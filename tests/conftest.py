@@ -1,0 +1,14 @@
+"""Test configuration: force an 8-device virtual CPU mesh before jax imports.
+
+Mirrors the reference's strategy of simulating multi-node on one machine
+(``xgboost_ray/tests/conftest.py:36-71`` uses ray's in-process Cluster); here
+the analog is XLA's host-platform device multiplexing, which lets every
+shard_map/psum test run the real collective code path on 8 virtual devices.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,219 @@
+"""Unit tests for the core device ops: binning, histograms, splits, growth.
+
+Models the reference's unit layer (``xgboost_ray/tests/test_matrix.py`` level
+of granularity) but for the compute core our build owns.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from xgboost_ray_tpu.ops import binning
+from xgboost_ray_tpu.ops.histogram import build_histogram, hist_onehot, hist_scatter, node_sums
+from xgboost_ray_tpu.ops.split import SplitParams, find_splits, leaf_weight
+from xgboost_ray_tpu.ops.grow import GrowConfig, build_tree, predict_tree_binned
+from xgboost_ray_tpu.ops.objectives import get_objective
+from xgboost_ray_tpu.ops.metrics import compute_metric
+
+
+def test_binning_roundtrip_basic():
+    rng = np.random.RandomState(0)
+    x = rng.randn(500, 4).astype(np.float32)
+    cuts = binning.sketch_cuts_np(x, max_bin=16)
+    assert cuts.shape == (4, 15)
+    assert np.all(np.diff(cuts, axis=1) >= 0)
+    b = binning.bin_matrix_np(x, cuts, max_bin=16)
+    assert b.dtype == np.uint8
+    assert b.max() <= 15  # no missing values present
+    # roughly equal occupancy per bin
+    counts = np.bincount(b[:, 0], minlength=16)
+    assert counts.min() > 0
+
+
+def test_binning_missing_goes_to_reserved_bin():
+    x = np.array([[1.0], [np.nan], [2.0], [3.0]], dtype=np.float32)
+    cuts = binning.sketch_cuts_np(x, max_bin=4)
+    b = binning.bin_matrix_np(x, cuts, max_bin=4)
+    assert b[1, 0] == 4  # missing bucket
+    assert b[0, 0] < 4
+
+
+def test_binning_device_matches_host():
+    rng = np.random.RandomState(1)
+    x = rng.randn(200, 3).astype(np.float32)
+    x[5, 1] = np.nan
+    cuts = binning.sketch_cuts_np(x, max_bin=8)
+    host = binning.bin_matrix_np(x, cuts, max_bin=8)
+    dev = np.asarray(binning.bin_matrix(jnp.asarray(x), jnp.asarray(cuts), 8))
+    np.testing.assert_array_equal(host, dev)
+
+
+def test_device_sketch_close_to_exact_quantiles():
+    rng = np.random.RandomState(2)
+    x = rng.randn(20000, 2).astype(np.float32)
+    valid = jnp.ones((x.shape[0],), bool)
+    mn, mx = binning.feature_min_max(jnp.asarray(x), valid)
+    hist = binning.sketch_histogram(jnp.asarray(x), valid, mn, mx)
+    cuts = np.asarray(binning.cuts_from_sketch(mn, mx, hist, max_bin=16))
+    exact = binning.sketch_cuts_np(x, max_bin=16)
+    assert np.max(np.abs(cuts - exact)) < 0.05  # fine-histogram approximation
+
+
+def test_histogram_impls_agree():
+    rng = np.random.RandomState(3)
+    n, f, nb = 300, 5, 8
+    bins = rng.randint(0, nb + 1, size=(n, f)).astype(np.uint8)
+    gh = rng.randn(n, 2).astype(np.float32)
+    pos = rng.randint(0, 4, size=n).astype(np.int32)
+    h1 = np.asarray(hist_scatter(jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(pos), 4, nb + 1))
+    h2 = np.asarray(
+        hist_onehot(jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(pos), 4, nb + 1, chunk=64)
+    )
+    np.testing.assert_allclose(h1, h2, atol=1e-4)
+    # cross-check against numpy accumulation
+    ref = np.zeros((4, f, nb + 1, 2), np.float32)
+    for i in range(n):
+        for j in range(f):
+            ref[pos[i], j, bins[i, j]] += gh[i]
+    np.testing.assert_allclose(h1, ref, atol=1e-4)
+
+
+def test_node_sums():
+    gh = jnp.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    pos = jnp.array([0, 1, 0])
+    s = np.asarray(node_sums(gh, pos, 2))
+    np.testing.assert_allclose(s, [[6.0, 8.0], [3.0, 4.0]])
+
+
+def test_find_splits_picks_obvious_split():
+    # one node, one feature, 4 bins: grads +1 in low bins, -1 in high bins
+    nbt = 5  # 4 bins + missing
+    hist = np.zeros((1, 1, nbt, 2), np.float32)
+    hist[0, 0, 0] = [10.0, 10.0]
+    hist[0, 0, 1] = [10.0, 10.0]
+    hist[0, 0, 2] = [-10.0, 10.0]
+    hist[0, 0, 3] = [-10.0, 10.0]
+    node_gh = jnp.asarray(hist[:, 0, :, :].sum(axis=1))
+    sp = find_splits(jnp.asarray(hist), node_gh, SplitParams(min_child_weight=0.0))
+    assert bool(sp.valid[0])
+    assert int(sp.split_bin[0]) == 1  # bins {0,1} left, {2,3} right
+    assert float(sp.gain[0]) > 0
+
+
+def test_find_splits_respects_min_child_weight():
+    nbt = 5
+    hist = np.zeros((1, 1, nbt, 2), np.float32)
+    hist[0, 0, 0] = [5.0, 0.5]
+    hist[0, 0, 3] = [-5.0, 0.5]
+    node_gh = jnp.asarray(hist[:, 0, :, :].sum(axis=1))
+    sp = find_splits(jnp.asarray(hist), node_gh, SplitParams(min_child_weight=10.0))
+    assert not bool(sp.valid[0])
+
+
+def test_find_splits_learns_missing_direction():
+    # missing rows have negative grads -> should go right with the negative bin
+    nbt = 4  # 3 bins + missing
+    hist = np.zeros((1, 1, nbt, 2), np.float32)
+    hist[0, 0, 0] = [8.0, 8.0]
+    hist[0, 0, 2] = [-8.0, 8.0]
+    hist[0, 0, 3] = [-4.0, 4.0]  # missing bucket, negative grad
+    node_gh = jnp.asarray(hist[:, 0, :, :].sum(axis=1))
+    sp = find_splits(jnp.asarray(hist), node_gh, SplitParams(min_child_weight=0.0))
+    assert bool(sp.valid[0])
+    assert not bool(sp.default_left[0])  # missing joins the negative (right) side
+
+
+def _fit_one_tree(x, g, h, max_depth=3, max_bin=8, **split_kw):
+    cuts = binning.sketch_cuts_np(x, max_bin=max_bin)
+    bins = binning.bin_matrix_np(x, cuts, max_bin=max_bin)
+    gh = jnp.asarray(np.stack([g, h], axis=1).astype(np.float32))
+    cfg = GrowConfig(
+        max_depth=max_depth,
+        max_bin=max_bin,
+        split=SplitParams(learning_rate=1.0, reg_lambda=0.0, min_child_weight=0.0, **split_kw),
+    )
+    tree, row_value = build_tree(jnp.asarray(bins), gh, jnp.asarray(cuts), cfg)
+    return tree, np.asarray(row_value), bins, cfg
+
+
+def test_build_tree_fits_step_function():
+    # discrete feature values so quantile cuts separate classes exactly;
+    # y = 1 for x<0 else -1; squarederror from margin 0 -> g = -y, h = 1
+    rng = np.random.RandomState(4)
+    x = rng.choice([-0.75, -0.25, 0.25, 0.75], size=(400, 1)).astype(np.float32)
+    y = np.where(x[:, 0] < 0, 1.0, -1.0).astype(np.float32)
+    tree, row_value, bins, cfg = _fit_one_tree(x, -y, np.ones_like(y), max_depth=2)
+    np.testing.assert_allclose(row_value, y, atol=1e-3)
+    # binned walk agrees with row_value from training
+    walked = np.asarray(
+        predict_tree_binned(tree, jnp.asarray(bins), cfg.max_depth, cfg.max_bin)
+    )
+    np.testing.assert_allclose(walked, row_value, atol=1e-5)
+
+
+def test_build_tree_row_values_match_leaf_math():
+    rng = np.random.RandomState(5)
+    x = rng.randn(200, 3).astype(np.float32)
+    g = rng.randn(200).astype(np.float32)
+    h = np.ones(200, np.float32)
+    tree, row_value, bins, cfg = _fit_one_tree(x, g, h, max_depth=3)
+    # each row's value must equal a leaf value of the tree
+    leaf_vals = np.asarray(tree.value)[np.asarray(tree.is_leaf)]
+    for v in row_value[:20]:
+        assert np.min(np.abs(leaf_vals - v)) < 1e-5
+
+
+def test_objectives_shapes_and_values():
+    m = jnp.zeros((5, 1))
+    y = jnp.array([0.0, 1.0, 1.0, 0.0, 1.0])
+    w = jnp.ones((5,))
+    obj = get_objective("binary:logistic")
+    g, h = obj.grad_hess(m, y, w)
+    np.testing.assert_allclose(np.asarray(g[:, 0]), [0.5, -0.5, -0.5, 0.5, -0.5])
+    np.testing.assert_allclose(np.asarray(h[:, 0]), [0.25] * 5)
+    obj2 = get_objective("reg:squarederror")
+    g2, h2 = obj2.grad_hess(jnp.full((5, 1), 2.0), y, w)
+    np.testing.assert_allclose(np.asarray(g2[:, 0]), np.asarray(2.0 - y))
+    obj3 = get_objective("multi:softprob", num_class=3)
+    g3, h3 = obj3.grad_hess(jnp.zeros((5, 3)), jnp.array([0.0, 1.0, 2.0, 0.0, 1.0]), w)
+    assert g3.shape == (5, 3)
+    np.testing.assert_allclose(np.asarray(g3).sum(axis=1), 0.0, atol=1e-6)
+
+
+def test_metrics_basic():
+    m = np.array([10.0, 10.0, -10.0, 10.0])
+    y = np.array([0.0, 1.0, 0.0, 1.0])
+    assert compute_metric("error", m, y) == pytest.approx(0.25)
+    assert compute_metric("logloss", np.array([-10.0, 10.0, -10.0, 10.0]), y) < 0.2
+    r = compute_metric("rmse", np.array([1.0, 2.0]), np.array([0.0, 4.0]))
+    assert r == pytest.approx(np.sqrt((1 + 4) / 2))
+    auc = compute_metric("auc", np.array([0.1, 0.9, 0.2, 0.8]), np.array([0, 1, 0, 1]))
+    assert auc == pytest.approx(1.0)
+
+
+def test_ndcg_metric_perfect_and_inverted():
+    ptr = np.array([0, 3, 6])
+    y = np.array([2.0, 1.0, 0.0, 0.0, 1.0, 2.0])
+    perfect = np.array([3.0, 2.0, 1.0, 1.0, 2.0, 3.0])
+    assert compute_metric("ndcg", perfect, y, group_ptr=ptr) == pytest.approx(1.0)
+    inverted = -perfect
+    assert compute_metric("ndcg", inverted, y, group_ptr=ptr) < 0.8
+
+
+def test_ranking_gradients_point_the_right_way():
+    from xgboost_ray_tpu.ops.ranking import build_group_rows, make_rank_grad_hess
+
+    qid = np.array([0, 0, 0, 1, 1])
+    rows, ptr = build_group_rows(qid)
+    assert rows.shape == (2, 3)
+    label = jnp.array([2.0, 1.0, 0.0, 1.0, 0.0])
+    margin = jnp.zeros((5, 1))
+    w = jnp.ones((5,))
+    gh = make_rank_grad_hess("rank:pairwise")
+    g, h = gh(margin, label, w, jnp.asarray(rows))
+    g = np.asarray(g[:, 0])
+    assert g[0] < g[1] < g[2]  # most relevant gets most negative grad (pushed up)
+    assert g[3] < g[4]
+    assert np.all(np.asarray(h) > 0)

@@ -1,0 +1,27 @@
+"""Device compute ops for the tpu_hist GBDT engine (JAX/XLA/Pallas).
+
+These modules replace the native (C++/CUDA) compute core of xgboost that the
+reference orchestrates (SURVEY.md §2.2): binning/quantile sketch, gradient
+histograms, split search, tree growth, objectives, metrics, and prediction.
+"""
+
+from xgboost_ray_tpu.ops.binning import (
+    bin_matrix,
+    bin_matrix_np,
+    sketch_cuts_np,
+)
+from xgboost_ray_tpu.ops.grow import GrowConfig, Tree, build_tree
+from xgboost_ray_tpu.ops.objectives import Objective, get_objective
+from xgboost_ray_tpu.ops.split import SplitParams
+
+__all__ = [
+    "bin_matrix",
+    "bin_matrix_np",
+    "sketch_cuts_np",
+    "GrowConfig",
+    "Tree",
+    "build_tree",
+    "Objective",
+    "get_objective",
+    "SplitParams",
+]

@@ -1,0 +1,206 @@
+"""Evaluation metrics for the tpu_hist learner.
+
+TPU-native replacement for xgboost's metric kernels; the reference forwards
+``params["eval_metric"]`` to ``xgb.train`` and merges rank-0's
+``evals_result`` (``xgboost_ray/main.py:1327-1328``).
+
+Each metric is expressed as a (numerator, denominator) contribution so the
+distributed path can psum both and divide — the same trick xgboost's
+allreduce-based metric reduction uses. Sort-based metrics (auc, ndcg, map)
+operate on full gathered arrays.
+"""
+
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+# --- elementwise metrics: margin [N, K], label [N], weight [N] -> (num, den)
+
+
+def _rmse(margin, label, weight):
+    d = margin[:, 0] - label
+    return jnp.sum(weight * d * d), jnp.sum(weight)
+
+
+def _mae(margin, label, weight):
+    return jnp.sum(weight * jnp.abs(margin[:, 0] - label)), jnp.sum(weight)
+
+
+def _logloss(margin, label, weight):
+    m = margin[:, 0]
+    # numerically stable: log(1+exp(-m)) for y=1, log(1+exp(m)) for y=0
+    ll = jnp.where(label > 0.5, jax.nn.softplus(-m), jax.nn.softplus(m))
+    return jnp.sum(weight * ll), jnp.sum(weight)
+
+
+def _error(margin, label, weight, threshold=0.5):
+    p = _sigmoid(margin[:, 0])
+    wrong = jnp.where((p > threshold) != (label > 0.5), 1.0, 0.0)
+    return jnp.sum(weight * wrong), jnp.sum(weight)
+
+
+def _merror(margin, label, weight):
+    pred = jnp.argmax(margin, axis=-1)
+    wrong = jnp.where(pred != label.astype(jnp.int32), 1.0, 0.0)
+    return jnp.sum(weight * wrong), jnp.sum(weight)
+
+
+def _mlogloss(margin, label, weight):
+    logp = jax.nn.log_softmax(margin, axis=-1)
+    k = label.astype(jnp.int32)
+    ll = -jnp.take_along_axis(logp, k[:, None], axis=1)[:, 0]
+    return jnp.sum(weight * ll), jnp.sum(weight)
+
+
+def _poisson_nloglik(margin, label, weight):
+    m = jnp.clip(margin[:, 0], -30.0, 30.0)
+    mu = jnp.exp(m)
+    # -log p(y|mu) ignoring log(y!) like xgboost does not: xgboost includes lgamma(y+1)
+    nll = mu - label * m + jax.lax.lgamma(label + 1.0)
+    return jnp.sum(weight * nll), jnp.sum(weight)
+
+
+_ELEMENTWISE: Dict[str, Callable] = {
+    "rmse": _rmse,
+    "mae": _mae,
+    "logloss": _logloss,
+    "error": _error,
+    "merror": _merror,
+    "mlogloss": _mlogloss,
+    "poisson-nloglik": _poisson_nloglik,
+}
+
+
+# --- sort-based metrics (host/global) ---------------------------------------
+
+
+def _auc_np(score: np.ndarray, label: np.ndarray, weight: np.ndarray) -> float:
+    """Weighted ROC AUC via rank statistic (ties handled by midranks)."""
+    order = np.argsort(score, kind="stable")
+    s, y, w = score[order], label[order], weight[order]
+    # midranks for ties on weighted positions
+    cw = np.cumsum(w)
+    ranks = cw - w / 2.0
+    # average ranks within tied score groups (weighted midrank)
+    _, inv, counts = np.unique(s, return_inverse=True, return_counts=True)
+    grp_sum = np.zeros(counts.shape[0])
+    grp_w = np.zeros(counts.shape[0])
+    np.add.at(grp_sum, inv, ranks * w)
+    np.add.at(grp_w, inv, w)
+    mid = grp_sum / np.maximum(grp_w, 1e-12)
+    ranks = mid[inv]
+    pos_w = np.sum(w * (y > 0.5))
+    neg_w = np.sum(w * (y <= 0.5))
+    if pos_w <= 0 or neg_w <= 0:
+        return 0.5
+    sum_pos_ranks = np.sum(ranks * w * (y > 0.5))
+    # weighted Mann-Whitney U
+    auc = (sum_pos_ranks - pos_w * pos_w / 2.0) / (pos_w * neg_w)
+    return float(auc)
+
+
+def _dcg_at(labels: np.ndarray, k: int) -> float:
+    labels = labels[:k]
+    gains = (2.0 ** labels - 1.0) / np.log2(np.arange(2, labels.size + 2))
+    return float(np.sum(gains))
+
+
+def _ndcg_np(score: np.ndarray, label: np.ndarray, group_ptr: np.ndarray, k: int) -> float:
+    """Mean NDCG@k over query groups. group_ptr: [n_groups+1] row offsets."""
+    total, n_groups = 0.0, 0
+    for g in range(group_ptr.size - 1):
+        lo, hi = group_ptr[g], group_ptr[g + 1]
+        if hi <= lo:
+            continue
+        ls, ss = label[lo:hi], score[lo:hi]
+        order = np.argsort(-ss, kind="stable")
+        dcg = _dcg_at(ls[order], k)
+        ideal = _dcg_at(np.sort(ls)[::-1], k)
+        total += (dcg / ideal) if ideal > 0 else 1.0
+        n_groups += 1
+    return total / max(n_groups, 1)
+
+
+def _map_np(score: np.ndarray, label: np.ndarray, group_ptr: np.ndarray, k: int) -> float:
+    """Mean average precision@k over groups (binary relevance: label > 0)."""
+    total, n_groups = 0.0, 0
+    for g in range(group_ptr.size - 1):
+        lo, hi = group_ptr[g], group_ptr[g + 1]
+        if hi <= lo:
+            continue
+        ls = (label[lo:hi] > 0).astype(np.float64)
+        order = np.argsort(-score[lo:hi], kind="stable")
+        rel = ls[order][:k]
+        if rel.sum() == 0:
+            total += 0.0
+        else:
+            prec = np.cumsum(rel) / np.arange(1, rel.size + 1)
+            total += float(np.sum(prec * rel) / rel.sum())
+        n_groups += 1
+    return total / max(n_groups, 1)
+
+
+def parse_metric_name(name: str) -> Tuple[str, Optional[float]]:
+    """Split 'ndcg@10' / 'error@0.7' style names into (base, arg)."""
+    if "@" in name:
+        base, arg = name.split("@", 1)
+        # xgboost's "ndcg@10-" means "minus" convention; strip trailing '-'
+        arg = arg.rstrip("-")
+        return base, float(arg)
+    return name, None
+
+
+def is_maximize_metric(name: str) -> bool:
+    base, _ = parse_metric_name(name)
+    return base in ("auc", "ndcg", "map", "aucpr")
+
+
+def compute_metric(
+    name: str,
+    margin: np.ndarray,
+    label: np.ndarray,
+    weight: Optional[np.ndarray] = None,
+    group_ptr: Optional[np.ndarray] = None,
+) -> float:
+    """Compute a named metric on full (gathered) arrays.
+
+    margin: [N] or [N, K] raw margin scores; label: [N]; weight: [N] or None;
+    group_ptr: [n_groups+1] for ranking metrics.
+    """
+    margin = np.asarray(margin, dtype=np.float32)
+    if margin.ndim == 1:
+        margin = margin[:, None]
+    label = np.asarray(label, dtype=np.float32)
+    weight = (
+        np.ones(label.shape[0], np.float32)
+        if weight is None or np.size(weight) == 0
+        else np.asarray(weight, np.float32)
+    )
+    base, arg = parse_metric_name(name)
+    if base in _ELEMENTWISE:
+        if base == "error" and arg is not None:
+            num, den = _error(jnp.asarray(margin), jnp.asarray(label), jnp.asarray(weight), arg)
+        else:
+            num, den = _ELEMENTWISE[base](
+                jnp.asarray(margin), jnp.asarray(label), jnp.asarray(weight)
+            )
+        num, den = float(num), float(den)
+        val = num / max(den, 1e-12)
+        return float(np.sqrt(val)) if base == "rmse" else val
+    if base == "auc":
+        score = margin[:, 0] if margin.shape[1] == 1 else margin[:, 1]
+        return _auc_np(score.astype(np.float64), label, weight.astype(np.float64))
+    if base in ("ndcg", "map"):
+        if group_ptr is None:
+            group_ptr = np.array([0, label.shape[0]], dtype=np.int64)
+        k = int(arg) if arg is not None else (2 ** 31 - 1)
+        fn = _ndcg_np if base == "ndcg" else _map_np
+        return fn(margin[:, 0].astype(np.float64), label.astype(np.float64), group_ptr, k)
+    raise ValueError(f"Unsupported eval_metric: {name!r}")

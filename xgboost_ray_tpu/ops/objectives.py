@@ -1,0 +1,208 @@
+"""Objective functions: gradient/hessian closures for the tpu_hist learner.
+
+TPU-native replacement for xgboost's C++ objective kernels (the reference
+passes ``params["objective"]`` straight through to ``xgb.train`` at
+``xgboost_ray/main.py:745-752``; custom objectives are exercised by
+``tests/test_xgboost_api.py:77-150``).
+
+Each objective is a small pure-function bundle; grad/hess are computed on
+device inside the jitted round step (closed-form, not autodiff — these are
+classic second-order formulas and closed-form is both faster and matches
+xgboost semantics exactly). Ranking objectives live in ``ranking.py``.
+"""
+
+import dataclasses
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Objective:
+    name: str
+    # margin [N, K], label [N] (float; class index for multiclass),
+    # weight [N] -> (grad [N, K], hess [N, K])
+    grad_hess: Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]]
+    # margin [N, K] -> user-facing prediction (probabilities / values)
+    transform: Callable[[jnp.ndarray], jnp.ndarray]
+    # number of model outputs per row (1, or num_class for softprob/softmax)
+    num_outputs: int = 1
+    # default eval metric name (used when user supplies none)
+    default_metric: str = "rmse"
+    # map user base_score (prediction space) -> initial margin
+    base_score_to_margin: Callable[[float], float] = lambda s: s
+    default_base_score: float = 0.5
+    # "value" | "prob" | "class": what transform returns
+    output_kind: str = "value"
+
+
+def _sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def _make_squarederror() -> Objective:
+    def gh(margin, label, weight):
+        g = (margin[:, 0] - label) * weight
+        h = weight
+        return g[:, None], h[:, None]
+
+    return Objective(
+        name="reg:squarederror",
+        grad_hess=gh,
+        transform=lambda m: m[:, 0],
+        default_metric="rmse",
+        default_base_score=0.5,
+    )
+
+
+def _make_absoluteerror() -> Objective:
+    # xgboost uses g = sign(pred - y), h = 1 (with line search refinements we skip)
+    def gh(margin, label, weight):
+        g = jnp.sign(margin[:, 0] - label) * weight
+        h = weight
+        return g[:, None], h[:, None]
+
+    return Objective(
+        name="reg:absoluteerror",
+        grad_hess=gh,
+        transform=lambda m: m[:, 0],
+        default_metric="mae",
+        default_base_score=0.5,
+    )
+
+
+def _make_logistic(name: str, raw_output: bool, scale_pos_weight: float) -> Objective:
+    def gh(margin, label, weight):
+        p = _sigmoid(margin[:, 0])
+        w = weight * jnp.where(label > 0.5, scale_pos_weight, 1.0)
+        g = (p - label) * w
+        h = jnp.maximum(p * (1.0 - p), 1e-16) * w
+        return g[:, None], h[:, None]
+
+    return Objective(
+        name=name,
+        grad_hess=gh,
+        transform=(lambda m: m[:, 0]) if raw_output else (lambda m: _sigmoid(m[:, 0])),
+        default_metric="logloss",
+        base_score_to_margin=lambda s: float(jnp.log(s / (1.0 - s))) if 0 < s < 1 else 0.0,
+        default_base_score=0.5,
+        output_kind="value" if raw_output else "prob",
+    )
+
+
+def _make_softmax(num_class: int, prob_output: bool) -> Objective:
+    def gh(margin, label, weight):
+        p = jax.nn.softmax(margin, axis=-1)  # [N, K]
+        y = jax.nn.one_hot(label.astype(jnp.int32), num_class, dtype=p.dtype)
+        g = (p - y) * weight[:, None]
+        h = jnp.maximum(2.0 * p * (1.0 - p), 1e-16) * weight[:, None]
+        return g, h
+
+    def transform(m):
+        p = jax.nn.softmax(m, axis=-1)
+        return p if prob_output else jnp.argmax(p, axis=-1).astype(jnp.float32)
+
+    return Objective(
+        name="multi:softprob" if prob_output else "multi:softmax",
+        grad_hess=gh,
+        transform=transform,
+        num_outputs=num_class,
+        default_metric="mlogloss" if prob_output else "merror",
+        base_score_to_margin=lambda s: 0.0,
+        default_base_score=0.5,
+        output_kind="prob" if prob_output else "class",
+    )
+
+
+def _make_poisson() -> Objective:
+    # log-link: pred = exp(margin); g = exp(m) - y; h = exp(m)
+    def gh(margin, label, weight):
+        mu = jnp.exp(jnp.clip(margin[:, 0], -30.0, 30.0))
+        g = (mu - label) * weight
+        h = jnp.maximum(mu, 1e-16) * weight
+        return g[:, None], h[:, None]
+
+    return Objective(
+        name="count:poisson",
+        grad_hess=gh,
+        transform=lambda m: jnp.exp(m[:, 0]),
+        default_metric="poisson-nloglik",
+        base_score_to_margin=lambda s: float(jnp.log(jnp.maximum(s, 1e-16))),
+        default_base_score=0.5,
+    )
+
+
+RANKING_OBJECTIVES = ("rank:pairwise", "rank:ndcg", "rank:map")
+
+
+def get_objective(
+    name: str,
+    num_class: int = 0,
+    scale_pos_weight: float = 1.0,
+) -> Objective:
+    """Resolve an xgboost objective string to an Objective bundle.
+
+    Ranking objectives are resolved in ranking.py (they need qid segments);
+    this function still returns their transform/base-score envelope.
+    """
+    if name in ("reg:squarederror", "reg:linear"):
+        return _make_squarederror()
+    if name == "reg:absoluteerror":
+        return _make_absoluteerror()
+    if name in ("binary:logistic", "reg:logistic"):
+        return _make_logistic(name, raw_output=False, scale_pos_weight=scale_pos_weight)
+    if name == "binary:logitraw":
+        return _make_logistic(name, raw_output=True, scale_pos_weight=scale_pos_weight)
+    if name in ("multi:softprob", "multi:softmax"):
+        if num_class < 2:
+            raise ValueError(f"{name} requires num_class >= 2, got {num_class}")
+        return _make_softmax(num_class, prob_output=(name == "multi:softprob"))
+    if name == "count:poisson":
+        return _make_poisson()
+    if name in RANKING_OBJECTIVES:
+        from xgboost_ray_tpu.ops import ranking
+
+        return ranking.get_ranking_objective(name)
+    raise ValueError(f"Unsupported objective: {name!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class CustomObjective:
+    """Wrap a user-supplied ``obj(preds, dtrain) -> (grad, hess)`` callable.
+
+    Mirrors the xgboost custom-objective protocol passed through by the
+    reference (``xgboost_ray/tests/test_xgboost_api.py:77-103``). The callable
+    runs on host each round; grad/hess are shipped back to device.
+    """
+
+    fn: Callable
+    base: Objective  # envelope providing transform/num_outputs
+
+    @property
+    def name(self):
+        return "custom"
+
+    @property
+    def num_outputs(self):
+        return self.base.num_outputs
+
+    @property
+    def transform(self):
+        return self.base.transform
+
+    @property
+    def default_metric(self):
+        return self.base.default_metric
+
+    @property
+    def base_score_to_margin(self):
+        return self.base.base_score_to_margin
+
+    @property
+    def default_base_score(self):
+        return self.base.default_base_score
+
+    @property
+    def output_kind(self):
+        return self.base.output_kind

@@ -1,0 +1,80 @@
+"""Vectorized tree-ensemble prediction on raw feature values.
+
+TPU-native replacement for xgboost's C++ prediction kernel
+(``model.predict(local_data)`` in the reference actor,
+``xgboost_ray/main.py:795-810``).
+
+The padded-heap tree layout (see ``grow.py``) makes prediction a fixed-length
+gather walk: ``max_depth`` steps of (feature gather, compare, child index),
+identical for every row — no data-dependent control flow, so the whole
+ensemble walk jits into one fused XLA program. Trees are vmapped; per-class
+routing for multiclass sums tree outputs round-robin into K margins.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from xgboost_ray_tpu.ops.grow import Tree
+
+
+def _walk_one_tree(tree: Tree, x: jnp.ndarray, max_depth: int) -> jnp.ndarray:
+    """x: [N, F] raw (may contain NaN). Returns leaf values [N]."""
+    n, num_features = x.shape
+    idx = jnp.zeros((n,), jnp.int32)
+    for _ in range(max_depth):
+        f = jnp.clip(tree.feature[idx], 0, num_features - 1)
+        xv = jnp.take_along_axis(x, f[:, None], axis=1)[:, 0]
+        # rule: go left iff x < threshold; missing follows learned default
+        go_right = jnp.where(jnp.isnan(xv), ~tree.default_left[idx], xv >= tree.threshold[idx])
+        nxt = 2 * idx + 1 + go_right.astype(jnp.int32)
+        idx = jnp.where(tree.is_leaf[idx], idx, nxt)
+    return tree.value[idx]
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth", "num_outputs", "num_parallel_tree", "ntree_limit"))
+def predict_margin(
+    forest: Tree,  # stacked trees: each field [T, heap]
+    x: jnp.ndarray,  # [N, F] float32 raw features
+    base_margin: jnp.ndarray,  # [N, K] starting margin
+    max_depth: int,
+    num_outputs: int,
+    num_parallel_tree: int = 1,
+    ntree_limit: int = 0,
+) -> jnp.ndarray:
+    """Sum leaf values of all trees into per-class margins. Returns [N, K]."""
+    t = forest.feature.shape[0]
+    leaf = jax.vmap(lambda tr: _walk_one_tree(tr, x, max_depth))(forest)  # [T, N]
+    if ntree_limit:
+        keep = jnp.arange(t) < ntree_limit
+        leaf = jnp.where(keep[:, None], leaf, 0.0)
+    if num_outputs == 1:
+        margin = base_margin[:, 0] + leaf.sum(axis=0) / num_parallel_tree
+        return margin[:, None]
+    # tree t belongs to class (t // num_parallel_tree) % K (round-major layout)
+    cls = (jnp.arange(t) // num_parallel_tree) % num_outputs
+    onehot = jax.nn.one_hot(cls, num_outputs, dtype=leaf.dtype)  # [T, K]
+    return base_margin + (leaf.T @ onehot) / num_parallel_tree
+
+
+def predict_leaf_index(
+    forest: Tree, x: jnp.ndarray, max_depth: int
+) -> jnp.ndarray:
+    """Per-tree leaf heap index for each row (xgboost pred_leaf analog). [N, T]."""
+    n, num_features = x.shape
+
+    def walk(tree):
+        idx = jnp.zeros((n,), jnp.int32)
+        for _ in range(max_depth):
+            f = jnp.clip(tree.feature[idx], 0, num_features - 1)
+            xv = jnp.take_along_axis(x, f[:, None], axis=1)[:, 0]
+            go_right = jnp.where(
+                jnp.isnan(xv), ~tree.default_left[idx], xv >= tree.threshold[idx]
+            )
+            nxt = 2 * idx + 1 + go_right.astype(jnp.int32)
+            idx = jnp.where(tree.is_leaf[idx], idx, nxt)
+        return idx
+
+    return jax.vmap(walk)(forest).T
