@@ -147,6 +147,24 @@ def _map_np(score: np.ndarray, label: np.ndarray, group_ptr: np.ndarray, k: int)
     return total / max(n_groups, 1)
 
 
+def is_elementwise_metric(name: str) -> bool:
+    """True if the metric reduces to psum-able (num, den) contributions."""
+    base, _ = parse_metric_name(name)
+    return base in _ELEMENTWISE
+
+
+def elementwise_contrib(name: str, margin, label, weight):
+    """Device-side (num, den) contribution for an elementwise metric.
+
+    margin: [N, K], label/weight: [N] (weight 0 for padding rows). The caller
+    psums both parts across shards; rmse additionally takes a sqrt on host.
+    """
+    base, arg = parse_metric_name(name)
+    if base == "error" and arg is not None:
+        return _error(margin, label, weight, arg)
+    return _ELEMENTWISE[base](margin, label, weight)
+
+
 def parse_metric_name(name: str) -> Tuple[str, Optional[float]]:
     """Split 'ndcg@10' / 'error@0.7' style names into (base, arg)."""
     if "@" in name:

@@ -1,0 +1,142 @@
+"""xgboost-style parameter dict parsing and validation.
+
+The reference passes the user's ``params`` dict straight to ``xgb.train``
+(``xgboost_ray/main.py:745-752``) after validating distributed-compatibility
+(``main.py:1506-1524``: ``exact``/``grow_colmaker`` rejected, GPU hint
+warnings). We mirror the same surface: same keys, same aliases, same
+rejections — resolved into a typed config for the jitted tpu_hist engine.
+"""
+
+import dataclasses
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+logger = logging.getLogger(__name__)
+
+_ALIASES = {
+    "eta": "learning_rate",
+    "lambda": "reg_lambda",
+    "alpha": "reg_alpha",
+    "min_split_loss": "gamma",
+}
+
+# accepted-and-ignored keys (no TPU meaning, kept for drop-in compatibility)
+_IGNORED = {
+    "nthread",
+    "n_jobs",
+    "verbosity",
+    "silent",
+    "gpu_id",
+    "predictor",
+    "sampling_method",
+    "max_leaves",
+    "grow_policy",
+    "monotone_constraints",
+    "interaction_constraints",
+    "validate_parameters",
+    "single_precision_histogram",
+    "use_label_encoder",
+    "enable_categorical",
+    "disable_default_eval_metric",
+    "num_pairsample",
+    "device",
+    "max_cat_to_onehot",
+    "eval_at",
+}
+
+
+@dataclasses.dataclass
+class TrainParams:
+    objective: str = "reg:squarederror"
+    num_class: int = 0
+    learning_rate: float = 0.3
+    max_depth: int = 6
+    reg_lambda: float = 1.0
+    reg_alpha: float = 0.0
+    gamma: float = 0.0
+    min_child_weight: float = 1.0
+    max_delta_step: float = 0.0
+    subsample: float = 1.0
+    colsample_bytree: float = 1.0
+    colsample_bylevel: float = 1.0
+    max_bin: int = 256
+    base_score: Optional[float] = None
+    seed: int = 0
+    num_parallel_tree: int = 1
+    scale_pos_weight: float = 1.0
+    tree_method: str = "tpu_hist"
+    eval_metric: List[str] = dataclasses.field(default_factory=list)
+    # tpu_hist internals
+    hist_impl: str = "auto"  # auto | scatter | onehot | pallas
+    hist_chunk: int = 8192
+
+
+def parse_params(params: Optional[Dict[str, Any]]) -> TrainParams:
+    params = dict(params or {})
+    out = TrainParams()
+
+    tree_method = str(params.pop("tree_method", "tpu_hist") or "tpu_hist")
+    if tree_method in ("exact",):
+        # parity with xgboost_ray/main.py:1509-1515 (exact unsupported distributed)
+        raise ValueError(
+            "`exact` tree_method doesn't support distributed training. Use "
+            "`tree_method=\"tpu_hist\"` (or \"hist\"/\"approx\", which map to it)."
+        )
+    if tree_method in ("gpu_hist",):
+        logger.warning(
+            "tree_method='gpu_hist' has no meaning on TPU; using 'tpu_hist'."
+        )
+        tree_method = "tpu_hist"
+    if tree_method in ("hist", "approx", "auto"):
+        tree_method = "tpu_hist"
+    if tree_method != "tpu_hist":
+        raise ValueError(f"Unsupported tree_method: {tree_method!r}")
+    out.tree_method = tree_method
+
+    updater = params.pop("updater", None)
+    if updater and "grow_colmaker" in str(updater):
+        # parity with xgboost_ray/main.py:1509-1515
+        raise ValueError(
+            "`grow_colmaker` updater doesn't support distributed training."
+        )
+
+    em = params.pop("eval_metric", None)
+    if em is not None:
+        out.eval_metric = [em] if isinstance(em, str) else list(em)
+
+    for key, value in list(params.items()):
+        name = _ALIASES.get(key, key)
+        if name in _IGNORED:
+            continue
+        if name == "random_state":
+            name = "seed"
+        if not hasattr(out, name):
+            logger.warning("Ignoring unknown xgboost parameter %r", key)
+            continue
+        field_type = type(getattr(TrainParams(), name))
+        if value is not None:
+            try:
+                if name == "base_score":
+                    value = float(value)
+                elif field_type is float:
+                    value = float(value)
+                elif field_type is int:
+                    value = int(value)
+                elif field_type is str:
+                    value = str(value)
+            except (TypeError, ValueError):
+                pass
+        setattr(out, name, value)
+
+    if out.max_depth < 1:
+        raise ValueError("max_depth must be >= 1 for tpu_hist")
+    if out.max_depth > 14:
+        raise ValueError(
+            f"max_depth={out.max_depth} too large for the padded-heap tpu_hist "
+            "learner (limit 14)."
+        )
+    if not 1 < out.max_bin <= 1024:
+        raise ValueError("max_bin must be in (1, 1024]")
+    if out.objective.startswith("multi:") and out.num_class < 2:
+        raise ValueError("multi:* objectives require num_class >= 2")
+    return out
