@@ -1,14 +1,29 @@
-"""Test configuration: force an 8-device virtual CPU mesh before jax imports.
+"""Test configuration: force a hermetic 8-device virtual CPU mesh.
 
 Mirrors the reference's strategy of simulating multi-node on one machine
 (``xgboost_ray/tests/conftest.py:36-71`` uses ray's in-process Cluster); here
 the analog is XLA's host-platform device multiplexing, which lets every
 shard_map/psum test run the real collective code path on 8 virtual devices.
+
+The TPU (axon) PJRT plugin registers itself at interpreter startup via
+sitecustomize; ``xla_bridge.backends()`` would then initialize it even under
+``JAX_PLATFORMS=cpu``, making CPU tests hang whenever the TPU tunnel is busy
+or wedged. Deregistering the factory here keeps the suite fully hermetic.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+# the axon register() call force-sets jax_platforms="axon,cpu"; undo both the
+# config override and the factory registration
+jax.config.update("jax_platforms", "cpu")
+for _name in list(_xb._backend_factories):
+    if _name not in ("cpu",):
+        _xb._backend_factories.pop(_name, None)

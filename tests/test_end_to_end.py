@@ -1,0 +1,379 @@
+"""End-to-end tests of the public train()/predict() API.
+
+Parity targets: ``xgboost_ray/tests/test_end_to_end.py`` (keystone fixtures,
+predict paths, callbacks, kwargs validation) and the core of
+``test_fault_tolerance.py`` (checkpoint-based restarts, determinism under
+failure, elastic continuation).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from xgboost_ray_tpu import (
+    RayDMatrix,
+    RayParams,
+    RayShardingMode,
+    predict,
+    train,
+)
+from xgboost_ray_tpu.callback import DistributedCallback, TrainingCallback
+from xgboost_ray_tpu.exceptions import RayActorError, RayXGBoostTrainingError
+
+
+def _one_hot_fixture():
+    eye = np.eye(4, dtype=np.float32)
+    x = np.tile(eye, (8, 1))  # 32 rows, patterns cycling 0..3
+    y = np.tile([1.0, 0.0, 1.0, 0.0], 8).astype(np.float32)
+    return x, y, eye
+
+
+_PARAMS = {
+    "objective": "binary:logistic",
+    "max_depth": 3,
+    "eta": 0.5,
+    "eval_metric": ["logloss", "error"],
+    "reg_lambda": 0.0,
+    "min_child_weight": 0.0,
+}
+
+
+class _FailOnceCallback(TrainingCallback):
+    """Injects a (virtual) actor death at a given round — the analog of the
+    reference's ``_kill_callback`` with die-lock once-only semantics
+    (``tests/utils.py:110-180``)."""
+
+    def __init__(self, fail_at: int, ranks=(1,)):
+        self.fail_at = fail_at
+        self.ranks = ranks
+        self.fired = False
+
+    def after_iteration(self, model, epoch, evals_log):
+        if not self.fired and epoch == self.fail_at:
+            self.fired = True
+            raise RayActorError("injected failure", ranks=self.ranks)
+        return False
+
+
+def test_train_end_to_end_interleaved_and_batch():
+    x, y, eye = _one_hot_fixture()
+    for sharding in (RayShardingMode.INTERLEAVED, RayShardingMode.BATCH):
+        dtrain = RayDMatrix(x, y, sharding=sharding)
+        evals_result = {}
+        additional_results = {}
+        bst = train(
+            _PARAMS,
+            dtrain,
+            num_boost_round=10,
+            evals=[(dtrain, "train")],
+            evals_result=evals_result,
+            additional_results=additional_results,
+            ray_params=RayParams(num_actors=2),
+        )
+        pred = bst.predict(eye)
+        np.testing.assert_array_equal(pred > 0.5, [True, False, True, False])
+        assert len(evals_result["train"]["logloss"]) == 10
+        assert evals_result["train"]["error"][-1] == 0.0
+        assert additional_results["total_n"] == 32
+        assert "training_time_s" in additional_results
+        assert "total_time_s" in additional_results
+
+
+def test_predict_distributed_combines_in_order():
+    x, y, _ = _one_hot_fixture()
+    dtrain = RayDMatrix(x, y)
+    bst = train(_PARAMS, dtrain, 10, ray_params=RayParams(num_actors=2))
+    for sharding in (RayShardingMode.INTERLEAVED, RayShardingMode.BATCH):
+        dpred = RayDMatrix(x, sharding=sharding)
+        out = predict(bst, dpred, ray_params=RayParams(num_actors=2))
+        assert out.shape == (32,)
+        np.testing.assert_allclose(out, bst.predict(x), atol=1e-6)
+
+
+def test_predict_softprob_2d_combine():
+    rng = np.random.RandomState(0)
+    n = 90
+    y = rng.randint(0, 3, n).astype(np.float32)
+    x = np.eye(3, dtype=np.float32)[y.astype(int)] + 0.01 * rng.randn(n, 3).astype(
+        np.float32
+    )
+    params = {"objective": "multi:softprob", "num_class": 3, "max_depth": 3,
+              "eta": 0.5}
+    dtrain = RayDMatrix(x, y)
+    bst = train(params, dtrain, 8, ray_params=RayParams(num_actors=2))
+    out = predict(bst, RayDMatrix(x), ray_params=RayParams(num_actors=3))
+    assert out.shape == (90, 3)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-5)
+    assert (out.argmax(axis=1) == y.astype(int)).mean() > 0.95
+
+
+def test_invalid_kwargs_rejected():
+    x, y, _ = _one_hot_fixture()
+    dtrain = RayDMatrix(x, y)
+    with pytest.raises(TypeError, match="unexpected keyword"):
+        train(_PARAMS, dtrain, 5, ray_params=RayParams(num_actors=2),
+              totally_bogus_arg=1)
+
+
+def test_train_requires_ray_dmatrix():
+    x, y, _ = _one_hot_fixture()
+    with pytest.raises(ValueError, match="RayDMatrix"):
+        train(_PARAMS, (x, y), 5, ray_params=RayParams(num_actors=2))
+
+
+def test_num_actors_required():
+    x, y, _ = _one_hot_fixture()
+    with pytest.raises(ValueError, match="num_actors"):
+        train(_PARAMS, RayDMatrix(x, y), 5)
+
+
+def test_exact_tree_method_rejected():
+    x, y, _ = _one_hot_fixture()
+    params = dict(_PARAMS, tree_method="exact")
+    with pytest.raises(ValueError, match="exact"):
+        train(params, RayDMatrix(x, y), 5, ray_params=RayParams(num_actors=2))
+
+
+def test_custom_objective_and_metric():
+    rng = np.random.RandomState(1)
+    x = rng.randn(200, 3).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+
+    def sq_obj(preds, dtrain):
+        labels = dtrain.get_label()
+        return preds - labels, np.ones_like(labels)
+
+    def mean_abs(preds, dtrain):
+        return "my_mae", float(np.mean(np.abs(preds - dtrain.get_label())))
+
+    dtrain = RayDMatrix(x, y)
+    evals_result = {}
+    params = {"objective": "reg:squarederror", "max_depth": 3, "eta": 0.5,
+              "eval_metric": ["rmse"]}
+    bst = train(
+        params,
+        dtrain,
+        10,
+        evals=[(dtrain, "train")],
+        evals_result=evals_result,
+        ray_params=RayParams(num_actors=2),
+        obj=sq_obj,
+        feval=mean_abs,
+    )
+    assert "my_mae" in evals_result["train"]
+    assert evals_result["train"]["my_mae"][-1] < evals_result["train"]["my_mae"][0]
+    pred = bst.predict(x)
+    assert np.mean(np.abs(pred - y)) < 0.25
+
+
+def test_user_callbacks_and_put_queue():
+    from xgboost_ray_tpu.session import put_queue
+
+    x, y, _ = _one_hot_fixture()
+
+    class RecordCallback(TrainingCallback):
+        def after_iteration(self, model, epoch, evals_log):
+            put_queue(("round", epoch))
+            return False
+
+    dtrain = RayDMatrix(x, y)
+    additional_results = {}
+    train(
+        _PARAMS,
+        dtrain,
+        5,
+        ray_params=RayParams(num_actors=2),
+        additional_results=additional_results,
+        callbacks=[RecordCallback()],
+    )
+    returns = additional_results["callback_returns"]
+    assert [item for _, item in sorted(returns.items())][0] == [
+        ("round", i) for i in range(5)
+    ]
+
+
+def test_early_stopping():
+    rng = np.random.RandomState(2)
+    x = rng.randn(400, 5).astype(np.float32)
+    y = (x[:, 0] + 0.5 * rng.randn(400) > 0).astype(np.float32)
+    dtrain = RayDMatrix(x[:300], y[:300])
+    dvalid = RayDMatrix(x[300:], y[300:])
+    evals_result = {}
+    bst = train(
+        dict(_PARAMS, max_depth=6),
+        dtrain,
+        100,
+        evals=[(dtrain, "train"), (dvalid, "valid")],
+        evals_result=evals_result,
+        ray_params=RayParams(num_actors=2),
+        early_stopping_rounds=5,
+    )
+    rounds_run = len(evals_result["valid"]["error"])
+    assert rounds_run < 100
+    assert bst.best_iteration is not None
+
+
+def test_xgb_model_warm_start():
+    x, y, _ = _one_hot_fixture()
+    dtrain = RayDMatrix(x, y)
+    bst1 = train(_PARAMS, dtrain, 5, ray_params=RayParams(num_actors=2))
+    assert bst1.num_boosted_rounds() == 5
+    bst2 = train(
+        _PARAMS, RayDMatrix(x, y), 5, ray_params=RayParams(num_actors=2),
+        xgb_model=bst1,
+    )
+    assert bst2.num_boosted_rounds() == 10
+
+
+def test_non_elastic_failure_recovers_from_checkpoint():
+    x, y, eye = _one_hot_fixture()
+    dtrain = RayDMatrix(x, y)
+    evals_result = {}
+    bst = train(
+        _PARAMS,
+        dtrain,
+        10,
+        evals=[(dtrain, "train")],
+        evals_result=evals_result,
+        ray_params=RayParams(num_actors=2, max_actor_restarts=1,
+                             checkpoint_frequency=2),
+        callbacks=[_FailOnceCallback(fail_at=5)],
+    )
+    assert bst.num_boosted_rounds() == 10
+    pred = bst.predict(eye)
+    np.testing.assert_array_equal(pred > 0.5, [True, False, True, False])
+
+
+def test_failure_does_not_change_the_model():
+    """Determinism across failure/no-failure runs — the reference's
+    ``test_fault_tolerance.py:401-449`` guarantee."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(256, 4).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    params = dict(_PARAMS, max_depth=4)
+
+    bst_clean = train(
+        params, RayDMatrix(x, y), 10,
+        ray_params=RayParams(num_actors=2, checkpoint_frequency=2),
+    )
+    bst_failed = train(
+        params, RayDMatrix(x, y), 10,
+        ray_params=RayParams(num_actors=2, max_actor_restarts=1,
+                             checkpoint_frequency=2),
+        callbacks=[_FailOnceCallback(fail_at=5, ranks=(0,))],
+    )
+    assert bst_failed.num_boosted_rounds() == 10
+    np.testing.assert_allclose(
+        bst_clean.predict(x, output_margin=True),
+        bst_failed.predict(x, output_margin=True),
+        atol=1e-4,
+    )
+
+
+def test_failure_exhausts_retries():
+    x, y, _ = _one_hot_fixture()
+
+    class AlwaysFail(TrainingCallback):
+        def after_iteration(self, model, epoch, evals_log):
+            raise RayActorError("boom", ranks=[1])
+
+    with pytest.raises(RayXGBoostTrainingError):
+        train(
+            _PARAMS, RayDMatrix(x, y), 10,
+            ray_params=RayParams(num_actors=2, max_actor_restarts=1),
+            callbacks=[AlwaysFail()],
+        )
+
+
+def test_elastic_training_continues_with_fewer(monkeypatch):
+    # disable background reintegration to observe pure elastic continuation
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_DISABLED", "1")
+    x, y, eye = _one_hot_fixture()
+    additional_results = {}
+    bst = train(
+        _PARAMS,
+        RayDMatrix(x, y),
+        10,
+        ray_params=RayParams(num_actors=2, elastic_training=True,
+                             max_failed_actors=1, max_actor_restarts=1,
+                             checkpoint_frequency=2),
+        additional_results=additional_results,
+        callbacks=[_FailOnceCallback(fail_at=4)],
+    )
+    assert bst.num_boosted_rounds() == 10
+    # after the failure only one actor's shard remains
+    assert additional_results["total_n"] == 16
+
+
+def test_elastic_reintegration_restores_world(monkeypatch):
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_RESOURCE_CHECK_S", "0")
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_GRACE_PERIOD_S", "0")
+    x, y, eye = _one_hot_fixture()
+    additional_results = {}
+    bst = train(
+        _PARAMS,
+        RayDMatrix(x, y),
+        12,
+        ray_params=RayParams(num_actors=2, elastic_training=True,
+                             max_failed_actors=1, max_actor_restarts=2,
+                             checkpoint_frequency=2),
+        additional_results=additional_results,
+        callbacks=[_FailOnceCallback(fail_at=3)],
+    )
+    assert bst.num_boosted_rounds() == 12
+    # reintegration restored the full world before the end
+    assert additional_results["total_n"] == 32
+    pred = bst.predict(eye)
+    np.testing.assert_array_equal(pred > 0.5, [True, False, True, False])
+
+
+def test_elastic_validation_errors():
+    x, y, _ = _one_hot_fixture()
+    with pytest.raises(ValueError, match="max_failed_actors"):
+        train(_PARAMS, RayDMatrix(x, y), 5,
+              ray_params=RayParams(num_actors=2, elastic_training=True))
+    with pytest.raises(ValueError, match="max_actor_restarts"):
+        train(_PARAMS, RayDMatrix(x, y), 5,
+              ray_params=RayParams(num_actors=2, elastic_training=True,
+                                   max_failed_actors=1))
+
+
+def test_distributed_callbacks_fire_in_order():
+    events = []
+
+    class Tracker(DistributedCallback):
+        def on_init(self, actor, *a, **kw):
+            events.append(("init", actor.rank))
+
+        def before_data_loading(self, actor, data, *a, **kw):
+            events.append(("before_load", actor.rank))
+
+        def after_data_loading(self, actor, data, *a, **kw):
+            events.append(("after_load", actor.rank))
+
+        def before_train(self, actor, *a, **kw):
+            events.append(("before_train", actor.rank))
+
+        def after_train(self, actor, result_dict, *a, **kw):
+            events.append(("after_train", actor.rank))
+
+    x, y, _ = _one_hot_fixture()
+    train(
+        _PARAMS, RayDMatrix(x, y), 3,
+        ray_params=RayParams(num_actors=2,
+                             distributed_callbacks=[Tracker()]),
+    )
+    kinds = [e[0] for e in events]
+    assert kinds.index("init") < kinds.index("before_load")
+    assert kinds.index("before_load") < kinds.index("after_load")
+    assert kinds.index("after_load") < kinds.index("before_train")
+    assert kinds.index("before_train") < kinds.index("after_train")
+    assert ("init", 0) in events and ("init", 1) in events
+
+
+def test_feature_weights_accepted_and_stored():
+    x, y, _ = _one_hot_fixture()
+    fw = np.array([1.0, 1.0, 0.5, 0.5], np.float32)
+    dtrain = RayDMatrix(x, y, feature_weights=fw)
+    bst = train(_PARAMS, dtrain, 5, ray_params=RayParams(num_actors=2))
+    assert bst.num_boosted_rounds() == 5

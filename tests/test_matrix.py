@@ -1,0 +1,254 @@
+"""Data-layer tests (parity targets: ``xgboost_ray/tests/test_matrix.py``)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from xgboost_ray_tpu.matrix import (
+    RayDMatrix,
+    RayDeviceQuantileDMatrix,
+    RayShardingMode,
+    _get_sharding_indices,
+    combine_data,
+)
+from xgboost_ray_tpu.data_sources import RayFileType
+
+
+@pytest.fixture
+def xy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    return x, y
+
+
+def _gather(dm, num_actors):
+    parts = [dm.get_data(r, num_actors) for r in range(num_actors)]
+    x = combine_data(dm.sharding, [p["data"] for p in parts])
+    y = combine_data(dm.sharding, [p["label"] for p in parts])
+    return x, y
+
+
+def test_from_numpy_interleaved_roundtrip(xy):
+    x, y = xy
+    dm = RayDMatrix(x, y, sharding=RayShardingMode.INTERLEAVED)
+    rx, ry = _gather(dm, 4)
+    np.testing.assert_allclose(rx, x)
+    np.testing.assert_allclose(ry, y)
+
+
+def test_from_numpy_batch_roundtrip_uneven(xy):
+    x, y = xy
+    dm = RayDMatrix(x[:63], y[:63], sharding=RayShardingMode.BATCH)
+    rx, ry = _gather(dm, 4)
+    np.testing.assert_allclose(rx, x[:63])
+    np.testing.assert_allclose(ry, y[:63])
+
+
+def test_interleaved_uneven_roundtrip(xy):
+    x, y = xy
+    dm = RayDMatrix(x[:61], y[:61], sharding=RayShardingMode.INTERLEAVED)
+    rx, ry = _gather(dm, 4)
+    np.testing.assert_allclose(rx, x[:61])
+    np.testing.assert_allclose(ry, y[:61])
+
+
+def test_from_pandas_label_column(xy):
+    x, y = xy
+    df = pd.DataFrame(x, columns=["a", "b", "c", "d"])
+    df["target"] = y
+    dm = RayDMatrix(df, label="target")
+    shard = dm.get_data(0, 2)
+    assert shard["data"].shape[1] == 4  # label column excluded
+    assert dm.resolved_feature_names == ["a", "b", "c", "d"]
+    np.testing.assert_allclose(shard["label"], y[0::2])
+
+
+def test_ignore_columns(xy):
+    x, y = xy
+    df = pd.DataFrame(x, columns=["a", "b", "c", "d"])
+    df["target"] = y
+    dm = RayDMatrix(df, label="target", ignore=["c"])
+    shard = dm.get_data(0, 2)
+    assert shard["data"].shape[1] == 3
+
+
+def test_column_ordering_preserved():
+    df = pd.DataFrame({"x1": [1.0, 2.0], "label": [0.0, 1.0], "x2": [3.0, 4.0]})
+    dm = RayDMatrix(df, label="label")
+    shard = dm.get_data(0, 1)
+    assert dm.resolved_feature_names == ["x1", "x2"]
+    np.testing.assert_allclose(shard["data"], [[1.0, 3.0], [2.0, 4.0]])
+
+
+def test_weight_and_base_margin(xy):
+    x, y = xy
+    w = np.arange(64, dtype=np.float32)
+    bm = np.full(64, 0.5, np.float32)
+    dm = RayDMatrix(x, y, weight=w, base_margin=bm)
+    parts = [dm.get_data(r, 2) for r in range(2)]
+    rw = combine_data(dm.sharding, [p["weight"] for p in parts])
+    np.testing.assert_allclose(rw, w)
+    np.testing.assert_allclose(parts[0]["base_margin"], bm[0::2])
+
+
+def test_missing_value_replacement(xy):
+    x, y = xy
+    x = x.copy()
+    x[x > 1.0] = 99.0
+    dm = RayDMatrix(x, y, missing=99.0)
+    shard = dm.get_data(0, 1)
+    assert np.isnan(shard["data"]).sum() == (x == 99.0).sum()
+
+
+def test_csv_single_and_multi(tmp_path, xy):
+    x, y = xy
+    df = pd.DataFrame(x, columns=[f"f{i}" for i in range(4)])
+    df["label"] = y
+    p1 = str(tmp_path / "a.csv")
+    p2 = str(tmp_path / "b.csv")
+    df.iloc[:32].to_csv(p1, index=False)
+    df.iloc[32:].to_csv(p2, index=False)
+
+    dm = RayDMatrix(p1, label="label", distributed=False)
+    shard = dm.get_data(0, 1)
+    assert shard["data"].shape == (32, 4)
+
+    dm2 = RayDMatrix([p1, p2], label="label")  # auto-distributed, file-sharded
+    assert dm2.distributed
+    s0 = dm2.get_data(0, 2)
+    s1 = dm2.get_data(1, 2)
+    assert s0["data"].shape == (32, 4) and s1["data"].shape == (32, 4)
+    np.testing.assert_allclose(s0["label"], y[:32])
+
+
+def test_parquet_distributed_dir(tmp_path, xy):
+    x, y = xy
+    df = pd.DataFrame(x, columns=[f"f{i}" for i in range(4)])
+    df["label"] = y
+    for i in range(4):
+        df.iloc[i * 16 : (i + 1) * 16].to_parquet(tmp_path / f"part{i}.parquet")
+    dm = RayDMatrix(str(tmp_path), label="label", filetype=RayFileType.PARQUET)
+    assert dm.distributed
+    shards = [dm.get_data(r, 2) for r in range(2)]
+    total = sum(s["data"].shape[0] for s in shards)
+    assert total == 64
+
+
+def test_too_many_actors_errors(xy):
+    x, y = xy
+    dm = RayDMatrix(x[:4], y[:4])
+    with pytest.raises(RuntimeError):
+        dm.load_data(8)
+
+
+def test_too_many_actors_distributed(tmp_path, xy):
+    x, y = xy
+    df = pd.DataFrame(x, columns=[f"f{i}" for i in range(4)])
+    df["label"] = y
+    p1 = str(tmp_path / "a.csv")
+    df.to_csv(p1, index=False)
+    dm = RayDMatrix([p1], label="label")
+    with pytest.raises(RuntimeError):
+        dm.get_data(0, 2)
+
+
+def test_num_actors_cannot_change(xy):
+    x, y = xy
+    dm = RayDMatrix(x, y, num_actors=2)
+    with pytest.raises(ValueError):
+        dm.load_data(4)
+
+
+def test_group_param_rejected(xy):
+    x, y = xy
+    with pytest.raises(ValueError):
+        RayDMatrix(x, y, group=np.array([32, 32]))
+
+
+def test_qid_with_weight_rejected(xy):
+    x, y = xy
+    with pytest.raises(NotImplementedError):
+        RayDMatrix(x, y, qid=np.zeros(64), weight=np.ones(64))
+
+
+def test_qid_sorting():
+    rng = np.random.RandomState(1)
+    x = rng.randn(20, 2).astype(np.float32)
+    qid = rng.randint(0, 4, size=20)
+    y = rng.rand(20).astype(np.float32)
+    dm = RayDMatrix(x, y, qid=qid)
+    shard = dm.get_data(0, 1)
+    assert np.all(np.diff(shard["qid"]) >= 0)  # groups contiguous
+    # rows stay aligned with their labels after the sort
+    order = np.argsort(qid, kind="stable")
+    np.testing.assert_allclose(shard["label"], y[order])
+    np.testing.assert_allclose(shard["data"], x[order])
+
+
+def test_list_of_frames_object_store_analog(xy):
+    x, y = xy
+    parts = [
+        pd.DataFrame(x[:32], columns=[f"f{i}" for i in range(4)]).assign(label=y[:32]),
+        pd.DataFrame(x[32:], columns=[f"f{i}" for i in range(4)]).assign(label=y[32:]),
+    ]
+    dm = RayDMatrix(parts, label="label")
+    assert dm.distributed
+    s0 = dm.get_data(0, 2)
+    np.testing.assert_allclose(s0["label"], y[:32])
+
+
+def test_partitioned_protocol(xy):
+    x, y = xy
+
+    class Fake:
+        pass
+
+    obj = Fake()
+    df = pd.DataFrame(x, columns=[f"f{i}" for i in range(4)])
+    df["label"] = y
+    obj.__partitioned__ = {
+        "shape": (64, 5),
+        "partition_tiling": (2, 1),
+        "partitions": {
+            (0, 0): {"start": (0, 0), "shape": (32, 5), "data": df.iloc[:32]},
+            (1, 0): {"start": (32, 0), "shape": (32, 5), "data": df.iloc[32:]},
+        },
+        "get": lambda ref: ref,
+    }
+    dm = RayDMatrix(obj, label="label")
+    s0 = dm.get_data(0, 2)
+    np.testing.assert_allclose(s0["label"], y[:32])
+
+
+def test_sharding_indices_cover_everything():
+    for mode in (RayShardingMode.INTERLEAVED, RayShardingMode.BATCH):
+        for n, k in [(10, 3), (64, 4), (7, 7), (5, 2)]:
+            all_idx = sorted(
+                i for r in range(k) for i in _get_sharding_indices(mode, r, k, n)
+            )
+            assert all_idx == list(range(n))
+
+
+def test_combine_data_multiclass_2d():
+    a = np.arange(12, dtype=np.float32).reshape(4, 3)
+    parts = [a[0::2], a[1::2]]
+    out = combine_data(RayShardingMode.INTERLEAVED, parts)
+    np.testing.assert_allclose(out, a)
+
+
+def test_device_quantile_dmatrix_alias(xy):
+    x, y = xy
+    dm = RayDeviceQuantileDMatrix(x, y, max_bin=64)
+    shard = dm.get_data(0, 1)
+    assert shard["data"].shape == (64, 4)
+
+
+def test_uid_identity(xy):
+    x, y = xy
+    a = RayDMatrix(x, y)
+    b = RayDMatrix(x, y)
+    assert a != b and hash(a) != hash(b)
+    assert a == a

@@ -1,0 +1,223 @@
+"""sklearn facade tests (parity targets: ``xgboost_ray/tests/test_sklearn.py``,
+core scenarios: binary/multiclass, RF variants, ranking, clone/grid-search
+compatibility, save/load, early stopping, RayDMatrix passthrough)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sklearn.base import clone
+from sklearn.datasets import load_breast_cancer, load_iris
+from sklearn.model_selection import train_test_split
+
+from xgboost_ray_tpu import RayDMatrix, RayParams
+from xgboost_ray_tpu.sklearn import (
+    RayXGBClassifier,
+    RayXGBRanker,
+    RayXGBRegressor,
+    RayXGBRFClassifier,
+    RayXGBRFRegressor,
+)
+
+RP = RayParams(num_actors=2)
+
+
+@pytest.fixture(scope="module")
+def bc():
+    d = load_breast_cancer()
+    return train_test_split(
+        d.data.astype(np.float32), d.target, random_state=0, test_size=0.25
+    )
+
+
+def test_classifier_binary(bc):
+    x_tr, x_te, y_tr, y_te = bc
+    clf = RayXGBClassifier(n_estimators=20, max_depth=4, random_state=0)
+    clf.fit(x_tr, y_tr, ray_params=RP)
+    assert clf.n_classes_ == 2
+    pred = clf.predict(x_te, ray_params=RP)
+    acc = (pred == y_te).mean()
+    assert acc > 0.92
+    proba = clf.predict_proba(x_te, ray_params=RP)
+    assert proba.shape == (len(y_te), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+    assert ((proba[:, 1] > 0.5).astype(int) == pred).all()
+
+
+def test_classifier_multiclass_iris():
+    d = load_iris()
+    x = d.data.astype(np.float32)
+    y = d.target
+    clf = RayXGBClassifier(n_estimators=15, max_depth=4)
+    clf.fit(x, y, ray_params=RP)
+    assert clf.n_classes_ == 3
+    pred = clf.predict(x, ray_params=RP)
+    assert (pred == y).mean() > 0.95
+    proba = clf.predict_proba(x, ray_params=RP)
+    assert proba.shape == (150, 3)
+
+
+def test_classifier_string_labels():
+    rng = np.random.RandomState(0)
+    x = rng.randn(100, 3).astype(np.float32)
+    y = np.where(x[:, 0] > 0, "spam", "ham")
+    clf = RayXGBClassifier(n_estimators=10, max_depth=3)
+    clf.fit(x, y, ray_params=RP)
+    pred = clf.predict(x, ray_params=RP)
+    assert set(pred) <= {"spam", "ham"}
+    assert (pred == y).mean() > 0.95
+
+
+def test_regressor_boston_like():
+    rng = np.random.RandomState(1)
+    x = rng.randn(300, 6).astype(np.float32)
+    y = x[:, 0] * 3 + x[:, 1] ** 2 + 0.1 * rng.randn(300)
+    reg = RayXGBRegressor(n_estimators=30, max_depth=4)
+    reg.fit(x, y, ray_params=RP)
+    pred = reg.predict(x, ray_params=RP)
+    assert np.mean((pred - y) ** 2) < 0.5
+    # sklearn scoring integration
+    assert reg.score(x, y) > 0.9
+
+
+def test_eval_set_and_early_stopping(bc):
+    x_tr, x_te, y_tr, y_te = bc
+    clf = RayXGBClassifier(n_estimators=100, max_depth=6, eval_metric=["logloss"])
+    clf.fit(
+        x_tr, y_tr,
+        eval_set=[(x_te, y_te)],
+        early_stopping_rounds=5,
+        ray_params=RP,
+    )
+    res = clf.evals_result()
+    assert "validation_0" in res
+    assert len(res["validation_0"]["logloss"]) < 100
+    assert hasattr(clf, "best_iteration")
+
+
+def test_clone_and_get_params():
+    clf = RayXGBClassifier(n_estimators=7, max_depth=2, learning_rate=0.1)
+    cloned = clone(clf)
+    assert cloned.n_estimators == 7
+    assert cloned.max_depth == 2
+    assert cloned.learning_rate == 0.1
+    params = clf.get_params()
+    assert params["n_estimators"] == 7
+
+
+def test_grid_search_compatible():
+    from sklearn.model_selection import GridSearchCV
+
+    rng = np.random.RandomState(2)
+    x = rng.randn(120, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(int)
+    clf = RayXGBClassifier(n_estimators=5, n_jobs=1)
+    gs = GridSearchCV(clf, {"max_depth": [2, 3]}, cv=2, error_score="raise")
+    gs.fit(x, y)
+    assert gs.best_params_["max_depth"] in (2, 3)
+
+
+def test_rf_classifier(bc):
+    x_tr, x_te, y_tr, y_te = bc
+    rf = RayXGBRFClassifier(n_estimators=20, max_depth=6, random_state=0)
+    rf.fit(x_tr, y_tr, ray_params=RP)
+    bst = rf.get_booster()
+    assert bst.num_boosted_rounds() == 1
+    assert bst.num_trees == 20
+    pred = rf.predict(x_te, ray_params=RP)
+    assert (pred == y_te).mean() > 0.9
+
+
+def test_rf_regressor():
+    rng = np.random.RandomState(3)
+    x = rng.randn(300, 5).astype(np.float32)
+    y = x[:, 0] * 2 + 0.05 * rng.randn(300)
+    rf = RayXGBRFRegressor(n_estimators=30, max_depth=6)
+    rf.fit(x, y, ray_params=RP)
+    pred = rf.predict(x, ray_params=RP)
+    assert np.corrcoef(pred, y)[0, 1] > 0.95
+
+
+def test_ranker_requires_qid():
+    rng = np.random.RandomState(4)
+    x = rng.randn(40, 3).astype(np.float32)
+    y = rng.randint(0, 3, 40)
+    rnk = RayXGBRanker(n_estimators=5)
+    with pytest.raises(ValueError, match="qid"):
+        rnk.fit(x, y, ray_params=RP)
+
+
+def test_ranker_learns():
+    rng = np.random.RandomState(5)
+    n_groups, per_group = 24, 10
+    n = n_groups * per_group
+    x = rng.randn(n, 4).astype(np.float32)
+    rel = (x[:, 0] > 0).astype(np.float32) + (x[:, 1] > 0.5).astype(np.float32)
+    qid = np.repeat(np.arange(n_groups), per_group)
+    rnk = RayXGBRanker(n_estimators=15, max_depth=3, eval_metric=["ndcg@5"])
+    rnk.fit(x, rel, qid=qid, eval_set=[(x, rel)], eval_qid=[qid], ray_params=RP)
+    res = rnk.evals_result()
+    assert res["validation_0"]["ndcg@5"][-1] > res["validation_0"]["ndcg@5"][0]
+    scores = rnk.predict(x, ray_params=RP)
+    assert scores.shape == (n,)
+    # within a random group, the top-scored doc should tend to be relevant
+    s0 = scores[:per_group]
+    assert rel[:per_group][np.argmax(s0)] >= rel[:per_group].mean()
+
+
+def test_ray_dmatrix_passthrough(bc):
+    x_tr, x_te, y_tr, y_te = bc
+    dm = RayDMatrix(x_tr, y_tr.astype(np.float32))
+    clf = RayXGBClassifier(n_estimators=10, max_depth=3)
+    clf.fit(dm, ray_params=RP)
+    pred = clf.predict(RayDMatrix(x_te), ray_params=RP)
+    assert ((pred == y_te).mean()) > 0.9
+
+
+def test_ray_dmatrix_without_label_rejected(bc):
+    x_tr, _, _, _ = bc
+    dm = RayDMatrix(x_tr)
+    clf = RayXGBClassifier(n_estimators=5)
+    with pytest.raises(ValueError, match="label"):
+        clf.fit(dm, ray_params=RP)
+
+
+def test_save_load_roundtrip(tmp_path, bc):
+    x_tr, x_te, y_tr, y_te = bc
+    clf = RayXGBClassifier(n_estimators=10, max_depth=3)
+    clf.fit(x_tr, y_tr, ray_params=RP)
+    p = str(tmp_path / "model.json")
+    clf.save_model(p)
+    clf2 = RayXGBClassifier()
+    clf2.load_model(p)
+    np.testing.assert_allclose(
+        clf.get_booster().predict(x_te), clf2.get_booster().predict(x_te), atol=1e-6
+    )
+
+
+def test_feature_importances(bc):
+    x_tr, _, y_tr, _ = bc
+    clf = RayXGBClassifier(n_estimators=10, max_depth=3)
+    clf.fit(x_tr, y_tr, ray_params=RP)
+    imp = clf.feature_importances_
+    assert imp.shape == (x_tr.shape[1],)
+    assert imp.sum() == pytest.approx(1.0)
+
+
+def test_warm_start_xgb_model(bc):
+    x_tr, _, y_tr, _ = bc
+    clf1 = RayXGBClassifier(n_estimators=5, max_depth=3)
+    clf1.fit(x_tr, y_tr, ray_params=RP)
+    clf2 = RayXGBClassifier(n_estimators=5, max_depth=3)
+    clf2.fit(x_tr, y_tr, xgb_model=clf1.get_booster(), ray_params=RP)
+    assert clf2.get_booster().num_boosted_rounds() == 10
+
+
+def test_pandas_input(bc):
+    x_tr, x_te, y_tr, y_te = bc
+    cols = [f"feat_{i}" for i in range(x_tr.shape[1])]
+    df_tr = pd.DataFrame(x_tr, columns=cols)
+    clf = RayXGBClassifier(n_estimators=10, max_depth=3)
+    clf.fit(df_tr, y_tr, ray_params=RP)
+    pred = clf.predict(pd.DataFrame(x_te, columns=cols), ray_params=RP)
+    assert (pred == y_te).mean() > 0.9

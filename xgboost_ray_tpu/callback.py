@@ -1,0 +1,101 @@
+"""Worker lifecycle callbacks (API mirror of ``xgboost_ray/callback.py``).
+
+``DistributedCallback`` hooks fire on each (virtual) worker around init, data
+loading, training and prediction — same hook names and ordering as the
+reference so user callbacks port unchanged.
+"""
+
+from typing import Any, List, Optional
+
+
+class DistributedCallback:
+    """Distributed callbacks for RayXGBoostActor lifecycle hooks."""
+
+    def on_init(self, actor, *args, **kwargs):
+        pass
+
+    def before_data_loading(self, actor, data, *args, **kwargs):
+        pass
+
+    def after_data_loading(self, actor, data, *args, **kwargs):
+        pass
+
+    def before_train(self, actor, *args, **kwargs):
+        pass
+
+    def after_train(self, actor, result_dict, *args, **kwargs):
+        pass
+
+    def before_predict(self, actor, *args, **kwargs):
+        pass
+
+    def after_predict(self, actor, predictions, *args, **kwargs):
+        pass
+
+
+class DistributedCallbackContainer:
+    def __init__(self, callbacks: Optional[List[DistributedCallback]]):
+        self.callbacks = callbacks or []
+
+    def on_init(self, actor, *args, **kwargs):
+        for callback in self.callbacks:
+            callback.on_init(actor, *args, **kwargs)
+
+    def before_data_loading(self, actor, data, *args, **kwargs):
+        for callback in self.callbacks:
+            callback.before_data_loading(actor, data, *args, **kwargs)
+
+    def after_data_loading(self, actor, data, *args, **kwargs):
+        for callback in self.callbacks:
+            callback.after_data_loading(actor, data, *args, **kwargs)
+
+    def before_train(self, actor, *args, **kwargs):
+        for callback in self.callbacks:
+            callback.before_train(actor, *args, **kwargs)
+
+    def after_train(self, actor, result_dict, *args, **kwargs):
+        for callback in self.callbacks:
+            callback.after_train(actor, result_dict, *args, **kwargs)
+
+    def before_predict(self, actor, *args, **kwargs):
+        for callback in self.callbacks:
+            callback.before_predict(actor, *args, **kwargs)
+
+    def after_predict(self, actor, predictions, *args, **kwargs):
+        for callback in self.callbacks:
+            callback.after_predict(actor, predictions, *args, **kwargs)
+
+
+class EnvironmentCallback(DistributedCallback):
+    """Set env vars on worker init (mirror of ``callback.py:105-110``)."""
+
+    def __init__(self, env_dict: dict):
+        self.env_dict = env_dict
+
+    def on_init(self, actor, *args, **kwargs):
+        import os
+
+        os.environ.update(self.env_dict)
+
+
+class TrainingCallback:
+    """xgboost-style per-iteration callback protocol.
+
+    The subset of ``xgboost.callback.TrainingCallback`` the reference relies
+    on (user callbacks forwarded at ``main.py:714-716``; legacy polyfill at
+    ``compat/__init__.py:12-42``): ``before_training``/``after_training``
+    return the model, ``before_iteration``/``after_iteration`` return a bool
+    (True stops training).
+    """
+
+    def before_training(self, model):
+        return model
+
+    def after_training(self, model):
+        return model
+
+    def before_iteration(self, model, epoch: int, evals_log: dict) -> bool:
+        return False
+
+    def after_iteration(self, model, epoch: int, evals_log: dict) -> bool:
+        return False

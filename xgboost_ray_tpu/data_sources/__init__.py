@@ -1,0 +1,33 @@
+"""Ordered data-source registry (mirrors ``xgboost_ray/data_sources/__init__.py``).
+
+Probe order matters: specific path-based sources before generic containers.
+"""
+
+from xgboost_ray_tpu.data_sources.data_source import DataSource, RayFileType
+from xgboost_ray_tpu.data_sources.numpy import Numpy
+from xgboost_ray_tpu.data_sources.pandas import Pandas
+from xgboost_ray_tpu.data_sources.csv import CSV
+from xgboost_ray_tpu.data_sources.parquet import Parquet
+from xgboost_ray_tpu.data_sources.object_store import ObjectStore
+from xgboost_ray_tpu.data_sources.partitioned import Partitioned
+
+data_sources = [
+    Numpy,
+    Pandas,
+    Partitioned,
+    CSV,
+    Parquet,
+    ObjectStore,
+]
+
+__all__ = [
+    "DataSource",
+    "RayFileType",
+    "Numpy",
+    "Pandas",
+    "CSV",
+    "Parquet",
+    "ObjectStore",
+    "Partitioned",
+    "data_sources",
+]

@@ -1,0 +1,56 @@
+"""CSV file data source (mirrors ``xgboost_ray/data_sources/csv.py``).
+
+Single path or list of paths; with a list, distributed loading shards on the
+*file* level (indices select files, reference csv.py:26-43).
+"""
+
+from typing import Any, List, Optional, Sequence, Union
+
+import pandas as pd
+
+from xgboost_ray_tpu.data_sources.data_source import DataSource, RayFileType
+
+
+def _is_csv_path(p: Any) -> bool:
+    return isinstance(p, str) and (p.endswith(".csv") or p.endswith(".csv.gz"))
+
+
+class CSV(DataSource):
+    supports_distributed_loading = True
+
+    @staticmethod
+    def is_data_type(data: Any, filetype: Optional[RayFileType] = None) -> bool:
+        if filetype == RayFileType.CSV:
+            return True
+        if isinstance(data, str):
+            return _is_csv_path(data)
+        if isinstance(data, Sequence) and not isinstance(data, str):
+            return len(data) > 0 and all(_is_csv_path(p) for p in data)
+        return False
+
+    @staticmethod
+    def get_filetype(data: Any) -> Optional[RayFileType]:
+        probe = data[0] if isinstance(data, (list, tuple)) and data else data
+        return RayFileType.CSV if _is_csv_path(probe) else None
+
+    @staticmethod
+    def load_data(
+        data: Union[str, Sequence[str]],
+        ignore: Optional[Sequence[str]] = None,
+        indices: Optional[Sequence[int]] = None,
+        **kwargs,
+    ) -> pd.DataFrame:
+        if isinstance(data, (list, tuple)):
+            files = list(data)
+            if indices is not None:
+                files = [files[i] for i in indices]
+            frames = [pd.read_csv(f, **kwargs) for f in files]
+            df = pd.concat(frames, ignore_index=True) if len(frames) > 1 else frames[0]
+        else:
+            df = pd.read_csv(data, **kwargs)
+            if indices is not None:
+                df = df.iloc[list(indices)]
+        if ignore:
+            keep = [c for c in df.columns if c not in set(ignore)]
+            df = df[keep]
+        return df

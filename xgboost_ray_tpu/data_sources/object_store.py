@@ -1,0 +1,64 @@
+"""Pre-partitioned in-memory data source.
+
+The reference's ObjectStore source consumes ``List[ray.ObjectRef]``
+(``xgboost_ray/data_sources/object_store.py:15-32``). Standalone TPU analog:
+a list of already-materialized partitions (pandas DataFrames, numpy arrays,
+or zero-arg callables producing either). Distributed loading shards on the
+partition level, like the reference does on refs.
+"""
+
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from xgboost_ray_tpu.data_sources.data_source import DataSource, RayFileType
+
+
+def _is_partition(p: Any) -> bool:
+    return isinstance(p, (pd.DataFrame, pd.Series, np.ndarray)) or callable(p)
+
+
+def _materialize(p: Any) -> pd.DataFrame:
+    if callable(p):
+        p = p()
+    if isinstance(p, np.ndarray):
+        arr = p if p.ndim == 2 else p.reshape(p.shape[0], -1)
+        return pd.DataFrame(arr, columns=[f"f{i}" for i in range(arr.shape[1])])
+    if isinstance(p, pd.Series):
+        return pd.DataFrame(p)
+    return p
+
+
+class ObjectStore(DataSource):
+    supports_distributed_loading = True
+
+    @staticmethod
+    def is_data_type(data: Any, filetype: Optional[RayFileType] = None) -> bool:
+        return (
+            isinstance(data, (list, tuple))
+            and len(data) > 0
+            and all(_is_partition(p) for p in data)
+            and not isinstance(data[0], str)
+        )
+
+    @staticmethod
+    def load_data(
+        data: Sequence[Any],
+        ignore: Optional[Sequence[str]] = None,
+        indices: Optional[Sequence[int]] = None,
+        **kwargs,
+    ) -> pd.DataFrame:
+        parts = list(data)
+        if indices is not None:
+            parts = [parts[i] for i in indices]
+        frames = [_materialize(p) for p in parts]
+        df = pd.concat(frames, ignore_index=True) if len(frames) > 1 else frames[0]
+        if ignore:
+            keep = [c for c in df.columns if c not in set(ignore)]
+            df = df[keep]
+        return df
+
+    @staticmethod
+    def get_n(data: Any) -> int:
+        return len(data)

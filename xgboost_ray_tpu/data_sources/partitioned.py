@@ -1,0 +1,49 @@
+"""``__partitioned__`` protocol data source.
+
+Mirrors ``xgboost_ray/data_sources/partitioned.py`` (Intel DPPY distributed
+dataframe protocol): an object exposing ``__partitioned__`` with a
+``partitions`` dict ({pos: {"start": ..., "shape": ..., "data": obj_or_ref}})
+and a ``get`` callable resolving references.
+"""
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from xgboost_ray_tpu.data_sources.data_source import DataSource, RayFileType
+from xgboost_ray_tpu.data_sources.object_store import _materialize
+
+
+class Partitioned(DataSource):
+    supports_distributed_loading = True
+
+    @staticmethod
+    def is_data_type(data: Any, filetype: Optional[RayFileType] = None) -> bool:
+        return hasattr(data, "__partitioned__")
+
+    @staticmethod
+    def load_data(
+        data: Any,
+        ignore: Optional[Sequence[str]] = None,
+        indices: Optional[Sequence[Any]] = None,
+        **kwargs,
+    ) -> pd.DataFrame:
+        meta = data.__partitioned__
+        getter = meta.get("get", lambda x: x)
+        parts = meta["partitions"]
+        # order partitions by their start offset for deterministic row order
+        items = sorted(parts.items(), key=lambda kv: tuple(np.ravel(kv[1].get("start", kv[0]))))
+        keys = [k for k, _ in items]
+        if indices is not None:
+            keys = [keys[i] for i in indices]
+        frames = [_materialize(getter(parts[k]["data"])) for k in keys]
+        df = pd.concat(frames, ignore_index=True) if len(frames) > 1 else frames[0]
+        if ignore:
+            keep = [c for c in df.columns if c not in set(ignore)]
+            df = df[keep]
+        return df
+
+    @staticmethod
+    def get_n(data: Any) -> int:
+        return len(data.__partitioned__["partitions"])

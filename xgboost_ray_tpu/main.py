@@ -1,0 +1,956 @@
+"""Driver API: ``train()`` / ``predict()`` / ``RayParams`` — the coordinator.
+
+API-compatible re-implementation of ``xgboost_ray/main.py`` (L3 of SURVEY §1)
+for the TPU runtime. The architectural inversion (SURVEY §7.1): the
+reference's N OS-process actors + Rabit tracker become virtual workers that
+own data shards and a single jitted SPMD program over the device mesh
+(``engine.TpuEngine``); the driver keeps the exact same responsibilities —
+validation, checkpointing every k rounds, the retry loop with
+restart-from-checkpoint arithmetic, elastic fault tolerance, the queue/event
+side-channel, and result merging (evals_result / additional_results).
+
+Fault model: TPU mesh failures surface as exceptions from the round step (or
+from fault-injection callbacks in tests); the driver marks ranks dead and —
+exactly like the reference (``main.py:1644-1713``) — either continues with
+survivors (elastic) or recreates the failed workers, then resumes from the
+last checkpoint with the world recompiled for the new mesh size.
+"""
+
+import dataclasses
+import logging
+import os
+import pickle
+import threading
+import time
+import warnings
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from xgboost_ray_tpu.callback import (
+    DistributedCallback,
+    DistributedCallbackContainer,
+    TrainingCallback,
+)
+from xgboost_ray_tpu.engine import TpuEngine
+from xgboost_ray_tpu.exceptions import (
+    RayActorError,
+    RayTaskError,
+    RayXGBoostActorAvailable,
+    RayXGBoostTrainingError,
+    RayXGBoostTrainingStopped,
+)
+from xgboost_ray_tpu.matrix import RayDMatrix, RayShardingMode, combine_data
+from xgboost_ray_tpu.models.booster import RayXGBoostBooster
+from xgboost_ray_tpu.params import parse_params
+from xgboost_ray_tpu import session as session_mod
+from xgboost_ray_tpu.util import Event, Queue
+
+logger = logging.getLogger(__name__)
+
+LEGACY_MATRIX = False
+
+
+# ---------------------------------------------------------------------------
+# Env-var config system (mirror of ``xgboost_ray/main.py:110-162``): every
+# field is overridable via RXGB_<NAME>, re-read live on each access.
+# ---------------------------------------------------------------------------
+
+
+def _get_environ(item: str, old_val: Any):
+    env_var = f"RXGB_{item}"
+    new_val = old_val
+    if env_var in os.environ:
+        raw = os.environ[env_var]
+        if isinstance(old_val, bool):
+            new_val = bool(int(raw))
+        elif isinstance(old_val, int):
+            new_val = int(raw)
+        elif isinstance(old_val, float):
+            new_val = float(raw)
+        else:
+            new_val = raw
+    return new_val
+
+
+@dataclass
+class _XGBoostEnv:
+    USE_SPREAD_STRATEGY: bool = True
+    PLACEMENT_GROUP_TIMEOUT_S: int = 100
+    STATUS_FREQUENCY_S: int = 30
+    ELASTIC_RESTART_DISABLED: bool = False
+    ELASTIC_RESTART_RESOURCE_CHECK_S: float = 30.0
+    ELASTIC_RESTART_GRACE_PERIOD_S: float = 10.0
+    COMMUNICATION_SOFT_PLACEMENT: bool = True
+
+    def __getattribute__(self, item):
+        old_val = object.__getattribute__(self, item)
+        if item.startswith("_"):
+            return old_val
+        return _get_environ(item, old_val)
+
+
+ENV = _XGBoostEnv()
+
+
+# ---------------------------------------------------------------------------
+# RayParams
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RayParams:
+    """Parameters to configure distributed-training behavior.
+
+    API mirror of ``xgboost_ray/main.py:448-504`` with one TPU addition:
+    ``tpus_per_actor`` (the number of mesh devices each logical actor may
+    occupy; the total mesh size is min(num_actors, available devices)).
+    """
+
+    # Actor scheduling
+    num_actors: int = 0
+    cpus_per_actor: int = 0
+    gpus_per_actor: int = -1
+    tpus_per_actor: int = -1
+    resources_per_actor: Optional[Dict] = None
+
+    # Fault tolerance
+    elastic_training: bool = False
+    max_failed_actors: int = 0
+    max_actor_restarts: int = 0
+    checkpoint_frequency: int = 5
+
+    # Distributed callbacks
+    distributed_callbacks: Optional[List[DistributedCallback]] = None
+
+    verbose: Optional[bool] = None
+    placement_options: Optional[Dict[str, Any]] = None
+
+    def get_tune_resources(self):
+        """Resources for a Tune trial running this training."""
+        from xgboost_ray_tpu.tune import _get_tune_resources
+
+        if self.num_actors <= 0:
+            raise ValueError("num_actors must be greater than 0.")
+        return _get_tune_resources(
+            num_actors=self.num_actors,
+            cpus_per_actor=max(0, self.cpus_per_actor),
+            gpus_per_actor=max(0, self.gpus_per_actor),
+            tpus_per_actor=max(0, self.tpus_per_actor),
+            resources_per_actor=self.resources_per_actor,
+            placement_options=self.placement_options,
+        )
+
+
+def _validate_ray_params(ray_params: Union[None, RayParams, dict]) -> RayParams:
+    if ray_params is None:
+        ray_params = RayParams()
+    elif isinstance(ray_params, dict):
+        ray_params = RayParams(**ray_params)
+    elif not isinstance(ray_params, RayParams):
+        raise ValueError(
+            f"`ray_params` must be a `RayParams` instance, a dict, or None, "
+            f"but it was {type(ray_params)}."
+        )
+    if ray_params.num_actors <= 0:
+        raise ValueError(
+            "The `num_actors` parameter is set to 0. Please always specify "
+            "the number of distributed workers you want to use "
+            "(`RayParams(num_actors=X)`)."
+        )
+    elif ray_params.num_actors < 2:
+        warnings.warn(
+            f"`num_actors` in `ray_params` is smaller than 2 "
+            f"({ray_params.num_actors}). Training will NOT be distributed!"
+        )
+    return ray_params
+
+
+@dataclass
+class _Checkpoint:
+    iteration: int = 0
+    value: Optional[bytes] = None
+
+
+# ---------------------------------------------------------------------------
+# Virtual worker ("actor"): owns a rank and its data shards. The compute
+# itself runs in the shared mesh program; this object carries the lifecycle
+# (load_data, liveness, callbacks) so the reference's scheduling/FT logic and
+# tests have the same surface to hook into (``xgboost_ray/main.py:543-815``).
+# ---------------------------------------------------------------------------
+
+
+class RayXGBoostActor:
+    def __init__(
+        self,
+        rank: int,
+        num_actors: int,
+        queue: Optional[Queue] = None,
+        stop_event: Optional[Event] = None,
+        distributed_callbacks: Optional[List[DistributedCallback]] = None,
+    ):
+        self.rank = rank
+        self.num_actors = num_actors
+        self.queue = queue
+        self.stop_event = stop_event
+        self.alive = True
+        self._data: Dict[RayDMatrix, Dict[str, Optional[np.ndarray]]] = {}
+        self._local_n: Dict[RayDMatrix, int] = {}
+        self._distributed_callbacks = DistributedCallbackContainer(
+            distributed_callbacks
+        )
+        self._distributed_callbacks.on_init(self)
+
+    def pid(self) -> int:
+        if not self.alive:
+            raise RayActorError(f"actor {self.rank} is dead", ranks=[self.rank])
+        return os.getpid()
+
+    def set_queue(self, queue: Queue):
+        self.queue = queue
+
+    def set_stop_event(self, stop_event: Event):
+        self.stop_event = stop_event
+
+    def load_data(self, data: RayDMatrix):
+        if data in self._data:
+            return
+        self._distributed_callbacks.before_data_loading(self, data)
+        shard = data.get_data(self.rank, self.num_actors)
+        n = shard["data"].shape[0] if shard["data"] is not None else 0
+        self._local_n[data] = n
+        self._data[data] = shard
+        self._distributed_callbacks.after_data_loading(self, data)
+
+    def get_shard(self, data: RayDMatrix) -> Dict[str, Optional[np.ndarray]]:
+        return self._data[data]
+
+    def local_n(self, data: RayDMatrix) -> int:
+        return self._local_n.get(data, 0)
+
+    def has_data(self, data: RayDMatrix) -> bool:
+        return data in self._data
+
+    def kill(self):
+        """Mark this worker dead (fault injection / failure detection)."""
+        self.alive = False
+
+
+# ---------------------------------------------------------------------------
+# Training state shared across attempts (mirror of ``main.py:1038-1058``).
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _TrainingState:
+    actors: List[Optional[RayXGBoostActor]]
+    queue: Queue
+    stop_event: Event
+    checkpoint: _Checkpoint
+    additional_results: Dict
+
+    failed_actor_ranks: set
+
+    # elastic: dead ranks awaiting background reintegration — NOT recreated
+    # by the next attempt (mirror of clearing start ranks, main.py:1659)
+    elastic_dead_ranks: set = dataclasses.field(default_factory=set)
+
+    # elastic scheduling (mirror of elastic.py state)
+    pending_actors: Optional[Dict[int, Tuple[RayXGBoostActor, float]]] = None
+    restart_training_at: Optional[float] = None
+    last_resource_check_at: float = 0.0
+
+    training_started_at: float = 0.0
+
+
+def _create_actor(
+    rank: int,
+    num_actors: int,
+    queue: Queue,
+    stop_event: Event,
+    distributed_callbacks: Optional[List[DistributedCallback]],
+) -> RayXGBoostActor:
+    return RayXGBoostActor(
+        rank,
+        num_actors,
+        queue=queue,
+        stop_event=stop_event,
+        distributed_callbacks=distributed_callbacks,
+    )
+
+
+def _handle_queue(queue: Queue, checkpoint: _Checkpoint, callback_returns: Dict):
+    """Drain the callback queue (mirror of ``main.py:902-922``)."""
+    while not queue.empty():
+        rank, item = queue.get()
+        if callable(item):
+            item()
+        elif isinstance(item, _Checkpoint):
+            checkpoint.iteration = item.iteration
+            checkpoint.value = item.value
+        else:
+            callback_returns.setdefault(rank, []).append(item)
+
+
+class _FauxDMatrix:
+    """Lightweight stand-in passed to custom objective/metric callables,
+    exposing the xgboost DMatrix accessors they use."""
+
+    def __init__(self, label, weight, group_ptr=None):
+        self._label = label
+        self._weight = weight
+        self._group_ptr = group_ptr
+
+    def get_label(self):
+        return self._label
+
+    def get_weight(self):
+        return self._weight if self._weight is not None else np.array([])
+
+    def get_group(self):
+        return (
+            np.diff(self._group_ptr) if self._group_ptr is not None else np.array([])
+        )
+
+    def num_row(self):
+        return len(self._label)
+
+
+class _EngineBoosterProxy:
+    """Lazy booster view handed to per-iteration callbacks; materializes the
+    current forest only when a callback actually touches the model."""
+
+    def __init__(self, engine: TpuEngine):
+        self._engine = engine
+        self._cached: Optional[RayXGBoostBooster] = None
+        self._cached_rounds = -1
+
+    def _materialize(self) -> RayXGBoostBooster:
+        n = len(self._engine.trees)
+        if self._cached is None or self._cached_rounds != n:
+            self._cached = self._engine.get_booster()
+            self._cached_rounds = n
+        return self._cached
+
+    def __getattr__(self, item):
+        return getattr(self._materialize(), item)
+
+
+def _serialize_booster(booster: RayXGBoostBooster) -> bytes:
+    return pickle.dumps(booster)
+
+
+def _deserialize_booster(raw: Optional[bytes]) -> Optional[RayXGBoostBooster]:
+    return pickle.loads(raw) if raw else None
+
+
+def _coerce_model(model) -> Optional[RayXGBoostBooster]:
+    if model is None:
+        return None
+    if isinstance(model, RayXGBoostBooster):
+        return model
+    if isinstance(model, bytes):
+        return _deserialize_booster(model)
+    if isinstance(model, str):
+        return RayXGBoostBooster.load_model(model)
+    raise ValueError(f"Cannot interpret xgb_model of type {type(model)}")
+
+
+_KNOWN_TRAIN_KWARGS = {
+    "obj",
+    "feval",
+    "custom_metric",
+    "callbacks",
+    "early_stopping_rounds",
+    "verbose_eval",
+    "xgb_model",
+    "maximize",
+}
+
+
+def _validate_kwargs_for_func(kwargs: Dict, allowed: set, func_name: str):
+    unknown = [k for k in kwargs if k not in allowed]
+    if unknown:
+        raise TypeError(
+            f"{func_name}() got unexpected keyword argument(s): {unknown}. "
+            f"Supported extra arguments: {sorted(allowed)}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# One training attempt (mirror of ``_train``, ``main.py:1061-1337``).
+# ---------------------------------------------------------------------------
+
+
+def _train(
+    params: Dict,
+    dtrain: RayDMatrix,
+    boost_rounds_left: int,
+    *,
+    evals: Sequence[Tuple[RayDMatrix, str]],
+    ray_params: RayParams,
+    obj: Optional[Callable],
+    feval: Optional[Callable],
+    callbacks: Sequence[Any],
+    early_stopping_rounds: Optional[int],
+    maximize: Optional[bool],
+    verbose_eval: Union[bool, int],
+    _training_state: _TrainingState,
+) -> Tuple[RayXGBoostBooster, Dict, Dict]:
+    from xgboost_ray_tpu import elastic as elastic_mod
+
+    state = _training_state
+    num_actors = ray_params.num_actors
+
+    # 1) create (or re-create) missing actors (mirror main.py:1129-1149)
+    newly_created = 0
+    for rank in list(state.failed_actor_ranks):
+        if state.actors[rank] is not None:
+            raise RuntimeError(
+                f"Trying to create actor with rank {rank}, but it already exists."
+            )
+        actor = _create_actor(
+            rank,
+            num_actors,
+            state.queue,
+            state.stop_event,
+            ray_params.distributed_callbacks,
+        )
+        state.actors[rank] = actor
+        state.failed_actor_ranks.remove(rank)
+        newly_created += 1
+    alive_actors = sum(1 for a in state.actors if a is not None)
+    if ray_params.verbose:
+        logger.info(
+            f"[RayXGBoost] Created {newly_created} new actors "
+            f"({alive_actors} total actors)."
+        )
+
+    # 2) locality / FIXED shard assignment (mirror main.py:1161-1165)
+    dtrain.assign_shards_to_actors(state.actors)
+    for deval, _ in evals:
+        deval.assign_shards_to_actors(state.actors)
+
+    # 3) data loading on every alive actor (mirror _PrepareActorTask)
+    load_errors = []
+    for actor in state.actors:
+        if actor is None:
+            continue
+        try:
+            actor.load_data(dtrain)
+            for deval, _ in evals:
+                actor.load_data(deval)
+        except (RayActorError, RayTaskError):
+            raise
+        except Exception as exc:  # noqa: BLE001 - surfaced as task error
+            load_errors.append((actor.rank, exc))
+    if load_errors:
+        err = RayTaskError(f"Data loading failed on ranks {load_errors}")
+        err.ranks = [rank for rank, _ in load_errors]
+        raise err
+    if ray_params.verbose:
+        logger.info("[RayXGBoost] Starting XGBoost training.")
+
+    # 4) build the mesh engine over the alive actors' shards
+    alive = [a for a in state.actors if a is not None]
+    parsed = parse_params(params)
+    train_shards = [a.get_shard(dtrain) for a in alive]
+    evals_in = []
+    for deval, name in evals:
+        if deval is dtrain:
+            evals_in.append((train_shards, name))
+        else:
+            evals_in.append(([a.get_shard(deval) for a in alive], name))
+    init_booster = _deserialize_booster(state.checkpoint.value)
+    engine = TpuEngine(
+        train_shards,
+        parsed,
+        num_actors=len(alive),
+        evals=evals_in,
+        init_booster=init_booster,
+        feature_names=dtrain.resolved_feature_names,
+    )
+    total_n = sum(a.local_n(dtrain) for a in alive)
+    state.additional_results["total_n"] = total_n
+
+    for actor in alive:
+        actor._distributed_callbacks.before_train(actor)
+
+    session_mod.init_session(rank=0, queue=state.queue)
+    proxy = _EngineBoosterProxy(engine)
+    evals_result: Dict[str, Dict[str, List[float]]] = {}
+    callback_returns = state.additional_results.setdefault("callback_returns", {})
+
+    es_metric = None
+    es_maximize = False
+    es_best: Optional[float] = None
+    es_best_iter = -1
+    if early_stopping_rounds is not None and evals_in:
+        from xgboost_ray_tpu.ops.metrics import is_maximize_metric
+
+        es_set = evals_in[-1][1]
+        es_metric = engine.metric_names[-1]
+        es_maximize = maximize if maximize is not None else is_maximize_metric(es_metric)
+
+    checkpoint_frequency = ray_params.checkpoint_frequency
+    train_started = time.time()
+    state.training_started_at = train_started
+    stop_requested = False
+    last_status = time.time()
+
+    for model_cb in callbacks:
+        if hasattr(model_cb, "before_training"):
+            model_cb.before_training(proxy)
+
+    completed = 0
+    for i in range(boost_rounds_left):
+        if state.stop_event.is_set():
+            raise RayXGBoostTrainingStopped("Training was aborted.")
+
+        for model_cb in callbacks:
+            if hasattr(model_cb, "before_iteration"):
+                model_cb.before_iteration(proxy, i, evals_result)
+
+        gh_custom = None
+        if obj is not None:
+            margins = engine.get_margins()
+            preds = margins[:, 0] if engine.n_outputs == 1 else margins
+            faux = _FauxDMatrix(engine.label_np, engine.weight_np, engine.group_ptr)
+            g, h = obj(preds, faux)
+            gh_custom = (g, h)
+
+        round_metrics = engine.step(i, gh_custom=gh_custom)
+        completed += 1
+
+        # custom metric (feval) computed on gathered margins per eval set
+        if feval is not None:
+            for es in engine.evals:
+                margin = engine.get_margins(es)
+                preds = margin[:, 0] if engine.n_outputs == 1 else margin
+                faux = _FauxDMatrix(
+                    es.label_np if es.label_np is not None else engine.label_np,
+                    es.weight_np,
+                    es.group_ptr,
+                )
+                name, value = feval(preds, faux)
+                round_metrics.setdefault(es.name, {})[name] = value
+
+        for set_name, metrics in round_metrics.items():
+            for metric_name, value in metrics.items():
+                evals_result.setdefault(set_name, {}).setdefault(
+                    metric_name, []
+                ).append(value)
+
+        if verbose_eval and (
+            verbose_eval is True or (i % max(int(verbose_eval), 1) == 0)
+        ):
+            flat = "\t".join(
+                f"{sn}-{mn}:{v[-1]:.5f}"
+                for sn, ms in evals_result.items()
+                for mn, v in ms.items()
+            )
+            print(f"[{i}]\t{flat}")
+
+        # driver-side checkpointing (mirror of the rank-0 checkpoint callback,
+        # main.py:612-626): every k rounds and after the final round
+        is_last = i == boost_rounds_left - 1
+        if checkpoint_frequency and ((i + 1) % checkpoint_frequency == 0 or is_last):
+            booster = engine.get_booster()
+            iteration = engine.iteration_offset + i
+            state.queue.put((0, _Checkpoint(iteration, _serialize_booster(booster))))
+
+        _handle_queue(state.queue, state.checkpoint, callback_returns)
+
+        # elastic: try to reintegrate failed ranks (mirror main.py:1266-1277)
+        if ray_params.elastic_training and not ENV.ELASTIC_RESTART_DISABLED:
+            elastic_mod._maybe_schedule_new_actors(
+                training_state=state,
+                num_cpus_per_actor=ray_params.cpus_per_actor,
+                num_gpus_per_actor=max(0, ray_params.gpus_per_actor),
+                resources_per_actor=ray_params.resources_per_actor,
+                ray_params=ray_params,
+                load_data=[dtrain] + [e[0] for e in evals],
+            )
+            elastic_mod._update_scheduled_actor_states(state)
+
+        stop = False
+        for model_cb in callbacks:
+            if hasattr(model_cb, "after_iteration"):
+                stop = model_cb.after_iteration(proxy, i, evals_result) or stop
+
+        if es_metric is not None:
+            try:
+                cur = evals_result[evals_in[-1][1]][es_metric][-1]
+            except KeyError:
+                cur = None
+            if cur is not None:
+                better = (
+                    es_best is None
+                    or (es_maximize and cur > es_best)
+                    or (not es_maximize and cur < es_best)
+                )
+                if better:
+                    es_best, es_best_iter = cur, i
+                elif i - es_best_iter >= early_stopping_rounds:
+                    stop = True
+
+        if time.time() - last_status > ENV.STATUS_FREQUENCY_S:
+            logger.info(
+                f"[RayXGBoost] Training in progress "
+                f"({time.time() - train_started:.0f}s, round {i})."
+            )
+            last_status = time.time()
+
+        if stop:
+            stop_requested = True
+            break
+
+    booster = engine.get_booster()
+    if es_metric is not None and es_best_iter >= 0:
+        booster.best_iteration = es_best_iter
+        booster.best_score = es_best
+
+    for model_cb in callbacks:
+        if hasattr(model_cb, "after_training"):
+            model_cb.after_training(proxy)
+
+    for actor in alive:
+        actor._distributed_callbacks.after_train(actor, {"evals_result": evals_result})
+
+    _handle_queue(state.queue, state.checkpoint, callback_returns)
+    state.additional_results["callback_returns"] = callback_returns
+
+    train_time = time.time() - train_started
+    return booster, evals_result, {
+        "train_n": total_n,
+        "training_time_s": train_time,
+        "stopped_early": stop_requested,
+        "completed_rounds": completed,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Public train() (mirror of ``main.py:1341-1747``)
+# ---------------------------------------------------------------------------
+
+
+def train(
+    params: Dict,
+    dtrain: RayDMatrix,
+    num_boost_round: int = 10,
+    *args,
+    evals: Union[List[Tuple[RayDMatrix, str]], Tuple] = (),
+    evals_result: Optional[Dict] = None,
+    additional_results: Optional[Dict] = None,
+    ray_params: Union[None, RayParams, Dict] = None,
+    _remote: Optional[bool] = None,
+    **kwargs,
+) -> RayXGBoostBooster:
+    """Distributed GBDT training on the TPU mesh.
+
+    Drop-in signature mirror of ``xgboost_ray.train`` (``main.py:1341``).
+    Failure handling matches the reference's three-way policy (elastic
+    continuation / recreate-from-checkpoint / abort), driven by
+    ``ray_params``.
+    """
+    start_time = time.time()
+    if args:
+        raise TypeError(
+            "train() takes keyword arguments after num_boost_round; got "
+            f"positional {args}"
+        )
+    _validate_kwargs_for_func(kwargs, _KNOWN_TRAIN_KWARGS, "train")
+    ray_params = _validate_ray_params(ray_params)
+
+    if not isinstance(dtrain, RayDMatrix):
+        raise ValueError(
+            f"The `dtrain` argument passed to `train()` is not a RayDMatrix, "
+            f"but of type {type(dtrain)}. FIX THIS by instantiating a "
+            f"RayDMatrix first: `dtrain = RayDMatrix(data, labels)`."
+        )
+    if isinstance(evals, tuple) and len(evals) == 2 and isinstance(evals[1], str):
+        evals = [evals]
+    for deval, name in evals:
+        if not isinstance(deval, RayDMatrix):
+            raise ValueError(
+                f"Evaluation data must be a RayDMatrix, got {type(deval)} "
+                f"for eval set {name!r}."
+            )
+
+    # Tune integration: auto-inject the report/checkpoint callback when
+    # running inside a tuning session (mirror main.py:1477-1480)
+    kwargs_callbacks = list(kwargs.get("callbacks") or [])
+    from xgboost_ray_tpu import tune as tune_mod
+
+    kwargs_callbacks = tune_mod._try_add_tune_callback(kwargs_callbacks)
+
+    parsed = parse_params(params)  # early validation (tree_method etc.)
+    del parsed
+
+    if ray_params.elastic_training and ray_params.max_failed_actors == 0:
+        raise ValueError(
+            "Elastic training enabled but the maximum number of failed "
+            "actors is set to 0. FIX THIS by setting "
+            "`RayParams(max_failed_actors=N)` to something > 0."
+        )
+    if ray_params.elastic_training and ray_params.max_actor_restarts == 0:
+        raise ValueError(
+            "Elastic training enabled but the maximum number of actor "
+            "restarts is set to 0. FIX THIS by setting "
+            "`RayParams(max_actor_restarts=N)` (-1 for unlimited)."
+        )
+
+    max_actor_restarts = (
+        ray_params.max_actor_restarts
+        if ray_params.max_actor_restarts >= 0
+        else float("inf")
+    )
+
+    obj = kwargs.get("obj")
+    feval = kwargs.get("feval") or kwargs.get("custom_metric")
+    early_stopping_rounds = kwargs.get("early_stopping_rounds")
+    maximize = kwargs.get("maximize")
+    verbose_eval = kwargs.get("verbose_eval", False)
+    xgb_model = _coerce_model(kwargs.get("xgb_model"))
+
+    # eager central loading on the driver (mirror main.py:1555-1556)
+    dtrain.load_data(ray_params.num_actors)
+    for deval, _ in evals:
+        deval.load_data(ray_params.num_actors)
+
+    state = _TrainingState(
+        actors=[None] * ray_params.num_actors,
+        queue=Queue(),
+        stop_event=Event(),
+        checkpoint=_Checkpoint(
+            iteration=-1,
+            value=_serialize_booster(xgb_model) if xgb_model else None,
+        ),
+        additional_results={},
+        failed_actor_ranks=set(range(ray_params.num_actors)),
+        pending_actors={},
+    )
+
+    boost_rounds_left = num_boost_round
+    last_checkpoint_value = state.checkpoint.value
+    tries = 0
+    total_training_time = 0.0
+    final_evals_result: Dict = {}
+    booster: Optional[RayXGBoostBooster] = None
+
+    while tries <= max_actor_restarts:
+        # restart-from-checkpoint round arithmetic (mirror main.py:1606-1612)
+        if state.checkpoint.value and state.checkpoint.value != last_checkpoint_value:
+            ckpt_booster = _deserialize_booster(state.checkpoint.value)
+            done_rounds = ckpt_booster.num_boosted_rounds() - (
+                xgb_model.num_boosted_rounds() if xgb_model else 0
+            )
+            boost_rounds_left = num_boost_round - done_rounds
+            last_checkpoint_value = state.checkpoint.value
+            if boost_rounds_left <= 0:
+                break
+
+        try:
+            booster, final_evals_result, stats = _train(
+                params,
+                dtrain,
+                boost_rounds_left,
+                evals=evals,
+                ray_params=ray_params,
+                obj=obj,
+                feval=feval,
+                callbacks=kwargs_callbacks,
+                early_stopping_rounds=early_stopping_rounds,
+                maximize=maximize,
+                verbose_eval=verbose_eval,
+                _training_state=state,
+            )
+            total_training_time += stats["training_time_s"]
+            break
+        except RayXGBoostActorAvailable as exc:
+            # elastic reintegration: free restart (mirror main.py:1661-1673)
+            logger.info(f"[RayXGBoost] {exc} Restarting from checkpoint with "
+                        f"reintegrated workers.")
+            _promote_pending_actors(state)
+            state.queue = Queue()
+            state.stop_event = Event()
+            _rewire_actors(state)
+            continue
+        except (RayActorError, RayTaskError) as exc:
+            if state.training_started_at:
+                total_training_time += time.time() - state.training_started_at
+                state.training_started_at = 0.0
+            alive = _apply_failure(state, exc)
+            if ray_params.elastic_training:
+                dead = ray_params.num_actors - alive
+                if dead > ray_params.max_failed_actors:
+                    raise RayXGBoostTrainingError(
+                        f"A worker died and too many workers are already dead "
+                        f"({dead} > max_failed_actors="
+                        f"{ray_params.max_failed_actors}). Aborting."
+                    ) from exc
+                logger.warning(
+                    f"[RayXGBoost] A worker died. Continuing elastically with "
+                    f"{alive} remaining workers."
+                )
+                # dead ranks are reintegrated in the background, not recreated
+                # by the next attempt
+                for rank in list(state.failed_actor_ranks):
+                    state.elastic_dead_ranks.add(rank)
+                    state.failed_actor_ranks.discard(rank)
+            else:
+                if tries + 1 > max_actor_restarts:
+                    raise RayXGBoostTrainingError(
+                        "A worker died during training and the maximum "
+                        "number of retries is exhausted. Checkpoint the "
+                        "model more frequently or raise "
+                        "`RayParams(max_actor_restarts=N)`."
+                    ) from exc
+                logger.warning(
+                    "[RayXGBoost] A worker died. Recreating it and restarting "
+                    "from the latest checkpoint."
+                )
+            state.queue = Queue()
+            state.stop_event = Event()
+            _rewire_actors(state)
+            tries += 1
+            continue
+
+    if booster is None:
+        # all rounds were already covered by the checkpoint
+        booster = _deserialize_booster(state.checkpoint.value)
+
+    if evals_result is not None:
+        evals_result.update(final_evals_result)
+
+    total_time = time.time() - start_time
+    state.additional_results["training_time_s"] = total_training_time
+    state.additional_results["total_time_s"] = total_time
+    if additional_results is not None:
+        additional_results.update(state.additional_results)
+
+    if ray_params.verbose:
+        logger.info(
+            f"[RayXGBoost] Finished training after {total_time:.2f}s "
+            f"({total_training_time:.2f}s pure training)."
+        )
+    return booster
+
+
+def _apply_failure(state: _TrainingState, exc) -> int:
+    """Mark failed ranks dead; return number of alive actors.
+
+    If the exception carries no rank information and liveness probing finds
+    every actor healthy, no actor is blamed: the retry simply rebuilds the
+    engine from the last checkpoint with the same world.
+    """
+    ranks = getattr(exc, "ranks", None) or []
+    if not ranks:
+        # unknown origin: probe liveness (mirror elastic.py:145-178)
+        for rank, actor in enumerate(state.actors):
+            if actor is not None and not actor.alive:
+                ranks.append(rank)
+    for rank in ranks:
+        if state.actors[rank] is not None:
+            state.actors[rank].kill()
+            state.actors[rank] = None
+            state.failed_actor_ranks.add(rank)
+    return sum(1 for a in state.actors if a is not None)
+
+
+def _rewire_actors(state: _TrainingState):
+    for actor in state.actors:
+        if actor is not None:
+            actor.set_queue(state.queue)
+            actor.set_stop_event(state.stop_event)
+
+
+def _promote_pending_actors(state: _TrainingState):
+    for rank, (actor, _ready_at) in list((state.pending_actors or {}).items()):
+        state.actors[rank] = actor
+        state.failed_actor_ranks.discard(rank)
+        state.elastic_dead_ranks.discard(rank)
+        del state.pending_actors[rank]
+    state.restart_training_at = None
+
+
+# ---------------------------------------------------------------------------
+# predict() (mirror of ``main.py:1750-1896``)
+# ---------------------------------------------------------------------------
+
+
+def _predict(
+    model: RayXGBoostBooster,
+    data: RayDMatrix,
+    ray_params: RayParams,
+    **kwargs,
+):
+    num_actors = ray_params.num_actors
+    actors = [
+        _create_actor(rank, num_actors, Queue(), Event(), ray_params.distributed_callbacks)
+        for rank in range(num_actors)
+    ]
+    data.assign_shards_to_actors(actors)
+    for actor in actors:
+        actor.load_data(data)
+        actor._distributed_callbacks.before_predict(actor)
+
+    predict_kwargs = dict(kwargs)
+    predict_kwargs.setdefault("validate_features", False)
+    results = []
+    for actor in actors:
+        shard = actor.get_shard(data)
+        if shard.get("base_margin") is not None and "base_margin" not in predict_kwargs:
+            pred = model.predict(
+                shard["data"], base_margin=shard["base_margin"], **predict_kwargs
+            )
+        else:
+            pred = model.predict(shard["data"], **predict_kwargs)
+        results.append(pred)
+        actor._distributed_callbacks.after_predict(actor, pred)
+
+    if data.sharding == RayShardingMode.FIXED:
+        return np.concatenate(results, axis=0)
+    return combine_data(data.sharding, results)
+
+
+def predict(
+    model: RayXGBoostBooster,
+    data: RayDMatrix,
+    ray_params: Union[None, RayParams, Dict] = None,
+    _remote: Optional[bool] = None,
+    **kwargs,
+) -> Optional[np.ndarray]:
+    """Distributed prediction (signature mirror of ``main.py:1810``)."""
+    ray_params = _validate_ray_params(ray_params)
+    if not isinstance(data, RayDMatrix):
+        raise ValueError(
+            f"The `data` argument passed to `predict()` is not a RayDMatrix, "
+            f"but of type {type(data)}. FIX THIS by instantiating a "
+            f"RayDMatrix first: `data = RayDMatrix(data)`."
+        )
+    model = _coerce_model(model)
+    max_actor_restarts = (
+        ray_params.max_actor_restarts
+        if ray_params.max_actor_restarts >= 0
+        else float("inf")
+    )
+    data.load_data(ray_params.num_actors)
+    tries = 0
+    while tries <= max_actor_restarts:
+        try:
+            return _predict(model, data, ray_params, **kwargs)
+        except (RayActorError, RayTaskError):
+            if tries + 1 <= max_actor_restarts:
+                logger.warning(
+                    "[RayXGBoost] A worker died during prediction. Trying "
+                    "again with new workers."
+                )
+                tries += 1
+            else:
+                raise RayXGBoostTrainingError(
+                    "A worker died during prediction and the maximum number "
+                    "of retries is exhausted."
+                )
+    return None

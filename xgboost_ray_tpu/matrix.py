@@ -1,0 +1,486 @@
+"""RayDMatrix: the distributed data handle for train()/predict().
+
+API-compatible re-implementation of ``xgboost_ray/matrix.py`` (RayDMatrix,
+RayShardingMode, combine_data, central/distributed loaders, qid sorting),
+re-targeted at the TPU runtime: shards are host numpy dicts keyed by actor
+rank; the engine device_puts them onto the mesh and bins them there
+(HBM-resident quantile-binned blocks replace xgboost's C++ DMatrix).
+
+Central loading (driver loads everything, shards by row) and distributed
+loading (each rank loads its own files/partitions) mirror
+``matrix.py:431-487`` and ``matrix.py:614-693`` respectively; the sharding
+index math and prediction re-assembly mirror ``matrix.py:1088-1157``.
+"""
+
+import glob
+import os
+import uuid
+from enum import Enum
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import pandas as pd
+
+from xgboost_ray_tpu.data_sources import DataSource, RayFileType, data_sources
+from xgboost_ray_tpu.data_sources._distributed import (
+    assign_partitions_to_actors,
+    get_actor_rank_hosts,
+)
+
+Data = Union[str, List[str], np.ndarray, pd.DataFrame, pd.Series, Sequence[Any]]
+
+
+class RayShardingMode(Enum):
+    """How rows (or files, for distributed loading) map to actor ranks.
+
+    Mirrors ``xgboost_ray/matrix.py:106-124``: INTERLEAVED strides rows over
+    ranks, BATCH gives contiguous blocks, FIXED pins pre-assigned partitions.
+    """
+
+    INTERLEAVED = 1
+    BATCH = 2
+    FIXED = 3
+
+
+def _get_sharding_indices(
+    sharding: RayShardingMode, rank: int, num_actors: int, n: int
+) -> List[int]:
+    """Row/file indices owned by ``rank`` (semantics of ``matrix.py:1088-1110``)."""
+    if sharding == RayShardingMode.BATCH:
+        n_per_actor, extras = divmod(n, num_actors)
+        sizes = [n_per_actor + 1] * extras + [n_per_actor] * (num_actors - extras)
+        points = np.concatenate([[0], np.cumsum(sizes)])
+        return list(range(points[rank], points[rank + 1]))
+    if sharding == RayShardingMode.INTERLEAVED:
+        return list(range(rank, n, num_actors))
+    raise ValueError(
+        f"Invalid value for `sharding` parameter: {sharding}. Pass a "
+        f"RayShardingMode enum member, e.g. RayShardingMode.BATCH."
+    )
+
+
+def combine_data(sharding: RayShardingMode, data: Iterable) -> np.ndarray:
+    """Re-assemble per-rank prediction shards into original row order
+    (inverse of ``_get_sharding_indices``; semantics of ``matrix.py:1114-1157``)."""
+    if sharding not in (RayShardingMode.BATCH, RayShardingMode.INTERLEAVED):
+        raise ValueError(
+            f"Invalid value for `sharding` parameter: {sharding}. Pass a "
+            f"RayShardingMode enum member, e.g. RayShardingMode.BATCH."
+        )
+    parts = [np.asarray(d) for d in data if len(d)]
+    if not parts:
+        return np.array([])
+    if sharding == RayShardingMode.BATCH:
+        return np.concatenate(parts, axis=0)
+    # INTERLEAVED: ranks may be off by one for uneven splits
+    min_len = min(len(d) for d in parts)
+    if parts[0].ndim == 1:
+        res = np.ravel(np.column_stack([d[:min_len] for d in parts]))
+    else:
+        n_cols = parts[0].shape[1]
+        res = np.hstack([d[:min_len] for d in parts]).reshape(
+            len(parts) * min_len, n_cols
+        )
+    tails = [d[min_len:] for d in parts if len(d) > min_len]
+    if tails:
+        res = np.concatenate([res] + tails, axis=0)
+    return res
+
+
+def qid_sort_order(qid) -> Optional[np.ndarray]:
+    """Stable order making query groups contiguous, or None if already sorted
+    (``matrix.py:70-102`` semantics)."""
+    order = np.argsort(np.asarray(qid), kind="stable")
+    if np.all(order == np.arange(len(order))):
+        return None
+    return order
+
+
+def ensure_sorted_by_qid(df: pd.DataFrame, qid) -> Tuple[pd.DataFrame, Any]:
+    """Stable-sort rows so query groups are contiguous (``matrix.py:70-102``)."""
+    order = qid_sort_order(qid)
+    if order is None:
+        return df, qid
+    qid_sorted = qid.iloc[order] if isinstance(qid, pd.Series) else np.asarray(qid)[order]
+    return df.iloc[order], qid_sorted
+
+
+class _RayDMatrixLoader:
+    """Shared loader logic: source resolution, dataframe splitting."""
+
+    def __init__(
+        self,
+        data: Data,
+        label: Optional[Data] = None,
+        weight: Optional[Data] = None,
+        feature_weights: Optional[Data] = None,
+        base_margin: Optional[Data] = None,
+        missing: Optional[float] = None,
+        label_lower_bound: Optional[Data] = None,
+        label_upper_bound: Optional[Data] = None,
+        feature_names: Optional[List[str]] = None,
+        feature_types: Optional[List[Any]] = None,
+        qid: Optional[Data] = None,
+        filetype: Optional[RayFileType] = None,
+        ignore: Optional[List[str]] = None,
+        **kwargs,
+    ):
+        self.data = data
+        self.label = label
+        self.weight = weight
+        self.feature_weights = feature_weights
+        self.base_margin = base_margin
+        self.missing = missing
+        self.label_lower_bound = label_lower_bound
+        self.label_upper_bound = label_upper_bound
+        self.feature_names = feature_names
+        self.feature_types = feature_types
+        self.qid = qid
+        self.filetype = filetype
+        self.ignore = ignore
+        self.kwargs = kwargs
+        self.data_source: Optional[type] = None
+        self.actor_shards: Optional[Dict[int, List[Any]]] = None
+        self._resolved_feature_names: Optional[List[str]] = None
+
+    def get_data_source(self) -> type:
+        if self.data_source is not None:
+            return self.data_source
+        filetype = self.filetype
+        data = self.data
+        for source in data_sources:
+            if filetype is None and hasattr(source, "get_filetype"):
+                filetype = source.get_filetype(data) or filetype
+        for source in data_sources:
+            if source.is_data_type(data, filetype):
+                self.data_source = source
+                self.filetype = filetype
+                return source
+        raise ValueError(
+            f"Unable to infer data source for data of type {type(data)}. "
+            f"Pass a supported data type (numpy array, pandas frame, "
+            f"csv/parquet path(s), partition list) or specify `filetype`."
+        )
+
+    def _split_dataframe(self, df: pd.DataFrame) -> Dict[str, Optional[np.ndarray]]:
+        """Extract label/weight/etc. columns; convert features to float32.
+
+        Semantics of ``matrix.py:283-358``: string references select (and
+        exclude) columns of the frame, array-likes attach externally.
+        """
+        source = self.get_data_source()
+        exclude: List[str] = []
+
+        def pick(ref):
+            series, col = source.get_column(df, ref)
+            if col is not None:
+                exclude.append(col)
+            return series
+
+        label = pick(self.label)
+        weight = pick(self.weight)
+        base_margin = pick(self.base_margin)
+        ll = pick(self.label_lower_bound)
+        lu = pick(self.label_upper_bound)
+        qid = pick(self.qid)
+
+        x = df.drop(columns=[c for c in exclude if c in df.columns])
+        if self.ignore:
+            x = x.drop(columns=[c for c in self.ignore if c in x.columns])
+
+        if qid is not None:
+            order = qid_sort_order(qid)
+            if order is not None:
+                x = x.iloc[order]
+                qid = np.asarray(qid)[order]
+                label = None if label is None else np.asarray(label)[order]
+                weight = None if weight is None else np.asarray(weight)[order]
+                base_margin = None if base_margin is None else np.asarray(base_margin)[order]
+                ll = None if ll is None else np.asarray(ll)[order]
+                lu = None if lu is None else np.asarray(lu)[order]
+
+        self._resolved_feature_names = self.feature_names or [str(c) for c in x.columns]
+        feats = x.to_numpy(dtype=np.float32, copy=False)
+        if self.missing is not None and not np.isnan(self.missing):
+            feats = np.where(feats == np.float32(self.missing), np.nan, feats)
+
+        def arr(v, dtype=np.float32):
+            return None if v is None else np.asarray(v, dtype=dtype).ravel()
+
+        return {
+            "data": feats,
+            "label": arr(label),
+            "weight": arr(weight),
+            "base_margin": arr(base_margin),
+            "label_lower_bound": arr(ll),
+            "label_upper_bound": arr(lu),
+            "qid": None if qid is None else np.asarray(qid).ravel(),
+        }
+
+
+class _CentralRayDMatrixLoader(_RayDMatrixLoader):
+    """Driver loads the full dataset once, then row-shards per rank
+    (``matrix.py:431-487``)."""
+
+    def load_data(self, num_actors: int, sharding: RayShardingMode):
+        source = self.get_data_source()
+        df = source.load_data(self.data, ignore=self.ignore, **self.kwargs)
+        df = source.update_feature_names(df, None)
+        fields = self._split_dataframe(df)
+        n = fields["data"].shape[0]
+        if num_actors > n:
+            raise RuntimeError(
+                f"Trying to shard data for {num_actors} actors, but the "
+                f"dataset has only {n} rows. Use fewer actors."
+            )
+        refs: Dict[int, Dict[str, Optional[np.ndarray]]] = {}
+        for rank in range(num_actors):
+            idx = _get_sharding_indices(sharding, rank, num_actors, n)
+            refs[rank] = {
+                k: (v[idx] if v is not None else None) for k, v in fields.items()
+            }
+        return refs, n
+
+
+class _DistributedRayDMatrixLoader(_RayDMatrixLoader):
+    """Each rank loads only its own files/partitions (``matrix.py:614-693``)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        # with per-rank loading, external arrays cannot be aligned to shard
+        # rows — only column-name references work (reference matrix.py:533-538)
+        for field in ("label", "weight", "base_margin", "label_lower_bound",
+                      "label_upper_bound", "qid"):
+            val = getattr(self, field)
+            if val is not None and not isinstance(val, str):
+                raise ValueError(
+                    f"Distributed data loading only works with column names "
+                    f"for `{field}`, got {type(val)}. Pass the name of the "
+                    f"column in your data files, or use central loading "
+                    f"(`distributed=False`)."
+                )
+
+    def _expand(self) -> Any:
+        data = self.data
+        if isinstance(data, str) and os.path.isdir(data):
+            files = sorted(
+                glob.glob(os.path.join(data, "**", "*"), recursive=True)
+            )
+            files = [f for f in files if os.path.isfile(f)]
+            return files
+        if isinstance(data, str):
+            hits = sorted(glob.glob(data))
+            if len(hits) > 1:
+                return hits
+        return data
+
+    def load_shard(self, rank: int, num_actors: int, sharding: RayShardingMode):
+        source = self.get_data_source()
+        data = self._expand()
+        if self.actor_shards is not None:  # FIXED: pre-assigned partitions
+            indices = self.actor_shards.get(rank, [])
+            df = source.load_data(
+                data, ignore=self.ignore, indices=indices, **self.kwargs
+            )
+        else:
+            n_parts = source.get_n(data)
+            if num_actors > n_parts:
+                raise RuntimeError(
+                    f"Trying to shard {n_parts} files/partitions across "
+                    f"{num_actors} actors: use fewer actors or central loading."
+                )
+            indices = _get_sharding_indices(sharding, rank, num_actors, n_parts)
+            df = source.load_data(
+                data, ignore=self.ignore, indices=indices, **self.kwargs
+            )
+        df = source.update_feature_names(df, None)
+        return self._split_dataframe(df)
+
+    def assign_shards(self, num_actors: int):
+        """FIXED sharding: locality-aware partition assignment
+        (``matrix.py:595-612`` + ``_distributed.py:24-112``)."""
+        data = self._expand()
+        source = self.get_data_source()
+        n_parts = source.get_n(data)
+        hosts = get_actor_rank_hosts(num_actors)
+        host_to_parts = {"localhost": list(range(n_parts))}
+        self.actor_shards = assign_partitions_to_actors(host_to_parts, hosts)
+
+
+class RayDMatrix:
+    """Distributed data handle (API of ``xgboost_ray/matrix.py:697-968``).
+
+    Lazy by default: pass ``num_actors`` to load eagerly, or the ``train()``/
+    ``predict()`` functions will trigger loading with their actor count.
+    """
+
+    def __init__(
+        self,
+        data: Data,
+        label: Optional[Data] = None,
+        weight: Optional[Data] = None,
+        feature_weights: Optional[Data] = None,
+        base_margin: Optional[Data] = None,
+        missing: Optional[float] = None,
+        label_lower_bound: Optional[Data] = None,
+        label_upper_bound: Optional[Data] = None,
+        feature_names: Optional[List[str]] = None,
+        feature_types: Optional[List[Any]] = None,
+        qid: Optional[Data] = None,
+        enable_categorical: Optional[bool] = None,
+        num_actors: Optional[int] = None,
+        filetype: Optional[RayFileType] = None,
+        ignore: Optional[List[str]] = None,
+        distributed: Optional[bool] = None,
+        sharding: RayShardingMode = RayShardingMode.INTERLEAVED,
+        lazy: bool = False,
+        **kwargs,
+    ):
+        if kwargs.get("group", None) is not None:
+            raise ValueError(
+                "`group` parameter is not supported; use `qid` instead."
+            )
+        if qid is not None and weight is not None:
+            raise NotImplementedError("per-group weight is not implemented.")
+        if enable_categorical:
+            raise NotImplementedError(
+                "categorical features are not supported by tpu_hist yet."
+            )
+        kwargs.pop("group", None)
+
+        self._uid = uuid.uuid4().int
+        self.feature_names = feature_names
+        self.feature_types = feature_types
+        self.missing = missing
+        self.num_actors = num_actors
+        self.sharding = sharding
+
+        if distributed is None:
+            distributed = self._can_load_distributed(data)
+        elif distributed and not self._can_load_distributed(data):
+            raise ValueError(
+                f"Distributed loading is not supported for data of type "
+                f"{type(data)}; pass file paths or partition lists."
+            )
+        self.distributed = distributed
+
+        loader_cls = _DistributedRayDMatrixLoader if distributed else _CentralRayDMatrixLoader
+        self.loader = loader_cls(
+            data=data,
+            label=label,
+            weight=weight,
+            feature_weights=feature_weights,
+            base_margin=base_margin,
+            missing=missing,
+            label_lower_bound=label_lower_bound,
+            label_upper_bound=label_upper_bound,
+            feature_names=feature_names,
+            feature_types=feature_types,
+            qid=qid,
+            filetype=filetype,
+            ignore=ignore,
+            **kwargs,
+        )
+
+        self.refs: Dict[int, Dict[str, Optional[np.ndarray]]] = {}
+        self.n: Optional[int] = None
+        self.loaded = False
+
+        if num_actors is not None and not lazy:
+            self.load_data(num_actors)
+
+    @staticmethod
+    def _can_load_distributed(data: Data) -> bool:
+        if isinstance(data, str):
+            return data.endswith((".csv", ".csv.gz", ".parquet")) or os.path.isdir(data)
+        if isinstance(data, (list, tuple)) and data and isinstance(data[0], str):
+            return True
+        if isinstance(data, (list, tuple)) and data:
+            return True  # partition list
+        if hasattr(data, "__partitioned__"):
+            return True
+        return False
+
+    # -- loading -----------------------------------------------------------
+
+    def load_data(self, num_actors: Optional[int] = None):
+        if num_actors is not None:
+            if self.num_actors is not None and self.num_actors != num_actors:
+                raise ValueError(
+                    f"The number of actors of a RayDMatrix cannot change once "
+                    f"set ({self.num_actors} -> {num_actors})."
+                )
+            self.num_actors = num_actors
+        if self.num_actors is None:
+            raise ValueError("Pass `num_actors` to load a RayDMatrix.")
+        if self.loaded:
+            return
+        if isinstance(self.loader, _CentralRayDMatrixLoader):
+            self.refs, self.n = self.loader.load_data(self.num_actors, self.sharding)
+            self.loaded = True
+        else:
+            # distributed: shards materialize per rank in get_data
+            self.loaded = True
+
+    def get_data(
+        self, rank: int, num_actors: Optional[int] = None
+    ) -> Dict[str, Optional[np.ndarray]]:
+        self.load_data(num_actors)
+        if rank not in self.refs:
+            if isinstance(self.loader, _DistributedRayDMatrixLoader):
+                self.refs[rank] = self.loader.load_shard(
+                    rank, self.num_actors, self.sharding
+                )
+            else:
+                raise KeyError(f"No shard for rank {rank}")
+        return self.refs[rank]
+
+    def unload_data(self):
+        self.refs = {}
+        self.loaded = False
+
+    def assign_shards_to_actors(self, actors: Sequence[Any]) -> bool:
+        """FIXED-mode locality assignment before training (``matrix.py:595-612``)."""
+        if self.sharding != RayShardingMode.FIXED:
+            return False
+        if not isinstance(self.loader, _DistributedRayDMatrixLoader):
+            return False
+        if self.loader.actor_shards is None:
+            self.loader.assign_shards(self.num_actors or len(actors))
+        return True
+
+    # -- introspection -----------------------------------------------------
+
+    def get_shard_sizes(self) -> Dict[int, int]:
+        return {r: (s["data"].shape[0] if s["data"] is not None else 0)
+                for r, s in self.refs.items()}
+
+    @property
+    def resolved_feature_names(self) -> Optional[List[str]]:
+        return self.feature_names or self.loader._resolved_feature_names
+
+    @property
+    def has_label(self) -> bool:
+        return self.loader.label is not None
+
+    def __hash__(self):
+        return self._uid
+
+    def __eq__(self, other):
+        return isinstance(other, RayDMatrix) and self._uid == other._uid
+
+
+class RayQuantileDMatrix(RayDMatrix):
+    """Alias of RayDMatrix: all tpu_hist matrices are quantile-binned on
+    device (the reference's distinction, ``matrix.py:971-975``, is a CUDA
+    memory optimization that is the default here)."""
+
+
+class RayDeviceQuantileDMatrix(RayDMatrix):
+    """Accepted for API compatibility (``matrix.py:977-1033``); on TPU every
+    matrix is already an HBM-resident quantile-binned block, so this behaves
+    exactly like RayDMatrix."""
+
+    def __init__(self, *args, max_bin: Optional[int] = None, **kwargs):
+        self.max_bin = max_bin
+        super().__init__(*args, **kwargs)

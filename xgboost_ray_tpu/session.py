@@ -1,0 +1,63 @@
+"""Per-worker session context for user callbacks.
+
+API mirror of ``xgboost_ray/session.py``: code running inside training
+callbacks can query its actor rank and push telemetry to the driver queue
+(drained into ``additional_results["callback_returns"]``,
+``xgboost_ray/main.py:902-922``).
+"""
+
+from typing import Any, Optional
+
+
+class RayXGBoostSession:
+    def __init__(self, rank: int, queue: Optional[Any] = None):
+        self._rank = rank
+        self._queue = queue
+
+    def get_actor_rank(self) -> int:
+        return self._rank
+
+    def get_rabit_rank(self) -> int:
+        # ranks coincide in the mesh runtime (no separate rabit world)
+        return self._rank
+
+    def put_queue(self, item: Any):
+        if self._queue is not None:
+            self._queue.put((self._rank, item))
+
+    def set_queue(self, queue: Any):
+        self._queue = queue
+
+
+_session: Optional[RayXGBoostSession] = None
+
+
+def init_session(rank: int = 0, queue: Optional[Any] = None):
+    global _session
+    _session = RayXGBoostSession(rank, queue)
+
+
+def get_session() -> RayXGBoostSession:
+    if _session is None:
+        raise ValueError(
+            "`get_session()` was called outside an initialized session. "
+            "Only call this from within xgboost_ray_tpu training callbacks."
+        )
+    return _session
+
+
+def set_session_queue(queue: Any):
+    get_session().set_queue(queue)
+
+
+def get_actor_rank() -> int:
+    return get_session().get_actor_rank()
+
+
+def get_rabit_rank() -> int:
+    return get_session().get_rabit_rank()
+
+
+def put_queue(item: Any):
+    """Put a queue item from a training callback onto the driver queue."""
+    get_session().put_queue(item)

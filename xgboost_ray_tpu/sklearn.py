@@ -1,0 +1,638 @@
+"""scikit-learn estimator facade.
+
+API mirror of ``xgboost_ray/sklearn.py``: the five estimators
+(RayXGBClassifier/Regressor/Ranker and the random-forest variants) expose the
+xgboost sklearn surface (fit/predict/predict_proba, eval_set, early stopping,
+clone/get_params compatibility) and route everything through our
+``train()``/``predict()`` with RayDMatrix — the same delegation pattern the
+reference uses via ``_wrap_evaluation_matrices`` (``sklearn.py:503-505``).
+
+RF note: parallel trees within a round are *averaged* (see
+``ops/predict.predict_margin``), giving classic random-forest semantics for
+``num_parallel_tree > 1`` with a single boosting round.
+"""
+
+import logging
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from xgboost_ray_tpu.main import RayParams, predict as ray_predict, train as ray_train
+from xgboost_ray_tpu.matrix import RayDMatrix, RayShardingMode
+from xgboost_ray_tpu.models.booster import RayXGBoostBooster
+
+logger = logging.getLogger(__name__)
+
+_SKLEARN_INSTALLED = True
+try:
+    from sklearn.base import BaseEstimator, ClassifierMixin, RegressorMixin
+except ImportError:  # pragma: no cover
+    _SKLEARN_INSTALLED = False
+    BaseEstimator = object
+    ClassifierMixin = object
+    RegressorMixin = object
+
+
+_PARAM_NAMES = [
+    "n_estimators",
+    "max_depth",
+    "learning_rate",
+    "verbosity",
+    "objective",
+    "booster",
+    "tree_method",
+    "n_jobs",
+    "gamma",
+    "min_child_weight",
+    "max_delta_step",
+    "subsample",
+    "colsample_bytree",
+    "colsample_bylevel",
+    "colsample_bynode",
+    "reg_alpha",
+    "reg_lambda",
+    "scale_pos_weight",
+    "base_score",
+    "random_state",
+    "missing",
+    "num_parallel_tree",
+    "max_bin",
+    "eval_metric",
+    "early_stopping_rounds",
+]
+
+
+def _check_if_params_are_ray_dmatrix(X, sample_weight, base_margin, eval_set,
+                                     sample_weight_eval_set, base_margin_eval_set):
+    """RayDMatrix passthrough with warnings (mirror ``sklearn.py:280-334``)."""
+    train_dmatrix = None
+    evals = ()
+    if isinstance(X, RayDMatrix):
+        params_to_warn = []
+        if sample_weight is not None:
+            params_to_warn.append("sample_weight")
+        if base_margin is not None:
+            params_to_warn.append("base_margin")
+        if params_to_warn:
+            warnings.warn(
+                f"X is a RayDMatrix; {params_to_warn} will be ignored "
+                f"(set them on the RayDMatrix instead)."
+            )
+        train_dmatrix = X
+        if not X.has_label:
+            raise ValueError(
+                "X is a RayDMatrix without a label; pass the label to the "
+                "RayDMatrix constructor."
+            )
+        if eval_set:
+            if any(not isinstance(e[0], RayDMatrix) for e in eval_set):
+                raise ValueError(
+                    "If X is a RayDMatrix, all eval_set entries must be "
+                    "(RayDMatrix, name) tuples."
+                )
+            evals = [
+                (e[0], e[1] if len(e) > 1 and isinstance(e[1], str) else f"validation_{i}")
+                for i, e in enumerate(eval_set)
+            ]
+    return train_dmatrix, evals
+
+
+class RayXGBMixin:
+    """Shared plumbing for all estimators."""
+
+    def _get_ray_params(self, ray_params) -> RayParams:
+        if isinstance(ray_params, dict):
+            ray_params = RayParams(**ray_params)
+        if ray_params is None:
+            n_jobs = getattr(self, "n_jobs", None) or 1
+            ray_params = RayParams(num_actors=int(n_jobs))
+        return ray_params
+
+    def get_xgb_params(self) -> Dict[str, Any]:
+        params = {}
+        for name in _PARAM_NAMES:
+            if name in ("n_estimators", "early_stopping_rounds", "eval_metric",
+                        "missing", "n_jobs", "verbosity", "booster",
+                        "colsample_bynode"):
+                continue
+            val = getattr(self, name, None)
+            if val is not None:
+                params[name] = val
+        # colsample_bynode has no direct tpu_hist analog; approximate with
+        # per-level sampling so RF variants still decorrelate trees
+        bynode = getattr(self, "colsample_bynode", None)
+        if bynode is not None and getattr(self, "colsample_bylevel", None) is None:
+            params["colsample_bylevel"] = bynode
+        if getattr(self, "eval_metric", None) is not None:
+            params["eval_metric"] = self.eval_metric
+        if getattr(self, "random_state", None) is not None:
+            params["seed"] = self.random_state
+        return params
+
+    def _num_boost_round(self) -> int:
+        return int(getattr(self, "n_estimators", None) or 100)
+
+    def _build_matrices(
+        self,
+        X,
+        y,
+        *,
+        sample_weight=None,
+        base_margin=None,
+        qid=None,
+        eval_set=None,
+        sample_weight_eval_set=None,
+        base_margin_eval_set=None,
+        eval_qid=None,
+        feature_weights=None,
+        ray_dmatrix_params=None,
+    ):
+        dm_params = dict(ray_dmatrix_params or {})
+        missing = getattr(self, "missing", None)
+        if missing is not None and not (isinstance(missing, float) and np.isnan(missing)):
+            dm_params.setdefault("missing", missing)
+        train_dmatrix = RayDMatrix(
+            X, label=y, weight=sample_weight, base_margin=base_margin,
+            qid=qid, feature_weights=feature_weights, **dm_params,
+        )
+        evals = []
+        if eval_set:
+            for i, (ex, ey) in enumerate(eval_set):
+                w = sample_weight_eval_set[i] if sample_weight_eval_set else None
+                bm = base_margin_eval_set[i] if base_margin_eval_set else None
+                q = eval_qid[i] if eval_qid else None
+                if ex is X and ey is y and w is None and bm is None and q is None:
+                    evals.append((train_dmatrix, f"validation_{i}"))
+                else:
+                    evals.append(
+                        (
+                            RayDMatrix(ex, label=ey, weight=w, base_margin=bm,
+                                       qid=q, **dm_params),
+                            f"validation_{i}",
+                        )
+                    )
+        return train_dmatrix, evals
+
+    def _fit_common(
+        self,
+        params: Dict[str, Any],
+        train_dmatrix: RayDMatrix,
+        evals: List[Tuple[RayDMatrix, str]],
+        *,
+        verbose=True,
+        xgb_model=None,
+        callbacks=None,
+        early_stopping_rounds=None,
+        ray_params=None,
+        _remote=None,
+        num_boost_round=None,
+    ):
+        evals_result: Dict = {}
+        additional_results: Dict = {}
+        extra = {}
+        obj = None
+        if callable(params.get("objective")):
+            obj = params.pop("objective")
+            params["objective"] = "reg:squarederror"
+        if obj is not None:
+            extra["obj"] = obj
+        esr = early_stopping_rounds
+        if esr is None:
+            esr = getattr(self, "early_stopping_rounds", None)
+        if esr is not None:
+            extra["early_stopping_rounds"] = esr
+        booster = ray_train(
+            params,
+            train_dmatrix,
+            num_boost_round=num_boost_round or self._num_boost_round(),
+            evals=evals,
+            evals_result=evals_result,
+            additional_results=additional_results,
+            ray_params=self._get_ray_params(ray_params),
+            _remote=_remote,
+            verbose_eval=verbose,
+            xgb_model=xgb_model,
+            callbacks=callbacks,
+            **extra,
+        )
+        self._Booster = booster
+        self.evals_result_ = evals_result
+        self.additional_results_ = additional_results
+        if booster.best_iteration is not None:
+            self.best_iteration = booster.best_iteration
+            self.best_score = booster.best_score
+        self.n_features_in_ = booster.num_features
+        return self
+
+    def get_booster(self) -> RayXGBoostBooster:
+        if not hasattr(self, "_Booster") or self._Booster is None:
+            raise ValueError("need to call fit or load_model beforehand")
+        return self._Booster
+
+    def evals_result(self) -> Dict:
+        return getattr(self, "evals_result_", {})
+
+    def _ray_predict_margin_or_value(
+        self,
+        X,
+        output_margin=False,
+        ntree_limit=None,
+        validate_features=True,
+        base_margin=None,
+        iteration_range=None,
+        ray_params=None,
+        _remote=None,
+        ray_dmatrix_params=None,
+    ) -> np.ndarray:
+        """Route through the distributed predict (mirror ``sklearn.py:357-390``)."""
+        booster = self.get_booster()
+        kwargs = dict(
+            output_margin=output_margin,
+            validate_features=validate_features,
+        )
+        if ntree_limit:
+            kwargs["ntree_limit"] = ntree_limit
+        if iteration_range is not None:
+            kwargs["iteration_range"] = iteration_range
+        if isinstance(X, RayDMatrix):
+            data = X
+        else:
+            dm_params = dict(ray_dmatrix_params or {})
+            data = RayDMatrix(X, base_margin=base_margin, **dm_params)
+        return ray_predict(
+            booster, data, ray_params=self._get_ray_params(ray_params),
+            _remote=_remote, **kwargs,
+        )
+
+    @property
+    def feature_importances_(self) -> np.ndarray:
+        """Split-count ("weight") importance, normalized."""
+        booster = self.get_booster()
+        feat = booster.forest.feature
+        leaf = booster.forest.is_leaf
+        used = feat[(feat >= 0) & (~leaf)]
+        counts = np.bincount(used, minlength=booster.num_features).astype(np.float64)
+        total = counts.sum()
+        return (counts / total) if total > 0 else counts
+
+    def save_model(self, fname: str):
+        self.get_booster().save_model(fname)
+
+    def load_model(self, fname: str):
+        self._Booster = RayXGBoostBooster.load_model(fname)
+        return self
+
+
+class _RayXGBEstimator(BaseEstimator, RayXGBMixin):
+    _default_objective = "reg:squarederror"
+
+    def __init__(
+        self,
+        n_estimators: int = 100,
+        max_depth: Optional[int] = None,
+        learning_rate: Optional[float] = None,
+        verbosity: Optional[int] = None,
+        objective: Optional[Union[str, Callable]] = None,
+        booster: Optional[str] = None,
+        tree_method: Optional[str] = None,
+        n_jobs: Optional[int] = None,
+        gamma: Optional[float] = None,
+        min_child_weight: Optional[float] = None,
+        max_delta_step: Optional[float] = None,
+        subsample: Optional[float] = None,
+        colsample_bytree: Optional[float] = None,
+        colsample_bylevel: Optional[float] = None,
+        colsample_bynode: Optional[float] = None,
+        reg_alpha: Optional[float] = None,
+        reg_lambda: Optional[float] = None,
+        scale_pos_weight: Optional[float] = None,
+        base_score: Optional[float] = None,
+        random_state: Optional[int] = None,
+        missing: float = np.nan,
+        num_parallel_tree: Optional[int] = None,
+        max_bin: Optional[int] = None,
+        eval_metric: Optional[Union[str, List[str]]] = None,
+        early_stopping_rounds: Optional[int] = None,
+        **kwargs,
+    ):
+        self.n_estimators = n_estimators
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.verbosity = verbosity
+        self.objective = objective
+        self.booster = booster
+        self.tree_method = tree_method
+        self.n_jobs = n_jobs
+        self.gamma = gamma
+        self.min_child_weight = min_child_weight
+        self.max_delta_step = max_delta_step
+        self.subsample = subsample
+        self.colsample_bytree = colsample_bytree
+        self.colsample_bylevel = colsample_bylevel
+        self.colsample_bynode = colsample_bynode
+        self.reg_alpha = reg_alpha
+        self.reg_lambda = reg_lambda
+        self.scale_pos_weight = scale_pos_weight
+        self.base_score = base_score
+        self.random_state = random_state
+        self.missing = missing
+        self.num_parallel_tree = num_parallel_tree
+        self.max_bin = max_bin
+        self.eval_metric = eval_metric
+        self.early_stopping_rounds = early_stopping_rounds
+        for key, value in kwargs.items():
+            setattr(self, key, value)
+
+    def _more_tags(self):
+        return {"non_deterministic": False, "allow_nan": True}
+
+    def fit(
+        self,
+        X,
+        y=None,
+        *,
+        sample_weight=None,
+        base_margin=None,
+        eval_set=None,
+        sample_weight_eval_set=None,
+        base_margin_eval_set=None,
+        verbose=False,
+        xgb_model=None,
+        feature_weights=None,
+        callbacks=None,
+        early_stopping_rounds=None,
+        ray_params=None,
+        _remote=None,
+        ray_dmatrix_params=None,
+    ):
+        params = self.get_xgb_params()
+        params.setdefault("objective", self._default_objective)
+        dm, evals = _check_if_params_are_ray_dmatrix(
+            X, sample_weight, base_margin, eval_set,
+            sample_weight_eval_set, base_margin_eval_set,
+        )
+        if dm is None:
+            dm, evals = self._build_matrices(
+                X, y, sample_weight=sample_weight, base_margin=base_margin,
+                eval_set=eval_set,
+                sample_weight_eval_set=sample_weight_eval_set,
+                base_margin_eval_set=base_margin_eval_set,
+                feature_weights=feature_weights,
+                ray_dmatrix_params=ray_dmatrix_params,
+            )
+        return self._fit_common(
+            params, dm, list(evals), verbose=verbose, xgb_model=xgb_model,
+            callbacks=callbacks, early_stopping_rounds=early_stopping_rounds,
+            ray_params=ray_params, _remote=_remote,
+        )
+
+    def predict(
+        self,
+        X,
+        output_margin=False,
+        ntree_limit=None,
+        validate_features=True,
+        base_margin=None,
+        iteration_range=None,
+        ray_params=None,
+        _remote=None,
+        ray_dmatrix_params=None,
+    ):
+        return self._ray_predict_margin_or_value(
+            X, output_margin=output_margin, ntree_limit=ntree_limit,
+            validate_features=validate_features, base_margin=base_margin,
+            iteration_range=iteration_range, ray_params=ray_params,
+            _remote=_remote, ray_dmatrix_params=ray_dmatrix_params,
+        )
+
+
+class RayXGBRegressor(_RayXGBEstimator, RegressorMixin):
+    """Distributed XGBoost-style regressor (mirror ``sklearn.py:602-644``)."""
+
+    _default_objective = "reg:squarederror"
+
+
+class RayXGBClassifier(_RayXGBEstimator, ClassifierMixin):
+    """Distributed XGBoost-style classifier (mirror ``sklearn.py:451-600``)."""
+
+    _default_objective = "binary:logistic"
+
+    def fit(
+        self,
+        X,
+        y=None,
+        *,
+        sample_weight=None,
+        base_margin=None,
+        eval_set=None,
+        sample_weight_eval_set=None,
+        base_margin_eval_set=None,
+        verbose=False,
+        xgb_model=None,
+        feature_weights=None,
+        callbacks=None,
+        early_stopping_rounds=None,
+        ray_params=None,
+        _remote=None,
+        ray_dmatrix_params=None,
+    ):
+        params = self.get_xgb_params()
+        dm, evals = _check_if_params_are_ray_dmatrix(
+            X, sample_weight, base_margin, eval_set,
+            sample_weight_eval_set, base_margin_eval_set,
+        )
+        if dm is not None:
+            num_class = params.get("num_class", 0)
+            self.classes_ = np.arange(max(2, num_class))
+            self.n_classes_ = max(2, int(num_class))
+            y_enc = None
+        else:
+            y_arr = np.asarray(y)
+            self.classes_ = np.unique(y_arr)
+            self.n_classes_ = len(self.classes_)
+            class_to_idx = {c: i for i, c in enumerate(self.classes_)}
+            y_enc = np.asarray([class_to_idx[v] for v in y_arr], dtype=np.float32)
+
+        if self.n_classes_ > 2:
+            params.setdefault("objective", "multi:softprob")
+            if params["objective"].startswith("multi"):
+                params["num_class"] = self.n_classes_
+        else:
+            params.setdefault("objective", self._default_objective)
+
+        if dm is None:
+            enc_eval_set = None
+            if eval_set:
+                class_to_idx = {c: i for i, c in enumerate(self.classes_)}
+                enc_eval_set = [
+                    (ex, np.asarray([class_to_idx[v] for v in np.asarray(ey)],
+                                    dtype=np.float32))
+                    for ex, ey in eval_set
+                ]
+            dm, evals = self._build_matrices(
+                X, y_enc, sample_weight=sample_weight, base_margin=base_margin,
+                eval_set=enc_eval_set,
+                sample_weight_eval_set=sample_weight_eval_set,
+                base_margin_eval_set=base_margin_eval_set,
+                feature_weights=feature_weights,
+                ray_dmatrix_params=ray_dmatrix_params,
+            )
+        return self._fit_common(
+            params, dm, list(evals), verbose=verbose, xgb_model=xgb_model,
+            callbacks=callbacks, early_stopping_rounds=early_stopping_rounds,
+            ray_params=ray_params, _remote=_remote,
+        )
+
+    def predict_proba(
+        self,
+        X,
+        ntree_limit=None,
+        validate_features=True,
+        base_margin=None,
+        iteration_range=None,
+        ray_params=None,
+        _remote=None,
+        ray_dmatrix_params=None,
+    ) -> np.ndarray:
+        raw = self._ray_predict_margin_or_value(
+            X, output_margin=False, ntree_limit=ntree_limit,
+            validate_features=validate_features, base_margin=base_margin,
+            iteration_range=iteration_range, ray_params=ray_params,
+            _remote=_remote, ray_dmatrix_params=ray_dmatrix_params,
+        )
+        raw = np.asarray(raw)
+        if raw.ndim == 2:
+            return raw
+        return np.stack([1.0 - raw, raw], axis=1)
+
+    def predict(
+        self,
+        X,
+        output_margin=False,
+        ntree_limit=None,
+        validate_features=True,
+        base_margin=None,
+        iteration_range=None,
+        ray_params=None,
+        _remote=None,
+        ray_dmatrix_params=None,
+    ):
+        raw = self._ray_predict_margin_or_value(
+            X, output_margin=output_margin, ntree_limit=ntree_limit,
+            validate_features=validate_features, base_margin=base_margin,
+            iteration_range=iteration_range, ray_params=ray_params,
+            _remote=_remote, ray_dmatrix_params=ray_dmatrix_params,
+        )
+        if output_margin:
+            return raw
+        raw = np.asarray(raw)
+        if raw.ndim == 2:
+            idx = raw.argmax(axis=1)
+        else:
+            booster = self.get_booster()
+            if booster.params.objective == "multi:softmax":
+                idx = raw.astype(int)
+            else:
+                idx = (raw > 0.5).astype(int)
+        classes = getattr(self, "classes_", None)
+        if classes is None:
+            return idx
+        return np.asarray(classes)[idx]
+
+
+class RayXGBRFRegressor(RayXGBRegressor):
+    """Random-forest variant (mirror ``sklearn.py:880-919``): one boosting
+    round of ``n_estimators`` parallel trees, lr=1, row/column subsampling."""
+
+    def __init__(self, *, learning_rate=1.0, subsample=0.8, colsample_bynode=0.8,
+                 reg_lambda=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, subsample=subsample,
+                         colsample_bynode=colsample_bynode,
+                         reg_lambda=reg_lambda, **kwargs)
+
+    def get_xgb_params(self):
+        params = super().get_xgb_params()
+        params["num_parallel_tree"] = self.n_estimators
+        return params
+
+    def _num_boost_round(self):
+        return 1
+
+
+class RayXGBRFClassifier(RayXGBClassifier):
+    """Random-forest classifier variant (mirror ``sklearn.py:631-637``)."""
+
+    def __init__(self, *, learning_rate=1.0, subsample=0.8, colsample_bynode=0.8,
+                 reg_lambda=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, subsample=subsample,
+                         colsample_bynode=colsample_bynode,
+                         reg_lambda=reg_lambda, **kwargs)
+
+    def get_xgb_params(self):
+        params = super().get_xgb_params()
+        params["num_parallel_tree"] = self.n_estimators
+        return params
+
+    def _num_boost_round(self):
+        return 1
+
+
+class RayXGBRanker(_RayXGBEstimator):
+    """Learning-to-rank estimator (mirror ``sklearn.py:921-1040``)."""
+
+    _default_objective = "rank:pairwise"
+
+    def fit(
+        self,
+        X,
+        y=None,
+        *,
+        qid=None,
+        sample_weight=None,
+        base_margin=None,
+        eval_set=None,
+        eval_qid=None,
+        sample_weight_eval_set=None,
+        base_margin_eval_set=None,
+        verbose=False,
+        xgb_model=None,
+        feature_weights=None,
+        callbacks=None,
+        early_stopping_rounds=None,
+        ray_params=None,
+        _remote=None,
+        ray_dmatrix_params=None,
+    ):
+        params = self.get_xgb_params()
+        params.setdefault("objective", self._default_objective)
+        if not params["objective"].startswith("rank:"):
+            raise ValueError(
+                "RayXGBRanker requires a rank:* objective, got "
+                f"{params['objective']!r}"
+            )
+        dm, evals = _check_if_params_are_ray_dmatrix(
+            X, sample_weight, base_margin, eval_set,
+            sample_weight_eval_set, base_margin_eval_set,
+        )
+        if dm is None:
+            if qid is None:
+                raise ValueError(
+                    "RayXGBRanker requires the `qid` argument (or a RayDMatrix "
+                    "constructed with qid)."
+                )
+            dm, evals = self._build_matrices(
+                X, y, sample_weight=sample_weight, base_margin=base_margin,
+                qid=qid, eval_set=eval_set, eval_qid=eval_qid,
+                sample_weight_eval_set=sample_weight_eval_set,
+                base_margin_eval_set=base_margin_eval_set,
+                feature_weights=feature_weights,
+                ray_dmatrix_params=ray_dmatrix_params,
+            )
+        elif dm.loader.qid is None:
+            raise ValueError("RayXGBRanker requires a RayDMatrix with qid.")
+        return self._fit_common(
+            params, dm, list(evals), verbose=verbose, xgb_model=xgb_model,
+            callbacks=callbacks, early_stopping_rounds=early_stopping_rounds,
+            ray_params=ray_params, _remote=_remote,
+        )

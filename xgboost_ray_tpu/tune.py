@@ -1,0 +1,211 @@
+"""Hyperparameter-tuning integration.
+
+Mirror of ``xgboost_ray/tune.py``: a report/checkpoint callback that is
+auto-injected when training runs inside a tuning session
+(``tune.py:27-104``), trial resource computation (``tune.py:107-126``), and a
+checkpoint-aware ``load_model`` (``tune.py:130-156``).
+
+Two backends:
+  * If ``ray.tune`` happens to be importable, its ``session.report`` is used.
+  * Otherwise a standalone session (``xgboost_ray_tpu.hpo``) provides the
+    same report/checkpoint surface, so HPO sweeps work on a bare TPU VM.
+"""
+
+import dataclasses
+import json
+import logging
+import os
+import tempfile
+from typing import Any, Dict, List, Optional
+
+from xgboost_ray_tpu.callback import TrainingCallback
+from xgboost_ray_tpu.models.booster import RayXGBoostBooster
+
+logger = logging.getLogger(__name__)
+
+try:  # pragma: no cover - not installed in the TPU image
+    from ray import tune as _ray_tune
+    from ray.tune.integration import xgboost as _  # noqa: F401
+
+    RAY_TUNE_INSTALLED = True
+except Exception:
+    _ray_tune = None
+    RAY_TUNE_INSTALLED = False
+
+
+# --- standalone tuning session ---------------------------------------------
+
+_session: Optional["TuneSession"] = None
+
+
+class TuneSession:
+    """Trial-side context collecting reported results and checkpoints."""
+
+    def __init__(self, trial_dir: Optional[str] = None):
+        self.trial_dir = trial_dir or tempfile.mkdtemp(prefix="rxgb_trial_")
+        self.results: List[Dict[str, Any]] = []
+        self.last_checkpoint_path: Optional[str] = None
+
+    def report(self, metrics: Dict[str, Any], checkpoint_path: Optional[str] = None):
+        self.results.append(dict(metrics))
+        if checkpoint_path:
+            self.last_checkpoint_path = checkpoint_path
+
+
+def init_session(trial_dir: Optional[str] = None) -> TuneSession:
+    global _session
+    _session = TuneSession(trial_dir)
+    return _session
+
+
+def shutdown_session():
+    global _session
+    _session = None
+
+
+def get_session() -> Optional[TuneSession]:
+    return _session
+
+
+def is_session_enabled() -> bool:
+    """Are we inside a tuning trial? (mirror of ``tune.py:61-64``)."""
+    if _session is not None:
+        return True
+    if RAY_TUNE_INSTALLED:  # pragma: no cover
+        try:
+            from ray.tune import is_session_enabled as _ise
+
+            return _ise()
+        except Exception:
+            return False
+    return False
+
+
+# --- report/checkpoint callback --------------------------------------------
+
+
+class TuneReportCheckpointCallback(TrainingCallback):
+    """Per-iteration metric report + periodic checkpoint to the trial dir.
+
+    Mirror of the reference's Tune callback (``tune.py:26-48``), which runs
+    its hooks on the driver. ``metrics`` maps reported names to eval-result
+    keys ("{set}-{metric}"); default reports every recorded metric.
+    """
+
+    def __init__(
+        self,
+        metrics: Optional[Any] = None,
+        filename: str = "checkpoint.json",
+        frequency: int = 5,
+    ):
+        if isinstance(metrics, str):
+            metrics = [metrics]
+        self._metrics = metrics
+        self._filename = filename
+        self._frequency = max(1, int(frequency))
+
+    @staticmethod
+    def _flatten(evals_log: Dict) -> Dict[str, float]:
+        flat = {}
+        for set_name, metric_dict in (evals_log or {}).items():
+            for metric_name, values in metric_dict.items():
+                if values:
+                    flat[f"{set_name}-{metric_name}"] = values[-1]
+        return flat
+
+    def after_iteration(self, model, epoch: int, evals_log: Dict) -> bool:
+        session = get_session()
+        if session is None:
+            return False
+        flat = self._flatten(evals_log)
+        if self._metrics is None:
+            report = dict(flat)
+        elif isinstance(self._metrics, dict):
+            report = {out: flat.get(src) for out, src in self._metrics.items()}
+        else:
+            report = {m: flat.get(m) for m in self._metrics}
+        report["training_iteration"] = epoch + 1
+
+        checkpoint_path = None
+        if (epoch + 1) % self._frequency == 0:
+            checkpoint_path = os.path.join(
+                session.trial_dir, f"checkpoint_{epoch + 1:06d}"
+            )
+            os.makedirs(checkpoint_path, exist_ok=True)
+            model.save_model(os.path.join(checkpoint_path, self._filename))
+        session.report(report, checkpoint_path=checkpoint_path)
+        return False
+
+
+# legacy alias (reference exports TuneReportCallback too)
+class TuneReportCallback(TuneReportCheckpointCallback):
+    def __init__(self, metrics: Optional[Any] = None):
+        super().__init__(metrics=metrics, frequency=1 << 30)
+
+
+def _try_add_tune_callback(callbacks: List) -> List:
+    """Inject/replace the tune callback inside a tuning session
+    (mirror of ``tune.py:60-104``)."""
+    if not is_session_enabled():
+        return callbacks
+    has = any(isinstance(cb, TuneReportCheckpointCallback) for cb in callbacks)
+    if not has:
+        callbacks = list(callbacks) + [TuneReportCheckpointCallback()]
+    return callbacks
+
+
+# --- trial resources --------------------------------------------------------
+
+
+@dataclasses.dataclass
+class PlacementGroupFactory:
+    """Standalone stand-in for Tune's PlacementGroupFactory: a head bundle
+    plus one bundle per actor, PACK strategy (mirror ``tune.py:107-126``)."""
+
+    bundles: List[Dict[str, float]]
+    strategy: str = "PACK"
+    # extra placement options (e.g. _max_cpu_fraction_per_node) carried
+    # through verbatim, matching ray.tune's permissive PlacementGroupFactory
+    options: dict = dataclasses.field(default_factory=dict)
+
+    def required_resources(self) -> Dict[str, float]:
+        total: Dict[str, float] = {}
+        for bundle in self.bundles:
+            for key, val in bundle.items():
+                total[key] = total.get(key, 0.0) + val
+        return total
+
+
+def _get_tune_resources(
+    num_actors: int,
+    cpus_per_actor: int,
+    gpus_per_actor: int,
+    tpus_per_actor: int,
+    resources_per_actor: Optional[Dict],
+    placement_options: Optional[Dict],
+) -> PlacementGroupFactory:
+    head = {"CPU": 1.0}
+    child: Dict[str, float] = {"CPU": float(cpus_per_actor)}
+    if gpus_per_actor:
+        child["GPU"] = float(gpus_per_actor)
+    if tpus_per_actor:
+        child["TPU"] = float(tpus_per_actor)
+    if resources_per_actor:
+        child.update({k: float(v) for k, v in resources_per_actor.items()})
+    options = dict(placement_options or {})
+    strategy = options.pop("strategy", "PACK")
+    return PlacementGroupFactory(
+        bundles=[head] + [dict(child) for _ in range(num_actors)],
+        strategy=strategy,
+        options=options,
+    )
+
+
+def load_model(model_path: str) -> RayXGBoostBooster:
+    """Load a model saved by the tune callback (mirror ``tune.py:130-156``)."""
+    if os.path.isdir(model_path):
+        for name in sorted(os.listdir(model_path)):
+            if name.endswith(".json"):
+                model_path = os.path.join(model_path, name)
+                break
+    return RayXGBoostBooster.load_model(model_path)

@@ -1,0 +1,65 @@
+"""Driver-side coordination primitives.
+
+The reference uses actor-wrapped asyncio primitives for the driver↔actor
+side-channel (``xgboost_ray/util.py:16-77``: Event actor, Queue actor,
+MultiActorTask). In the TPU runtime the coordinator and workers share a
+process (workers are mesh slots), so these become thin wrappers over
+``threading``/``queue`` with the same interface — preserved so user-facing
+semantics (stop events, callback queues) and the FT tests carry over.
+"""
+
+import queue
+import threading
+from typing import Any, Callable, List, Optional
+
+
+class Event:
+    """Mirror of the reference's Event actor API (``util.py:16-47``)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+
+    def set(self):
+        self._event.set()
+
+    def is_set(self) -> bool:
+        return self._event.is_set()
+
+    def clear(self):
+        self._event.clear()
+
+    def shutdown(self):
+        self._event.set()
+
+
+class Queue:
+    """Mirror of the Ray Queue actor the reference pins near the driver."""
+
+    def __init__(self):
+        self._q: "queue.Queue" = queue.Queue()
+
+    def put(self, item: Any):
+        self._q.put(item)
+
+    def empty(self) -> bool:
+        return self._q.empty()
+
+    def get(self, block: bool = False, timeout: Optional[float] = None) -> Any:
+        return self._q.get(block=block, timeout=timeout)
+
+    def shutdown(self):
+        while not self._q.empty():
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+
+
+class MultiActorTask:
+    """Readiness poll over a set of futures/callables (``util.py:52-77``)."""
+
+    def __init__(self, checks: Optional[List[Callable[[], bool]]] = None):
+        self._checks = checks or []
+
+    def is_ready(self) -> bool:
+        return all(check() for check in self._checks)
