@@ -1,0 +1,55 @@
+"""Generate partitioned test parquet data (parity with ``examples/create_test_data.py``)."""
+
+import argparse
+import os
+
+import numpy as np
+import pandas as pd
+from sklearn.datasets import make_classification
+
+
+def create_parquet(
+    filename: str,
+    num_rows: int = 1000,
+    num_features: int = 4,
+    num_classes: int = 2,
+    num_partitions: int = 1,
+):
+    x, y = make_classification(
+        n_samples=num_rows,
+        n_features=num_features,
+        n_informative=max(2, num_features - 2),
+        n_redundant=0,
+        n_classes=num_classes,
+        random_state=0,
+    )
+    df = pd.DataFrame(x.astype(np.float32), columns=[f"f{i}" for i in range(num_features)])
+    df["labels"] = y.astype(np.float32)
+    if num_partitions > 1:
+        df["partition"] = df.index % num_partitions
+        df.to_parquet(filename, partition_cols=["partition"])
+    else:
+        df.to_parquet(filename)
+    return filename
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("filename", type=str, nargs="?", default="parted.parquet")
+    parser.add_argument("--num-rows", type=int, default=1_000_000)
+    parser.add_argument("--num-features", type=int, default=8)
+    parser.add_argument("--num-classes", type=int, default=2)
+    parser.add_argument("--num-partitions", type=int, default=100)
+    args = parser.parse_args()
+    create_parquet(
+        args.filename,
+        num_rows=args.num_rows,
+        num_features=args.num_features,
+        num_classes=args.num_classes,
+        num_partitions=args.num_partitions,
+    )
+    print(f"Wrote {args.filename}")
+
+
+if __name__ == "__main__":
+    main()

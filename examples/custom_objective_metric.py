@@ -1,0 +1,43 @@
+"""Custom objective + custom eval metric (parity with the reference's custom
+objective coverage, ``xgboost_ray/tests/test_xgboost_api.py:77-150``)."""
+
+import numpy as np
+from sklearn.datasets import load_breast_cancer
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+
+def squared_log_obj(preds, dtrain):
+    labels = dtrain.get_label()
+    preds = np.maximum(preds, -1 + 1e-6)
+    grad = (np.log1p(preds) - np.log1p(labels)) / (preds + 1)
+    hess = np.maximum((-np.log1p(preds) + np.log1p(labels) + 1) / ((preds + 1) ** 2), 1e-6)
+    return grad, hess
+
+
+def rmsle_metric(preds, dtrain):
+    labels = dtrain.get_label()
+    preds = np.maximum(preds, -1 + 1e-6)
+    return "rmsle", float(np.sqrt(np.mean((np.log1p(preds) - np.log1p(labels)) ** 2)))
+
+
+def main():
+    data, labels = load_breast_cancer(return_X_y=True)
+    dtrain = RayDMatrix(data.astype(np.float32), labels.astype(np.float32))
+    evals_result = {}
+    train(
+        {"max_depth": 3, "eta": 0.1, "eval_metric": ["rmse"]},
+        dtrain,
+        num_boost_round=20,
+        evals=[(dtrain, "train")],
+        evals_result=evals_result,
+        obj=squared_log_obj,
+        feval=rmsle_metric,
+        verbose_eval=False,
+        ray_params=RayParams(num_actors=2),
+    )
+    print("Final rmsle: {:.4f}".format(evals_result["train"]["rmsle"][-1]))
+
+
+if __name__ == "__main__":
+    main()

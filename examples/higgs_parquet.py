@@ -1,0 +1,55 @@
+"""HIGGS from partitioned parquet with distributed loading (parity with
+``examples/higgs_parquet.py``)."""
+
+import argparse
+import glob
+import os
+import time
+
+import numpy as np
+import pandas as pd
+
+from xgboost_ray_tpu import RayDMatrix, RayFileType, RayParams, train
+from examples.higgs import make_synthetic
+
+
+def ensure_parquet_dir(path: str, n_files: int = 8):
+    if os.path.isdir(path) and glob.glob(os.path.join(path, "*.parquet")):
+        return
+    os.makedirs(path, exist_ok=True)
+    x, y = make_synthetic()
+    df = pd.DataFrame(x, columns=[f"feature-{i:02d}" for i in range(x.shape[1])])
+    df["label"] = y
+    rows_per = len(df) // n_files
+    for i in range(n_files):
+        df.iloc[i * rows_per : (i + 1) * rows_per].to_parquet(
+            os.path.join(path, f"higgs-{i:03d}.parquet")
+        )
+
+
+def main(path, num_actors):
+    ensure_parquet_dir(path)
+    dtrain = RayDMatrix(path, label="label", filetype=RayFileType.PARQUET)
+
+    config = {"tree_method": "hist", "eval_metric": ["logloss", "error"]}
+    evals_result = {}
+    start = time.time()
+    train(
+        config,
+        dtrain,
+        evals_result=evals_result,
+        ray_params=RayParams(max_actor_restarts=1, num_actors=num_actors),
+        num_boost_round=100,
+        evals=[(dtrain, "train")],
+        verbose_eval=False,
+    )
+    print(f"TRAIN TIME TAKEN: {time.time() - start:.2f} seconds")
+    print("Final training error: {:.4f}".format(evals_result["train"]["error"][-1]))
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("path", nargs="?", default="higgs_parquet")
+    parser.add_argument("--num-actors", type=int, default=8)
+    args = parser.parse_args()
+    main(args.path, args.num_actors)

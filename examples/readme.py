@@ -1,0 +1,36 @@
+"""The README quick-start (parity with ``examples/readme.py``):
+breast_cancer binary classification on 2 mesh workers."""
+
+import numpy as np
+from sklearn.datasets import load_breast_cancer
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+
+def main():
+    data = load_breast_cancer()
+    train_x = data.data.astype(np.float32)
+    train_y = data.target.astype(np.float32)
+
+    train_set = RayDMatrix(train_x, train_y)
+
+    evals_result = {}
+    bst = train(
+        {
+            "objective": "binary:logistic",
+            "eval_metric": ["logloss", "error"],
+        },
+        train_set,
+        num_boost_round=10,
+        evals_result=evals_result,
+        evals=[(train_set, "train")],
+        verbose_eval=False,
+        ray_params=RayParams(num_actors=2, cpus_per_actor=1),
+    )
+
+    bst.save_model("model.json")
+    print("Final training error: {:.4f}".format(evals_result["train"]["error"][-1]))
+
+
+if __name__ == "__main__":
+    main()

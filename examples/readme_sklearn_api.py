@@ -1,0 +1,33 @@
+"""README sklearn-API quick-start (parity with ``examples/readme_sklearn_api.py``)."""
+
+from sklearn.datasets import load_breast_cancer
+from sklearn.model_selection import train_test_split
+
+from xgboost_ray_tpu import RayParams
+from xgboost_ray_tpu.sklearn import RayXGBClassifier
+
+
+def main():
+    seed = 42
+    x, y = load_breast_cancer(return_X_y=True)
+    x_train, x_test, y_train, y_test = train_test_split(
+        x, y, train_size=0.25, random_state=42
+    )
+
+    clf = RayXGBClassifier(n_jobs=2, random_state=seed)
+    clf.fit(x_train, y_train)
+
+    pred_ray = clf.predict(x_test)
+    print(pred_ray[:10])
+
+    pred_proba_ray = clf.predict_proba(x_test)
+    print(pred_proba_ray[:5])
+
+    # also test with num_actors=1
+    clf = RayXGBClassifier(n_jobs=1, random_state=seed)
+    clf.fit(x_train, y_train)
+    print(clf.predict(x_test)[:10])
+
+
+if __name__ == "__main__":
+    main()

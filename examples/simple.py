@@ -1,0 +1,41 @@
+"""Train on a pandas dataframe with sharded rows (parity with ``examples/simple.py``)."""
+
+import argparse
+
+import numpy as np
+import pandas as pd
+from sklearn import datasets
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+
+def main(cpus_per_actor, num_actors):
+    # Load dataset
+    data, labels = datasets.load_breast_cancer(return_X_y=True)
+    df = pd.DataFrame(data)
+    df["label"] = labels
+
+    train_set = RayDMatrix(df, "label")
+
+    evals_result = {}
+    bst = train(
+        {"objective": "binary:logistic", "eval_metric": ["logloss", "error"]},
+        train_set,
+        evals_result=evals_result,
+        evals=[(train_set, "train")],
+        verbose_eval=False,
+        num_boost_round=10,
+        ray_params=RayParams(cpus_per_actor=cpus_per_actor, num_actors=num_actors),
+    )
+
+    model_path = "simple.json"
+    bst.save_model(model_path)
+    print("Final training error: {:.4f}".format(evals_result["train"]["error"][-1]))
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cpus-per-actor", type=int, default=1)
+    parser.add_argument("--num-actors", type=int, default=2)
+    args = parser.parse_args()
+    main(args.cpus_per_actor, args.num_actors)

@@ -1,0 +1,51 @@
+"""Train from a ``__partitioned__``-protocol frame (parity with
+``examples/simple_partitioned.py``)."""
+
+import numpy as np
+from sklearn import datasets
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+
+class PartitionedFrame:
+    """Minimal object implementing the __partitioned__ protocol."""
+
+    def __init__(self, arrays):
+        start = 0
+        parts = {}
+        for i, arr in enumerate(arrays):
+            parts[(i, 0)] = {"start": (start, 0), "shape": arr.shape, "data": arr}
+            start += arr.shape[0]
+        self.__partitioned__ = {
+            "shape": (start, arrays[0].shape[1]),
+            "partition_tiling": (len(arrays), 1),
+            "partitions": parts,
+            "get": lambda x: x,
+        }
+
+
+def main():
+    import pandas as pd
+
+    data, labels = datasets.load_breast_cancer(return_X_y=True)
+    df = pd.DataFrame(data, columns=[f"f{i}" for i in range(data.shape[1])])
+    df["label"] = labels
+    frames = [df.iloc[:200], df.iloc[200:400], df.iloc[400:]]
+    pf = PartitionedFrame(frames)
+
+    train_set = RayDMatrix(pf, "label")
+    evals_result = {}
+    train(
+        {"objective": "binary:logistic", "eval_metric": ["logloss", "error"]},
+        train_set,
+        evals_result=evals_result,
+        evals=[(train_set, "train")],
+        verbose_eval=False,
+        num_boost_round=10,
+        ray_params=RayParams(num_actors=2),
+    )
+    print("Final training error: {:.4f}".format(evals_result["train"]["error"][-1]))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Distributed prediction with a saved model (parity with ``examples/simple_predict.py``)."""
+
+import os
+
+import numpy as np
+from sklearn import datasets
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, RayXGBoostBooster, predict, train
+
+
+def main():
+    if not os.path.exists("simple.json"):
+        data, labels = datasets.load_breast_cancer(return_X_y=True)
+        train_set = RayDMatrix(data.astype(np.float32), labels.astype(np.float32))
+        bst = train(
+            {"objective": "binary:logistic"},
+            train_set,
+            num_boost_round=10,
+            ray_params=RayParams(num_actors=2),
+        )
+        bst.save_model("simple.json")
+
+    data, labels = datasets.load_breast_cancer(return_X_y=True)
+    dpred = RayDMatrix(data.astype(np.float32))
+    bst = RayXGBoostBooster.load_model("simple.json")
+    pred_ray = predict(bst, dpred, ray_params=RayParams(num_actors=2))
+    print(pred_ray[:10])
+    acc = float(((pred_ray > 0.5) == labels).mean())
+    print(f"Accuracy: {acc:.4f}")
+
+
+if __name__ == "__main__":
+    main()

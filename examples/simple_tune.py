@@ -1,0 +1,51 @@
+"""Hyperparameter sweep over the mesh trainer (parity with
+``examples/simple_tune.py``, using the standalone Tuner instead of Ray Tune)."""
+
+import numpy as np
+from sklearn import datasets
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+from xgboost_ray_tpu.tuner import Tuner, grid_search, loguniform
+
+
+def train_model(config):
+    data, labels = datasets.load_breast_cancer(return_X_y=True)
+    train_set = RayDMatrix(data.astype(np.float32), labels.astype(np.float32))
+    params = {
+        "objective": "binary:logistic",
+        "eval_metric": ["logloss", "error"],
+        "eta": config["eta"],
+        "subsample": config["subsample"],
+        "max_depth": config["max_depth"],
+    }
+    train(
+        params,
+        train_set,
+        evals=[(train_set, "train")],
+        verbose_eval=False,
+        num_boost_round=10,
+        ray_params=RayParams(num_actors=2),
+    )
+
+
+def main():
+    search_space = {
+        "eta": loguniform(1e-4, 1e-1),
+        "subsample": 0.8,
+        "max_depth": grid_search([3, 4, 5]),
+    }
+    tuner = Tuner(
+        train_model,
+        search_space,
+        metric="train-error",
+        mode="min",
+        num_samples=2,
+    )
+    result = tuner.fit()
+    best = result.get_best_trial()
+    print("Best hyperparameters", best.config)
+    print("Best error", best.last_result["train-error"])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Train + evaluate on a generated parquet dataset (parity with
+``examples/train_on_test_data.py``)."""
+
+import argparse
+import os
+import shutil
+import tempfile
+import time
+
+from xgboost_ray_tpu import RayDMatrix, RayFileType, RayParams, predict, train
+from examples.create_test_data import create_parquet
+
+
+def main(num_rows, num_partitions, num_features, num_actors):
+    tmpdir = tempfile.mkdtemp()
+    path = os.path.join(tmpdir, "parted.parquet")
+    create_parquet(
+        path,
+        num_rows=num_rows,
+        num_partitions=num_partitions,
+        num_features=num_features,
+    )
+    dtrain = RayDMatrix(path, label="labels", ignore=["partition"])
+
+    config = {"tree_method": "hist", "eval_metric": ["logloss", "error"]}
+    evals_result = {}
+    start = time.time()
+    bst = train(
+        config,
+        dtrain,
+        evals_result=evals_result,
+        ray_params=RayParams(max_actor_restarts=0, num_actors=num_actors),
+        num_boost_round=10,
+        evals=[(dtrain, "train")],
+        verbose_eval=False,
+    )
+    print(f"TRAIN TIME TAKEN: {time.time() - start:.2f} seconds")
+    print("Final training error: {:.4f}".format(evals_result["train"]["error"][-1]))
+
+    pred = predict(bst, dtrain, ray_params=RayParams(num_actors=num_actors))
+    print("Predictions:", pred[:10])
+    shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-rows", type=int, default=100_000)
+    parser.add_argument("--num-partitions", type=int, default=8)
+    parser.add_argument("--num-features", type=int, default=8)
+    parser.add_argument("--num-actors", type=int, default=2)
+    args = parser.parse_args()
+    main(args.num_rows, args.num_partitions, args.num_features, args.num_actors)

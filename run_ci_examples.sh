@@ -1,0 +1,22 @@
+#!/bin/bash
+# Smoke-run the examples (parity with the reference's run_ci_examples.sh).
+set -e
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
+ROOT="$(cd "$(dirname "$0")" && pwd)"
+export PYTHONPATH="$ROOT:$PYTHONPATH"
+cd "$ROOT"
+
+pushd examples/ || exit 1
+ran=0
+for ex in readme.py readme_sklearn_api.py simple.py simple_predict.py \
+          simple_objectstore.py simple_partitioned.py simple_tune.py \
+          custom_objective_metric.py; do
+  echo "================= Running $ex ================="
+  python "$ex"
+  ran=$((ran+1))
+done
+popd
+echo "================= Running train_on_test_data.py ================="
+python -m examples.train_on_test_data --num-rows 20000 --num-partitions 4 --num-actors 2
+echo "Ran $ran examples + train_on_test_data OK"

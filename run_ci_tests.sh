@@ -1,0 +1,15 @@
+#!/bin/bash
+# Ordered fail-fast test runner (parity with the reference's run_ci_tests.sh).
+set -e
+cd "$(dirname "$0")"
+python -m pytest tests/test_matrix.py -v -x
+python -m pytest tests/test_data_source.py -v -x
+python -m pytest tests/test_ops.py -v -x
+python -m pytest tests/test_engine.py -v -x
+python -m pytest tests/test_end_to_end.py -v -x
+python -m pytest tests/test_fault_tolerance.py -v -x
+python -m pytest tests/test_xgboost_api.py -v -x
+python -m pytest tests/test_tune.py -v -x
+python -m pytest tests/test_sklearn.py -v -x
+echo "================= Running smoke benchmark ================="
+python tests/release/benchmark_tpu.py 2 10 8 --smoke-test

@@ -1,0 +1,105 @@
+"""Fault-tolerance benchmark grid (parity with ``tests/release/benchmark_ft.py``).
+
+Conditions mirror the reference's experiment design (``benchmark_ft.py:32-190``):
+  calibrate       — no failures, full world
+  fewer_workers   — train with (workers - affected) from the start
+  non_elastic     — kill `affected` workers at 25% of rounds, restart-based FT
+  elastic         — same failure under elastic continuation (+ reintegration)
+Each condition reports final metrics + train time so degradation under
+failure can be compared against the calibration rows.
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+from xgboost_ray_tpu.callback import TrainingCallback
+from xgboost_ray_tpu.exceptions import RayActorError
+
+
+class FaultToleranceManager(TrainingCallback):
+    """Scripts a global kill timeline (analog of the reference's
+    0-CPU coordinator actor, ``tests/fault_tolerance.py``)."""
+
+    def __init__(self, die_round=None, ranks=(1,)):
+        self.die_round = die_round
+        self.ranks = tuple(ranks)
+        self.fired = False
+        self.global_rounds = []
+
+    def after_iteration(self, model, epoch, evals_log):
+        self.global_rounds.append(epoch)
+        if self.die_round is not None and not self.fired and epoch == self.die_round:
+            self.fired = True
+            raise RayActorError("scheduled failure", ranks=list(self.ranks))
+        return False
+
+
+def run_condition(condition, x, y, workers, rounds, affected):
+    dtrain = RayDMatrix(x, y)
+    params = {"objective": "binary:logistic",
+              "eval_metric": ["logloss", "error"], "max_depth": 6}
+    callbacks = []
+    if condition == "calibrate":
+        rp = RayParams(num_actors=workers, checkpoint_frequency=max(1, rounds // 10))
+    elif condition == "fewer_workers":
+        rp = RayParams(num_actors=workers - affected,
+                       checkpoint_frequency=max(1, rounds // 10))
+    elif condition == "non_elastic":
+        rp = RayParams(num_actors=workers, max_actor_restarts=affected + 1,
+                       checkpoint_frequency=max(1, rounds // 10))
+        callbacks = [FaultToleranceManager(die_round=rounds // 4,
+                                           ranks=range(affected))]
+    elif condition == "elastic":
+        rp = RayParams(num_actors=workers, elastic_training=True,
+                       max_failed_actors=affected, max_actor_restarts=affected + 1,
+                       checkpoint_frequency=max(1, rounds // 10))
+        callbacks = [FaultToleranceManager(die_round=rounds // 4,
+                                           ranks=range(affected))]
+    else:
+        raise ValueError(condition)
+
+    evals_result = {}
+    additional = {}
+    start = time.time()
+    train(params, dtrain, rounds, evals=[(dtrain, "train")],
+          evals_result=evals_result, additional_results=additional,
+          ray_params=rp, callbacks=callbacks, verbose_eval=False)
+    taken = time.time() - start
+    return {
+        "condition": condition,
+        "affected": affected,
+        "train_time_s": round(taken, 2),
+        "final_logloss": evals_result["train"]["logloss"][-1],
+        "final_error": evals_result["train"]["error"][-1],
+        "total_n": additional.get("total_n"),
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--workers", type=int, default=4)
+    parser.add_argument("--rounds", type=int, default=40)
+    parser.add_argument("--rows", type=int, default=100_000)
+    parser.add_argument("--affected", type=int, nargs="+", default=[1, 2])
+    args = parser.parse_args()
+
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal((args.rows, 16)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float32)
+
+    results = []
+    for affected in args.affected:
+        for condition in ("calibrate", "fewer_workers", "non_elastic", "elastic"):
+            res = run_condition(condition, x, y, args.workers, args.rounds, affected)
+            print(json.dumps(res))
+            results.append(res)
+    with open("ft_results.json", "w") as fp:
+        json.dump(results, fp, indent=2)
+
+
+if __name__ == "__main__":
+    main()

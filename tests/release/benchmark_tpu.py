@@ -1,0 +1,130 @@
+"""Scale benchmark CLI (parity with ``tests/release/benchmark_cpu_gpu.py``).
+
+Usage: python benchmark_tpu.py <num_workers> <num_rounds> <num_files> [--file ...]
+Writes res.csv with wall-clock timings; the tpu_hist analog of the
+reference's hist/gpu_hist benchmark.
+"""
+
+import argparse
+import csv
+import glob
+import os
+import time
+
+import numpy as np
+
+from xgboost_ray_tpu import RayDMatrix, RayFileType, RayParams, train
+
+
+def train_ray(
+    path,
+    num_workers,
+    num_boost_rounds,
+    num_files=0,
+    regression=False,
+    use_gpu=False,  # accepted for CLI parity; TPU is always the device
+    smoke_test=False,
+    ray_params=None,
+    xgboost_params=None,
+    **kwargs,
+):
+    if not isinstance(path, list):
+        path = [path]
+    if num_files:
+        files = sorted(sum((glob.glob(os.path.join(p, "*.parquet")) for p in path), []))
+        while num_files > len(files):
+            files = files + files
+        path = files[:num_files]
+
+    use_device_matrix = not smoke_test
+    dtrain = RayDMatrix(
+        path,
+        num_actors=num_workers,
+        label="labels",
+        ignore=["partition"],
+        filetype=RayFileType.PARQUET,
+    )
+
+    config = dict(xgboost_params or {})
+    config.setdefault("tree_method", "tpu_hist")
+    config.setdefault(
+        "objective", "reg:squarederror" if regression else "binary:logistic"
+    )
+    config.setdefault("eval_metric", ["rmse"] if regression else ["logloss", "error"])
+
+    start = time.time()
+    evals_result = {}
+    additional_results = {}
+    bst = train(
+        config,
+        dtrain,
+        evals_result=evals_result,
+        additional_results=additional_results,
+        num_boost_round=num_boost_rounds,
+        ray_params=ray_params
+        or RayParams(
+            num_actors=num_workers,
+            checkpoint_frequency=(num_boost_rounds // 2),
+        ),
+        evals=[(dtrain, "train")],
+        verbose_eval=False,
+        **kwargs,
+    )
+    taken = time.time() - start
+    print(f"TRAIN TIME TAKEN: {taken:.2f} seconds")
+
+    out_file = os.path.expanduser("benchmark_{}.json".format("tpu"))
+    bst.save_model(out_file)
+    print("Final training error: {:.4f}".format(
+        evals_result["train"][config["eval_metric"][-1]][-1]))
+    return bst, additional_results, taken
+
+
+def main():
+    parser = argparse.ArgumentParser(description="TPU benchmark (release harness)")
+    parser.add_argument("num_workers", type=int, default=2, nargs="?")
+    parser.add_argument("num_rounds", type=int, default=10, nargs="?")
+    parser.add_argument("num_files", type=int, default=20, nargs="?")
+    parser.add_argument("--file", default="/data/parted.parquet", type=str)
+    parser.add_argument("--regression", action="store_true", default=False)
+    parser.add_argument("--smoke-test", action="store_true", default=False)
+    args = parser.parse_args()
+
+    path = args.file
+    if args.smoke_test or not os.path.exists(path):
+        from examples.create_test_data import create_parquet
+
+        path = "/tmp/smoke_test_parquet"
+        os.makedirs(path, exist_ok=True)
+        if not glob.glob(os.path.join(path, "*.parquet")):
+            import pandas as pd
+            from sklearn.datasets import make_classification
+
+            x, y = make_classification(n_samples=40_000, n_features=8, random_state=0)
+            df = pd.DataFrame(x.astype(np.float32),
+                              columns=[f"f{i}" for i in range(8)])
+            df["labels"] = y.astype(np.float32)
+            rows = len(df) // max(args.num_files, 1)
+            for i in range(max(args.num_files, 1)):
+                df.iloc[i * rows : (i + 1) * rows].to_parquet(
+                    os.path.join(path, f"part-{i:03d}.parquet"))
+
+    init_start = time.time()
+    _, extra, train_taken = train_ray(
+        path, args.num_workers, args.num_rounds, args.num_files,
+        regression=args.regression, smoke_test=args.smoke_test,
+    )
+    total_taken = time.time() - init_start
+    print(f"TOTAL TIME TAKEN: {total_taken:.2f} seconds")
+
+    with open("res.csv", "at") as fp:
+        writer = csv.writer(fp, delimiter=",")
+        writer.writerow([
+            args.num_workers, args.num_files,
+            int(extra.get("total_n", 0)), args.num_rounds,
+            round(train_taken, 4), round(total_taken, 4),
+        ])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Generate a learnable partitioned parquet dataset (parity with
+``tests/release/create_learnable_data.py``: make_classification, target
+accuracy ~0.8, N parquet partitions)."""
+
+import argparse
+import os
+
+import numpy as np
+import pandas as pd
+from sklearn.datasets import make_classification
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("path", type=str, nargs="?", default="learnable.parquet")
+    parser.add_argument("--num-rows", type=int, default=1_000_000)
+    parser.add_argument("--num-cols", type=int, default=4)
+    parser.add_argument("--num-partitions", type=int, default=100)
+    parser.add_argument("--seed", type=int, default=1234)
+    args = parser.parse_args()
+
+    x, y = make_classification(
+        n_samples=args.num_rows,
+        n_features=args.num_cols,
+        n_informative=args.num_cols,
+        n_redundant=0,
+        n_repeated=0,
+        flip_y=0.2,  # keeps achievable accuracy ~0.8 like the reference
+        random_state=args.seed,
+    )
+    df = pd.DataFrame(x.astype(np.float32),
+                      columns=[f"f{i}" for i in range(args.num_cols)])
+    df["labels"] = y.astype(np.float32)
+    df["partition"] = df.index % args.num_partitions
+    os.makedirs(os.path.dirname(args.path) or ".", exist_ok=True)
+    df.to_parquet(args.path, partition_cols=["partition"])
+    print(f"Wrote {args.num_rows} rows to {args.path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""Trial placement verification (parity with ``tests/release/tune_placement.py``:
+asserts the PACK bundle layout of tuning trials)."""
+
+import numpy as np
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+from xgboost_ray_tpu.tuner import Tuner, grid_search
+
+
+def main():
+    rp = RayParams(num_actors=4, cpus_per_actor=2, tpus_per_actor=1)
+    pgf = rp.get_tune_resources()
+    assert pgf.strategy == "PACK", pgf.strategy
+    assert len(pgf.bundles) == 5, pgf.bundles  # head + one per actor
+    assert pgf.required_resources()["TPU"] == 4
+
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal((1000, 4)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+
+    def trainable(config):
+        dtrain = RayDMatrix(x, y)
+        train({"objective": "binary:logistic", "max_depth": config["max_depth"],
+               "eval_metric": ["error"]},
+              dtrain, 5, evals=[(dtrain, "train")],
+              ray_params=RayParams(num_actors=2), verbose_eval=False)
+
+    result = Tuner(trainable, {"max_depth": grid_search([2, 3])},
+                   metric="train-error", mode="min").fit()
+    assert len(result.trials) == 2
+    assert all(t.error is None for t in result.trials)
+    print("PLACEMENT OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""Locality assignment tests (parity targets:
+``xgboost_ray/tests/test_data_source.py`` — even/uneven, colocated/spill)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from xgboost_ray_tpu.data_sources._distributed import (
+    assign_partitions_to_actors,
+    get_actor_rank_hosts,
+)
+from xgboost_ray_tpu.matrix import RayDMatrix, RayShardingMode
+
+
+def test_even_assignment_single_host():
+    host_to_parts = {"h0": [f"p{i}" for i in range(8)]}
+    actors = {0: "h0", 1: "h0", 2: "h0", 3: "h0"}
+    out = assign_partitions_to_actors(host_to_parts, actors)
+    sizes = sorted(len(v) for v in out.values())
+    assert sizes == [2, 2, 2, 2]
+    assigned = sorted(p for parts in out.values() for p in parts)
+    assert assigned == sorted(f"p{i}" for i in range(8))
+
+
+def test_uneven_assignment_bounded():
+    host_to_parts = {"h0": [f"p{i}" for i in range(10)]}
+    actors = {r: "h0" for r in range(4)}
+    out = assign_partitions_to_actors(host_to_parts, actors)
+    sizes = sorted(len(v) for v in out.values())
+    assert sizes == [2, 2, 3, 3]
+
+
+def test_colocated_parts_stay_local():
+    host_to_parts = {
+        "hA": ["a0", "a1", "a2", "a3"],
+        "hB": ["b0", "b1", "b2", "b3"],
+    }
+    actors = {0: "hA", 1: "hA", 2: "hB", 3: "hB"}
+    out = assign_partitions_to_actors(host_to_parts, actors)
+    for rank in (0, 1):
+        assert all(p.startswith("a") for p in out[rank]), out
+    for rank in (2, 3):
+        assert all(p.startswith("b") for p in out[rank]), out
+
+
+def test_spill_to_remote_actors():
+    # all parts on hA, but actors also on hB: hB actors get the remainder
+    host_to_parts = {"hA": [f"p{i}" for i in range(6)], "hB": []}
+    actors = {0: "hA", 1: "hB", 2: "hB"}
+    out = assign_partitions_to_actors(host_to_parts, actors)
+    assert sum(len(v) for v in out.values()) == 6
+    assert max(len(v) for v in out.values()) == 2
+
+
+def test_every_partition_assigned_exactly_once():
+    rng = np.random.RandomState(0)
+    for trial in range(10):
+        n_hosts = rng.randint(1, 4)
+        n_parts = rng.randint(1, 20)
+        n_actors = rng.randint(1, min(n_parts, 8) + 1)
+        parts = [f"p{i}" for i in range(n_parts)]
+        host_to_parts = {}
+        for i, p in enumerate(parts):
+            host_to_parts.setdefault(f"h{i % n_hosts}", []).append(p)
+        actors = {r: f"h{r % n_hosts}" for r in range(n_actors)}
+        out = assign_partitions_to_actors(host_to_parts, actors)
+        assigned = sorted(p for v in out.values() for p in v)
+        assert assigned == sorted(parts)
+        sizes = [len(v) for v in out.values()]
+        assert max(sizes) - min(sizes) <= 1
+
+
+def test_get_actor_rank_hosts_single_process():
+    hosts = get_actor_rank_hosts(4)
+    assert len(hosts) == 4
+    assert len(set(hosts.values())) == 1  # one jax process here
+
+
+def test_fixed_sharding_assigns_partitions(tmp_path):
+    rng = np.random.RandomState(1)
+    x = rng.randn(64, 3).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    df = pd.DataFrame(x, columns=["a", "b", "c"])
+    df["label"] = y
+    files = []
+    for i in range(4):
+        p = str(tmp_path / f"part{i}.parquet")
+        df.iloc[i * 16 : (i + 1) * 16].to_parquet(p)
+        files.append(p)
+    dm = RayDMatrix(files, label="label", sharding=RayShardingMode.FIXED,
+                    num_actors=2, lazy=True)
+    assert dm.assign_shards_to_actors([None, None])
+    s0 = dm.get_data(0, 2)
+    s1 = dm.get_data(1, 2)
+    assert s0["data"].shape[0] + s1["data"].shape[0] == 64
+    assert s0["data"].shape[0] == 32  # 2 files each

@@ -1,0 +1,239 @@
+"""Fault-tolerance tests beyond the e2e basics (parity targets:
+``xgboost_ray/tests/test_fault_tolerance.py``: multi-kill, aborts, checkpoint
+semantics, pure elastic-scheduler state-machine walkthroughs)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+from xgboost_ray_tpu.callback import TrainingCallback
+from xgboost_ray_tpu.exceptions import (
+    RayActorError,
+    RayXGBoostActorAvailable,
+    RayXGBoostTrainingError,
+)
+from xgboost_ray_tpu.main import (
+    RayXGBoostActor,
+    _Checkpoint,
+    _TrainingState,
+)
+from xgboost_ray_tpu import elastic
+from xgboost_ray_tpu.util import Event, Queue
+
+
+def _data(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    return x, y
+
+
+_PARAMS = {"objective": "binary:logistic", "eval_metric": ["logloss", "error"],
+           "max_depth": 3}
+
+
+class KillAt(TrainingCallback):
+    """Kill given ranks at given rounds; each firing happens exactly once
+    (the analog of the reference's die-lock files)."""
+
+    def __init__(self, schedule):
+        # schedule: {round: [ranks]}
+        self.schedule = dict(schedule)
+
+    def after_iteration(self, model, epoch, evals_log):
+        if epoch in self.schedule:
+            ranks = self.schedule.pop(epoch)
+            raise RayActorError("scheduled kill", ranks=ranks)
+        return False
+
+
+def test_multi_kill_different_rounds():
+    x, y = _data()
+    bst = train(
+        _PARAMS, RayDMatrix(x, y), 12,
+        ray_params=RayParams(num_actors=2, max_actor_restarts=2,
+                             checkpoint_frequency=2),
+        callbacks=[KillAt({3: [0], 7: [1]})],
+    )
+    assert bst.num_boosted_rounds() == 12
+
+
+def test_kill_during_data_loading():
+    from xgboost_ray_tpu.callback import DistributedCallback
+
+    x, y = _data()
+
+    class DieOnLoad(DistributedCallback):
+        def __init__(self):
+            self.fired = False
+
+        def before_data_loading(self, actor, data, *a, **kw):
+            if not self.fired and actor.rank == 1:
+                self.fired = True
+                raise RayActorError("died while loading", ranks=[1])
+
+    bst = train(
+        _PARAMS, RayDMatrix(x, y), 5,
+        ray_params=RayParams(num_actors=2, max_actor_restarts=1,
+                             distributed_callbacks=[DieOnLoad()]),
+    )
+    assert bst.num_boosted_rounds() == 5
+
+
+def test_abort_without_retries():
+    x, y = _data()
+    with pytest.raises(RayXGBoostTrainingError):
+        train(
+            _PARAMS, RayDMatrix(x, y), 10,
+            ray_params=RayParams(num_actors=2, max_actor_restarts=0),
+            callbacks=[KillAt({2: [1]})],
+        )
+
+
+def test_elastic_abort_when_too_many_dead(monkeypatch):
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_DISABLED", "1")
+    x, y = _data()
+    with pytest.raises(RayXGBoostTrainingError, match="too many"):
+        train(
+            _PARAMS, RayDMatrix(x, y), 10,
+            ray_params=RayParams(num_actors=2, elastic_training=True,
+                                 max_failed_actors=1, max_actor_restarts=3,
+                                 checkpoint_frequency=2),
+            callbacks=[KillAt({2: [0], 5: [1]})],
+        )
+
+
+def test_checkpoint_rounds_arithmetic():
+    """After a failure at round 5 with checkpoints every 2 rounds, training
+    must resume from round 6 (checkpoint at iteration 5) — the final model
+    has exactly num_boost_round trees (mirror of ``main.py:1606-1612``)."""
+    x, y = _data()
+    rounds_seen = []
+
+    class Recorder(TrainingCallback):
+        def after_iteration(self, model, epoch, evals_log):
+            rounds_seen.append(epoch)
+            return False
+
+    bst = train(
+        _PARAMS, RayDMatrix(x, y), 10,
+        ray_params=RayParams(num_actors=2, max_actor_restarts=1,
+                             checkpoint_frequency=2),
+        callbacks=[Recorder(), KillAt({5: [1]})],
+    )
+    assert bst.num_boosted_rounds() == 10
+    # attempt 1 runs rounds 0..5 (killed after 5; checkpoint covers 0..5),
+    # attempt 2 runs the remaining 4 rounds as local rounds 0..3
+    assert rounds_seen == [0, 1, 2, 3, 4, 5, 0, 1, 2, 3]
+
+
+def test_predict_retry_on_actor_error():
+    from xgboost_ray_tpu.callback import DistributedCallback
+    from xgboost_ray_tpu import predict
+
+    x, y = _data()
+    bst = train(_PARAMS, RayDMatrix(x, y), 5, ray_params=RayParams(num_actors=2))
+
+    class DieOncePredict(DistributedCallback):
+        def __init__(self):
+            self.fired = False
+
+        def before_predict(self, actor, *a, **kw):
+            if not self.fired:
+                self.fired = True
+                raise RayActorError("predict crash", ranks=[actor.rank])
+
+    out = predict(
+        bst, RayDMatrix(x),
+        ray_params=RayParams(num_actors=2, max_actor_restarts=1,
+                             distributed_callbacks=[DieOncePredict()]),
+    )
+    assert out.shape == (256,)
+
+
+# ---------------------------------------------------------------------------
+# Pure state-machine tests of the elastic scheduler (no training at all),
+# the analog of the reference's mock-based walkthrough
+# (``test_fault_tolerance.py:451-585``).
+# ---------------------------------------------------------------------------
+
+
+def _fake_state(num_actors=4, dead=(2,)):
+    actors = [
+        RayXGBoostActor(rank, num_actors) if rank not in dead else None
+        for rank in range(num_actors)
+    ]
+    return _TrainingState(
+        actors=actors,
+        queue=Queue(),
+        stop_event=Event(),
+        checkpoint=_Checkpoint(),
+        additional_results={},
+        failed_actor_ranks=set(),
+        elastic_dead_ranks=set(dead),
+        pending_actors={},
+    )
+
+
+class _NoLoadMatrix:
+    """Matrix stub whose get_data returns an empty shard instantly."""
+
+    def get_data(self, rank, num_actors=None):
+        return {"data": np.zeros((1, 1), np.float32), "label": np.zeros(1)}
+
+    def load_data(self, num_actors=None):
+        pass
+
+
+def test_elastic_scheduler_creates_pending(monkeypatch):
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_RESOURCE_CHECK_S", "0")
+    state = _fake_state(dead=(1, 3))
+    rp = RayParams(num_actors=4, elastic_training=True, max_failed_actors=2,
+                   max_actor_restarts=1)
+    scheduled = elastic._maybe_schedule_new_actors(
+        training_state=state, num_cpus_per_actor=1, num_gpus_per_actor=0,
+        resources_per_actor=None, ray_params=rp, load_data=[_NoLoadMatrix()],
+    )
+    assert scheduled
+    assert set(state.pending_actors) == {1, 3}
+
+
+def test_elastic_scheduler_respects_check_interval(monkeypatch):
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_RESOURCE_CHECK_S", "9999")
+    state = _fake_state(dead=(1,))
+    state.last_resource_check_at = time.time()
+    rp = RayParams(num_actors=4, elastic_training=True, max_failed_actors=1,
+                   max_actor_restarts=1)
+    scheduled = elastic._maybe_schedule_new_actors(
+        training_state=state, num_cpus_per_actor=1, num_gpus_per_actor=0,
+        resources_per_actor=None, ray_params=rp, load_data=[_NoLoadMatrix()],
+    )
+    assert not scheduled
+    assert not state.pending_actors
+
+
+def test_elastic_scheduler_grace_period_then_restart(monkeypatch):
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_RESOURCE_CHECK_S", "0")
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_GRACE_PERIOD_S", "0")
+    state = _fake_state(dead=(2,))
+    rp = RayParams(num_actors=4, elastic_training=True, max_failed_actors=1,
+                   max_actor_restarts=1)
+    elastic._maybe_schedule_new_actors(
+        training_state=state, num_cpus_per_actor=1, num_gpus_per_actor=0,
+        resources_per_actor=None, ray_params=rp, load_data=[_NoLoadMatrix()],
+    )
+    # first call arms the grace period, second (after expiry) raises
+    elastic._update_scheduled_actor_states(state)
+    with pytest.raises(RayXGBoostActorAvailable):
+        elastic._update_scheduled_actor_states(state)
+
+
+def test_get_actor_alive_status():
+    state = _fake_state(dead=(0,))
+    state.actors[1].kill()
+    dead_ranks = []
+    n_dead = elastic._get_actor_alive_status(state.actors, dead_ranks.append)
+    assert n_dead == 2
+    assert dead_ranks == [0, 1]

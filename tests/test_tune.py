@@ -1,0 +1,159 @@
+"""Tune/HPO integration tests (parity targets: ``xgboost_ray/tests/test_tune.py``)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+from xgboost_ray_tpu import tune as tune_mod
+from xgboost_ray_tpu.tune import (
+    TuneReportCheckpointCallback,
+    load_model,
+)
+from xgboost_ray_tpu.tuner import ExperimentResult, Tuner, choice, grid_search
+
+
+@pytest.fixture
+def xy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(128, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    return x, y
+
+
+@pytest.fixture(autouse=True)
+def _clean_session():
+    yield
+    tune_mod.shutdown_session()
+
+
+_PARAMS = {"objective": "binary:logistic", "eval_metric": ["logloss", "error"],
+           "max_depth": 3}
+
+
+def test_callback_reports_every_round(tmp_path, xy):
+    x, y = xy
+    session = tune_mod.init_session(str(tmp_path))
+    dtrain = RayDMatrix(x, y)
+    train(_PARAMS, dtrain, 6, evals=[(dtrain, "train")],
+          ray_params=RayParams(num_actors=2))
+    assert len(session.results) == 6  # auto-injected callback fired per round
+    assert "train-logloss" in session.results[0]
+    assert session.results[0]["training_iteration"] == 1
+
+
+def test_callback_not_injected_outside_session(xy):
+    x, y = xy
+    dtrain = RayDMatrix(x, y)
+    additional = {}
+    train(_PARAMS, dtrain, 3, evals=[(dtrain, "train")],
+          ray_params=RayParams(num_actors=2), additional_results=additional)
+    assert tune_mod.get_session() is None
+
+
+def test_checkpoints_written_and_loadable(tmp_path, xy):
+    x, y = xy
+    session = tune_mod.init_session(str(tmp_path))
+    dtrain = RayDMatrix(x, y)
+    train(
+        _PARAMS, dtrain, 10, evals=[(dtrain, "train")],
+        ray_params=RayParams(num_actors=2),
+        callbacks=[TuneReportCheckpointCallback(frequency=5)],
+    )
+    assert session.last_checkpoint_path is not None
+    bst = load_model(session.last_checkpoint_path)
+    pred = bst.predict(x)
+    assert pred.shape == (128,)
+
+
+def test_explicit_callback_not_duplicated(tmp_path, xy):
+    x, y = xy
+    session = tune_mod.init_session(str(tmp_path))
+    dtrain = RayDMatrix(x, y)
+    train(
+        _PARAMS, dtrain, 4, evals=[(dtrain, "train")],
+        ray_params=RayParams(num_actors=2),
+        callbacks=[TuneReportCheckpointCallback(frequency=2)],
+    )
+    # one report per round, not two (injection skipped when already present)
+    assert len(session.results) == 4
+
+
+def test_metric_selection_mapping(tmp_path, xy):
+    x, y = xy
+    session = tune_mod.init_session(str(tmp_path))
+    dtrain = RayDMatrix(x, y)
+    train(
+        _PARAMS, dtrain, 3, evals=[(dtrain, "train")],
+        ray_params=RayParams(num_actors=2),
+        callbacks=[TuneReportCheckpointCallback(
+            metrics={"loss": "train-logloss"}, frequency=100)],
+    )
+    assert "loss" in session.results[-1]
+
+
+def test_get_tune_resources():
+    rp = RayParams(num_actors=4, cpus_per_actor=2, tpus_per_actor=1)
+    pgf = rp.get_tune_resources()
+    assert len(pgf.bundles) == 5  # head + 4 actors
+    assert pgf.strategy == "PACK"
+    total = pgf.required_resources()
+    assert total["CPU"] == 1 + 4 * 2
+    assert total["TPU"] == 4
+    with pytest.raises(ValueError):
+        RayParams(num_actors=0).get_tune_resources()
+
+
+def test_placement_options_passthrough():
+    rp = RayParams(num_actors=2, cpus_per_actor=1,
+                   placement_options={"strategy": "SPREAD",
+                                      "_max_cpu_fraction_per_node": 0.8})
+    pgf = rp.get_tune_resources()
+    assert pgf.strategy == "SPREAD"
+    assert pgf.options["_max_cpu_fraction_per_node"] == 0.8
+
+
+def test_tuner_grid_search_end_to_end(tmp_path, xy):
+    x, y = xy
+
+    def trainable(config):
+        dtrain = RayDMatrix(x, y)
+        params = dict(_PARAMS, max_depth=config["max_depth"], eta=config["eta"])
+        train(params, dtrain, 5, evals=[(dtrain, "train")],
+              ray_params=RayParams(num_actors=2))
+
+    tuner = Tuner(
+        trainable,
+        {"max_depth": grid_search([2, 3]), "eta": 0.3},
+        metric="train-logloss",
+        mode="min",
+        experiment_dir=str(tmp_path),
+        raise_on_failed_trial=True,
+    )
+    result = tuner.fit()
+    assert len(result.trials) == 2
+    best = result.get_best_trial()
+    assert best is not None
+    assert best.config["max_depth"] in (2, 3)
+    assert best.last_result["train-logloss"] < 0.7
+    assert result.best_config == best.config
+
+
+def test_tuner_isolates_trial_failures(tmp_path, xy):
+    x, y = xy
+
+    def trainable(config):
+        if config["max_depth"] == 99:
+            raise RuntimeError("boom")
+        dtrain = RayDMatrix(x, y)
+        train(dict(_PARAMS, max_depth=config["max_depth"]), dtrain, 2,
+              evals=[(dtrain, "train")], ray_params=RayParams(num_actors=2))
+
+    tuner = Tuner(
+        trainable, {"max_depth": grid_search([2, 99])},
+        metric="train-logloss", mode="min", experiment_dir=str(tmp_path),
+    )
+    result = tuner.fit()
+    assert result.trials[1].error is not None
+    assert result.get_best_trial().config["max_depth"] == 2

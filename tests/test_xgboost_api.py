@@ -1,0 +1,119 @@
+"""xgboost API-surface parity tests (targets: ``xgboost_ray/tests/test_xgboost_api.py``:
+custom objective, custom metric, user callbacks)."""
+
+import numpy as np
+import pytest
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+from xgboost_ray_tpu.callback import TrainingCallback
+
+
+@pytest.fixture
+def xy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(200, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    return x, y
+
+
+RP = RayParams(num_actors=2)
+
+
+def test_custom_objective_logreg(xy):
+    x, y = xy
+
+    def logregobj(preds, dtrain):
+        labels = dtrain.get_label()
+        p = 1.0 / (1.0 + np.exp(-preds))
+        return p - labels, p * (1.0 - p)
+
+    dtrain = RayDMatrix(x, y)
+    evals_result = {}
+    bst = train(
+        {"max_depth": 3, "eta": 0.3, "eval_metric": ["error"],
+         "base_score": 0.5},
+        dtrain, 15, evals=[(dtrain, "train")], evals_result=evals_result,
+        obj=logregobj, ray_params=RP,
+    )
+    assert evals_result["train"]["error"][-1] < 0.05
+    margin = bst.predict(x, output_margin=True)
+    acc = ((margin > 0) == y).mean()
+    assert acc > 0.95
+
+
+def test_custom_metric_receives_dmatrix_accessors(xy):
+    x, y = xy
+    seen = {}
+
+    def metric(preds, dtrain):
+        seen["n"] = dtrain.num_row()
+        seen["labels"] = dtrain.get_label().shape
+        return "const_metric", 42.0
+
+    dtrain = RayDMatrix(x, y)
+    evals_result = {}
+    train({"objective": "binary:logistic"}, dtrain, 3,
+          evals=[(dtrain, "train")], evals_result=evals_result,
+          feval=metric, ray_params=RP)
+    assert seen["n"] == 200
+    assert seen["labels"] == (200,)
+    assert evals_result["train"]["const_metric"] == [42.0] * 3
+
+
+def test_callback_hooks_order_and_model_access(xy):
+    x, y = xy
+    events = []
+
+    class Probe(TrainingCallback):
+        def before_training(self, model):
+            events.append("before_training")
+            return model
+
+        def before_iteration(self, model, epoch, evals_log):
+            events.append(f"before_{epoch}")
+            return False
+
+        def after_iteration(self, model, epoch, evals_log):
+            events.append(f"after_{epoch}")
+            # lazy booster proxy must expose real booster attributes
+            assert model.num_boosted_rounds() == epoch + 1
+            return False
+
+        def after_training(self, model):
+            events.append("after_training")
+            return model
+
+    train({"objective": "binary:logistic"}, RayDMatrix(x, y), 3,
+          callbacks=[Probe()], ray_params=RP)
+    assert events == [
+        "before_training", "before_0", "after_0", "before_1", "after_1",
+        "before_2", "after_2", "after_training",
+    ]
+
+
+def test_callback_early_stop_via_return_value(xy):
+    x, y = xy
+
+    class StopAt(TrainingCallback):
+        def after_iteration(self, model, epoch, evals_log):
+            return epoch >= 4
+
+    evals_result = {}
+    dtrain = RayDMatrix(x, y)
+    train({"objective": "binary:logistic"}, dtrain, 100,
+          evals=[(dtrain, "train")], evals_result=evals_result,
+          callbacks=[StopAt()], ray_params=RP)
+    assert len(evals_result["train"]["logloss"]) == 5
+
+
+def test_multiple_eval_metrics_recorded(xy):
+    x, y = xy
+    dtrain = RayDMatrix(x, y)
+    evals_result = {}
+    train({"objective": "binary:logistic",
+           "eval_metric": ["logloss", "error", "auc"]},
+          dtrain, 4, evals=[(dtrain, "train")], evals_result=evals_result,
+          ray_params=RP)
+    assert set(evals_result["train"]) == {"logloss", "error", "auc"}
+    assert len(evals_result["train"]["auc"]) == 4
+    assert evals_result["train"]["auc"][-1] > 0.95

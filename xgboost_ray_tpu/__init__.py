@@ -9,6 +9,19 @@ Rabit TCP allreduce becomes ``jax.lax.psum`` over ICI/DCN.
 Public API mirrors ``xgboost_ray/__init__.py:1-41``.
 """
 
+import os as _os
+
+# Respect an explicit JAX_PLATFORMS env override even when a PJRT plugin
+# (e.g. a TPU tunnel) force-updated the jax config at interpreter startup —
+# otherwise CPU-forced runs still initialize (and can hang on) the TPU client.
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+
+    try:
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception:  # pragma: no cover - config may be frozen post-init
+        pass
+
 from xgboost_ray_tpu.main import (
     RayParams,
     RayXGBoostActor,

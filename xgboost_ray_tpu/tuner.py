@@ -1,0 +1,192 @@
+"""Standalone hyperparameter sweep runner.
+
+The reference delegates HPO orchestration to Ray Tune (``xgboost_ray/tune.py``
+integrates callbacks + resources; trials are scheduled by Ray). On a TPU pod
+there is no Ray scheduler, so this module provides the trial-execution layer:
+grid/random search over a param space, one trial at a time on the mesh (task
+parallelism across trials maps to separate slices in multi-slice
+deployments), with the same report/checkpoint surface
+(``tune.TuneSession`` + ``TuneReportCheckpointCallback``).
+
+Search-space helpers mirror ``ray.tune``'s: grid_search, choice, uniform,
+loguniform, randint.
+"""
+
+import dataclasses
+import itertools
+import logging
+import os
+import random
+import tempfile
+from typing import Any, Callable, Dict, List, Optional
+
+from xgboost_ray_tpu import tune as tune_mod
+
+logger = logging.getLogger(__name__)
+
+
+# --- search space primitives -------------------------------------------------
+
+
+@dataclasses.dataclass
+class GridSearch:
+    values: List[Any]
+
+
+@dataclasses.dataclass
+class Sampler:
+    fn: Callable[[random.Random], Any]
+
+    def sample(self, rng: random.Random) -> Any:
+        return self.fn(rng)
+
+
+def grid_search(values: List[Any]) -> GridSearch:
+    return GridSearch(list(values))
+
+
+def choice(values: List[Any]) -> Sampler:
+    return Sampler(lambda rng: rng.choice(list(values)))
+
+
+def uniform(low: float, high: float) -> Sampler:
+    return Sampler(lambda rng: rng.uniform(low, high))
+
+
+def loguniform(low: float, high: float) -> Sampler:
+    import math
+
+    return Sampler(lambda rng: math.exp(rng.uniform(math.log(low), math.log(high))))
+
+
+def randint(low: int, high: int) -> Sampler:
+    return Sampler(lambda rng: rng.randrange(low, high))
+
+
+def _expand_space(space: Dict[str, Any], num_samples: int, seed: int) -> List[Dict[str, Any]]:
+    grid_keys = [k for k, v in space.items() if isinstance(v, GridSearch)]
+    grid_values = [space[k].values for k in grid_keys]
+    rng = random.Random(seed)
+    configs = []
+    grid_product = list(itertools.product(*grid_values)) if grid_keys else [()]
+    for _ in range(max(1, num_samples)):
+        for combo in grid_product:
+            config = {}
+            for key, value in space.items():
+                if isinstance(value, GridSearch):
+                    config[key] = combo[grid_keys.index(key)]
+                elif isinstance(value, Sampler):
+                    config[key] = value.sample(rng)
+                else:
+                    config[key] = value
+            configs.append(config)
+    return configs
+
+
+# --- trial execution ---------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    results: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    last_result: Optional[Dict[str, Any]] = None
+    checkpoint_path: Optional[str] = None
+    error: Optional[str] = None
+    trial_dir: str = ""
+
+
+@dataclasses.dataclass
+class ExperimentResult:
+    trials: List[Trial]
+    metric: Optional[str]
+    mode: str
+
+    def get_best_trial(
+        self, metric: Optional[str] = None, mode: Optional[str] = None
+    ) -> Optional[Trial]:
+        metric = metric or self.metric
+        mode = mode or self.mode
+        scored = [
+            t for t in self.trials
+            if t.last_result is not None and metric in t.last_result
+        ]
+        if not scored:
+            return None
+        key = lambda t: t.last_result[metric]
+        return max(scored, key=key) if mode == "max" else min(scored, key=key)
+
+    @property
+    def best_config(self) -> Optional[Dict[str, Any]]:
+        best = self.get_best_trial()
+        return best.config if best else None
+
+    @property
+    def best_checkpoint(self) -> Optional[str]:
+        best = self.get_best_trial()
+        return best.checkpoint_path if best else None
+
+
+class Tuner:
+    """Sequential trial runner with the tune-session report surface.
+
+    ``trainable(config)`` runs a full training; inside it, ``train()``
+    auto-injects ``TuneReportCheckpointCallback`` (because a tune session is
+    active), so per-round metrics and periodic checkpoints flow into the
+    trial record without user code — identical UX to the reference's
+    Ray-Tune path (``xgboost_ray/tune.py:27-48``).
+    """
+
+    def __init__(
+        self,
+        trainable: Callable[[Dict[str, Any]], Any],
+        param_space: Dict[str, Any],
+        *,
+        metric: Optional[str] = None,
+        mode: str = "min",
+        num_samples: int = 1,
+        seed: int = 0,
+        experiment_dir: Optional[str] = None,
+        raise_on_failed_trial: bool = False,
+    ):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+        self.trainable = trainable
+        self.param_space = param_space
+        self.metric = metric
+        self.mode = mode
+        self.num_samples = num_samples
+        self.seed = seed
+        self.experiment_dir = experiment_dir or tempfile.mkdtemp(prefix="rxgb_exp_")
+        self.raise_on_failed_trial = raise_on_failed_trial
+
+    def fit(self) -> ExperimentResult:
+        configs = _expand_space(self.param_space, self.num_samples, self.seed)
+        trials: List[Trial] = []
+        for i, config in enumerate(configs):
+            trial_id = f"trial_{i:05d}"
+            trial_dir = os.path.join(self.experiment_dir, trial_id)
+            os.makedirs(trial_dir, exist_ok=True)
+            trial = Trial(trial_id=trial_id, config=config, trial_dir=trial_dir)
+            session = tune_mod.init_session(trial_dir)
+            try:
+                self.trainable(config)
+                trial.results = session.results
+                trial.last_result = session.results[-1] if session.results else None
+                trial.checkpoint_path = session.last_checkpoint_path
+            except Exception as exc:  # noqa: BLE001 - trial isolation
+                trial.error = f"{type(exc).__name__}: {exc}"
+                logger.warning(f"[Tuner] {trial_id} failed: {trial.error}")
+                if self.raise_on_failed_trial:
+                    tune_mod.shutdown_session()
+                    raise
+            finally:
+                tune_mod.shutdown_session()
+            trials.append(trial)
+            if trial.last_result and self.metric and self.metric in trial.last_result:
+                logger.info(
+                    f"[Tuner] {trial_id} {self.metric}="
+                    f"{trial.last_result[self.metric]:.5f} config={config}"
+                )
+        return ExperimentResult(trials=trials, metric=self.metric, mode=self.mode)
