@@ -1,7 +1,12 @@
 #!/bin/bash
 # Smoke-run the examples (parity with the reference's run_ci_examples.sh).
 set -e
-export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+# CI examples always run on the CPU mesh (set RXGB_EXAMPLES_ON_TPU=1 to use
+# the ambient accelerator instead) — the ambient env may pin JAX_PLATFORMS to
+# a TPU plugin, which would serialize CI on accelerator availability.
+if [ "${RXGB_EXAMPLES_ON_TPU:-0}" != "1" ]; then
+  export JAX_PLATFORMS=cpu
+fi
 export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
 ROOT="$(cd "$(dirname "$0")" && pwd)"
 export PYTHONPATH="$ROOT:$PYTHONPATH"

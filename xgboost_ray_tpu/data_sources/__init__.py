@@ -10,11 +10,19 @@ from xgboost_ray_tpu.data_sources.csv import CSV
 from xgboost_ray_tpu.data_sources.parquet import Parquet
 from xgboost_ray_tpu.data_sources.object_store import ObjectStore
 from xgboost_ray_tpu.data_sources.partitioned import Partitioned
+from xgboost_ray_tpu.data_sources.modin import Modin
+from xgboost_ray_tpu.data_sources.dask import Dask
+from xgboost_ray_tpu.data_sources.ray_dataset import RayDataset
+from xgboost_ray_tpu.data_sources.petastorm import Petastorm
 
 data_sources = [
     Numpy,
     Pandas,
+    Modin,
+    Dask,
+    RayDataset,
     Partitioned,
+    Petastorm,
     CSV,
     Parquet,
     ObjectStore,
@@ -29,5 +37,9 @@ __all__ = [
     "Parquet",
     "ObjectStore",
     "Partitioned",
+    "Modin",
+    "Dask",
+    "RayDataset",
+    "Petastorm",
     "data_sources",
 ]

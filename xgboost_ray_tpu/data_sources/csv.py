@@ -2,6 +2,11 @@
 
 Single path or list of paths; with a list, distributed loading shards on the
 *file* level (indices select files, reference csv.py:26-43).
+
+Numeric CSVs take the native multithreaded C++ parser
+(``xgboost_ray_tpu/native/fast_csv.cpp``) when it is built and no
+pandas-specific kwargs are requested; anything else falls back to
+``pandas.read_csv``.
 """
 
 from typing import Any, List, Optional, Sequence, Union
@@ -9,6 +14,20 @@ from typing import Any, List, Optional, Sequence, Union
 import pandas as pd
 
 from xgboost_ray_tpu.data_sources.data_source import DataSource, RayFileType
+
+
+def _read_one(path: str, **kwargs) -> pd.DataFrame:
+    if not kwargs:
+        try:
+            from xgboost_ray_tpu import native
+
+            result = native.read_csv_numpy(path)
+        except Exception:  # noqa: BLE001 - native path is best-effort
+            result = None
+        if result is not None:
+            matrix, names = result
+            return pd.DataFrame(matrix, columns=names, copy=False)
+    return pd.read_csv(path, **kwargs)
 
 
 def _is_csv_path(p: Any) -> bool:
@@ -44,10 +63,10 @@ class CSV(DataSource):
             files = list(data)
             if indices is not None:
                 files = [files[i] for i in indices]
-            frames = [pd.read_csv(f, **kwargs) for f in files]
+            frames = [_read_one(f, **kwargs) for f in files]
             df = pd.concat(frames, ignore_index=True) if len(frames) > 1 else frames[0]
         else:
-            df = pd.read_csv(data, **kwargs)
+            df = _read_one(data, **kwargs)
             if indices is not None:
                 df = df.iloc[list(indices)]
         if ignore:

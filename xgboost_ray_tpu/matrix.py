@@ -301,6 +301,12 @@ class _DistributedRayDMatrixLoader(_RayDMatrixLoader):
         (``matrix.py:595-612`` + ``_distributed.py:24-112``)."""
         data = self._expand()
         source = self.get_data_source()
+        # distributed-frame sources (modin/dask/ray.data) provide their own
+        # partition objects + locality assignment
+        _, assignment = source.get_actor_shards(data, list(range(num_actors)))
+        if assignment:
+            self.actor_shards = assignment
+            return
         n_parts = source.get_n(data)
         hosts = get_actor_rank_hosts(num_actors)
         host_to_parts = {"localhost": list(range(n_parts))}
@@ -385,6 +391,16 @@ class RayDMatrix:
         self.refs: Dict[int, Dict[str, Optional[np.ndarray]]] = {}
         self.n: Optional[int] = None
         self.loaded = False
+
+        # distributed-frame sources pin partitions to ranks: FIXED sharding
+        # is set automatically (reference matrix.py:106-124 docstring)
+        if distributed:
+            try:
+                source = self.loader.get_data_source()
+                if getattr(source, "__name__", "") in ("Modin", "Dask", "RayDataset"):
+                    self.sharding = RayShardingMode.FIXED
+            except ValueError:
+                pass  # source resolution errors surface at load time
 
         if num_actors is not None and not lazy:
             self.load_data(num_actors)

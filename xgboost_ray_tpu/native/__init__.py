@@ -1,0 +1,134 @@
+"""Native (C++) host-side components, bound via ctypes.
+
+The reference leans on native code for host data paths (pandas C parsers,
+xgboost's C++ DMatrix ingestion); this package holds our equivalents.
+Currently: ``fast_csv`` — a multithreaded CSV -> float32 parser used by the
+CSV data source when available. Built lazily with g++ on first use; every
+entry point degrades gracefully to the pandas path if the toolchain or the
+build is unavailable.
+"""
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "fast_csv.cpp")
+_LIB_PATH = os.path.join(_HERE, "libfastcsv.so")
+
+_lock = threading.Lock()
+_lib = None
+_load_failed = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        "-o", _LIB_PATH, _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception as exc:  # noqa: BLE001 - fall back to pandas
+        logger.debug("fast_csv build failed: %s", exc)
+        return False
+
+
+def _load():
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if os.environ.get("RXGB_DISABLE_NATIVE_CSV"):
+            _load_failed = True
+            return None
+        if not os.path.exists(_LIB_PATH) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
+        ):
+            if not _build():
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as exc:
+            logger.debug("fast_csv load failed: %s", exc)
+            _load_failed = True
+            return None
+        lib.fcsv_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.fcsv_open.restype = ctypes.c_int64
+        lib.fcsv_rows.argtypes = [ctypes.c_int64]
+        lib.fcsv_rows.restype = ctypes.c_int64
+        lib.fcsv_cols.argtypes = [ctypes.c_int64]
+        lib.fcsv_cols.restype = ctypes.c_int64
+        lib.fcsv_header.argtypes = [ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64]
+        lib.fcsv_header.restype = ctypes.c_int64
+        lib.fcsv_parse.argtypes = [
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int,
+        ]
+        lib.fcsv_parse.restype = ctypes.c_int
+        lib.fcsv_close.argtypes = [ctypes.c_int64]
+        lib.fcsv_close.restype = None
+        _lib = lib
+        return _lib
+
+
+def native_csv_available() -> bool:
+    return _load() is not None
+
+
+def read_csv_numpy(
+    path: str, n_threads: int = 0
+) -> Optional[Tuple[np.ndarray, List[str]]]:
+    """Parse a (numeric, comma-separated, headered) CSV into float32.
+
+    Returns (matrix [rows, cols], column names), or None when the native
+    parser is unavailable or the file isn't eligible (e.g. gzip) — callers
+    fall back to pandas.
+    """
+    if path.endswith(".gz"):
+        return None
+    lib = _load()
+    if lib is None:
+        return None
+    handle = lib.fcsv_open(path.encode(), 1)
+    if handle == 0:
+        return None
+    try:
+        rows = lib.fcsv_rows(handle)
+        cols = lib.fcsv_cols(handle)
+        if rows < 0 or cols <= 0:
+            return None
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = lib.fcsv_header(handle, buf, len(buf))
+        if n < 0:
+            return None
+        names = buf.value.decode("utf-8", errors="replace").split("\n") if n else []
+        if len(names) != cols:
+            return None
+        # header must be non-numeric, otherwise this was a headerless file
+        # and pandas semantics differ — fall back
+        for name in names:
+            try:
+                float(name)
+                return None
+            except ValueError:
+                pass
+        out = np.empty((rows, cols), dtype=np.float32)
+        rc = lib.fcsv_parse(
+            handle, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n_threads
+        )
+        if rc != 0:
+            return None
+        return out, names
+    finally:
+        lib.fcsv_close(handle)
