@@ -377,3 +377,32 @@ def test_feature_weights_accepted_and_stored():
     dtrain = RayDMatrix(x, y, feature_weights=fw)
     bst = train(_PARAMS, dtrain, 5, ray_params=RayParams(num_actors=2))
     assert bst.num_boosted_rounds() == 5
+
+
+def test_batched_rounds_match_per_round_path():
+    """The lax.scan fast path (no callbacks) must produce exactly the same
+    model and metrics as per-round stepping (forced via a no-op callback)."""
+    rng = np.random.RandomState(9)
+    x = rng.randn(300, 5).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+
+    class Noop(TrainingCallback):
+        pass
+
+    er1, er2 = {}, {}
+    dtrain1 = RayDMatrix(x, y)
+    bst1 = train(_PARAMS, dtrain1, 8, evals=[(dtrain1, "train")],
+                 evals_result=er1,
+                 ray_params=RayParams(num_actors=2, checkpoint_frequency=3))
+    dtrain2 = RayDMatrix(x, y)
+    bst2 = train(_PARAMS, dtrain2, 8, evals=[(dtrain2, "train")],
+                 evals_result=er2,
+                 ray_params=RayParams(num_actors=2, checkpoint_frequency=3),
+                 callbacks=[Noop()])
+    np.testing.assert_allclose(
+        bst1.predict(x, output_margin=True),
+        bst2.predict(x, output_margin=True), atol=1e-5,
+    )
+    np.testing.assert_allclose(er1["train"]["logloss"], er2["train"]["logloss"],
+                               atol=1e-6)
+    assert len(er1["train"]["logloss"]) == 8

@@ -217,3 +217,44 @@ def test_ranking_gradients_point_the_right_way():
     assert g[0] < g[1] < g[2]  # most relevant gets most negative grad (pushed up)
     assert g[3] < g[4]
     assert np.all(np.asarray(h) > 0)
+
+
+def test_hist_partition_matches_scatter():
+    from xgboost_ray_tpu.ops.histogram import hist_partition
+
+    rng = np.random.RandomState(7)
+    n, f, nb = 700, 6, 8
+    bins = rng.randint(0, nb + 1, size=(n, f)).astype(np.uint8)
+    gh = rng.randn(n, 2).astype(np.float32)
+    for n_nodes in (1, 4, 16):
+        pos = rng.randint(0, n_nodes, size=n).astype(np.int32)
+        ref = np.asarray(
+            hist_scatter(jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(pos),
+                         n_nodes, nb + 1)
+        )
+        out = np.asarray(
+            hist_partition(jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(pos),
+                           n_nodes, nb + 1, block=32, block_chunk=8)
+        )
+        np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_hist_partition_skewed_nodes():
+    from xgboost_ray_tpu.ops.histogram import hist_partition
+
+    rng = np.random.RandomState(8)
+    n, f, nb, n_nodes = 500, 3, 4, 8
+    bins = rng.randint(0, nb + 1, size=(n, f)).astype(np.uint8)
+    gh = rng.randn(n, 2).astype(np.float32)
+    # extreme skew: almost everything in node 0, some nodes empty
+    pos = np.zeros(n, np.int32)
+    pos[:20] = rng.randint(1, n_nodes, size=20)
+    ref = np.asarray(
+        hist_scatter(jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(pos),
+                     n_nodes, nb + 1)
+    )
+    out = np.asarray(
+        hist_partition(jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(pos),
+                       n_nodes, nb + 1, block=64, block_chunk=4)
+    )
+    np.testing.assert_allclose(out, ref, atol=1e-4)

@@ -117,3 +117,32 @@ def test_multiple_eval_metrics_recorded(xy):
     assert set(evals_result["train"]) == {"logloss", "error", "auc"}
     assert len(evals_result["train"]["auc"]) == 4
     assert evals_result["train"]["auc"][-1] > 0.95
+
+
+def test_legacy_function_callback(xy):
+    """Function-style callback(env) support (reference compat/__init__.py)."""
+    x, y = xy
+    seen = []
+
+    def legacy_cb(env):
+        seen.append((env.iteration, dict(env.evaluation_result_list)))
+
+    dtrain = RayDMatrix(x, y)
+    train({"objective": "binary:logistic", "eval_metric": ["error"]},
+          dtrain, 3, evals=[(dtrain, "train")], callbacks=[legacy_cb],
+          ray_params=RP)
+    assert [i for i, _ in seen] == [0, 1, 2]
+    assert "train-error" in seen[-1][1]
+
+
+def test_profiling_round_times(xy, monkeypatch, tmp_path):
+    x, y = xy
+    monkeypatch.setenv("RXGB_PROFILE_DIR", str(tmp_path))
+    dtrain = RayDMatrix(x, y)
+    additional = {}
+    train({"objective": "binary:logistic"}, dtrain, 4,
+          additional_results=additional, ray_params=RP)
+    assert len(additional["round_times_s"]) == 4
+    assert all(t >= 0 for t in additional["round_times_s"])
+    import os
+    assert any(os.scandir(str(tmp_path)))  # a trace was written

@@ -1,0 +1,89 @@
+"""Multi-host distributed backend: the rendezvous layer.
+
+The reference's communication backend is a Python Rabit tracker (TCP
+rendezvous + binomial-tree/ring topology brokering,
+``xgboost_ray/compat/tracker.py``, ``main.py:225-324``). On TPU there is no
+tracker to build: rendezvous is ``jax.distributed.initialize`` (one process
+per host), after which ``jax.devices()`` is the global device list, the
+training mesh spans all hosts, and the per-round histogram ``psum`` compiles
+onto ICI within a slice and DCN across slices (SURVEY §5.8).
+
+This module is the thin, user-facing wrapper for that flow plus the helpers
+the engine uses to place host-local shard data into globally-sharded arrays.
+"""
+
+import logging
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> None:
+    """Join the multi-host world (call once per host before train()).
+
+    On TPU pods with default provisioning, all arguments are auto-detected by
+    JAX; arguments exist for manual/DCN setups. Replaces the reference's
+    tracker bootstrap: there is no port brokering and no restart-per-attempt —
+    world changes are handled by recompiling for the surviving mesh
+    (``xgboost_ray/main.py:256-270`` motivates the reference's restart; see
+    SURVEY §5.8 for the mapping).
+    """
+    global _initialized
+    if _initialized:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+    logger.info(
+        "[RayXGBoost] joined distributed world: process %d/%d, %d local / %d "
+        "global devices",
+        jax.process_index(),
+        jax.process_count(),
+        len(jax.local_devices()),
+        len(jax.devices()),
+    )
+
+
+def shutdown_distributed() -> None:
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def put_rows_global(arr: np.ndarray, sharding) -> jax.Array:
+    """Place row data into a globally row-sharded array.
+
+    Single-host: a plain ``device_put``. Multi-host: ``arr`` is this
+    process's *local* rows (the shards of the ranks whose mesh devices live
+    on this host, already padded to the local extent), assembled into the
+    global array without any cross-host copy —
+    ``jax.make_array_from_process_local_data`` is the DCN-era replacement for
+    shipping shards through an object store.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_process_local_data(sharding, arr)

@@ -155,12 +155,16 @@ class TpuEngine:
         pad_to = -(-max(self.n_rows, self.n_devices) // self.n_devices) * self.n_devices
         self._row_sharding = NamedSharding(self.mesh, P("actors"))
 
+        from xgboost_ray_tpu.distributed import put_rows_global
+
         def put_rows(arr, dtype, fill=0):
             arr = np.asarray(arr, dtype=dtype)
             if arr.shape[0] < pad_to:
                 pad_width = [(0, pad_to - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
                 arr = np.pad(arr, pad_width, constant_values=fill)
-            return jax.device_put(arr, self._row_sharding)
+            # multi-host: arr holds this process's local rows and is assembled
+            # into the global sharded array without cross-host copies
+            return put_rows_global(arr, self._row_sharding)
 
         self._put_rows = put_rows
         self.pad_to = pad_to
@@ -201,6 +205,7 @@ class TpuEngine:
         self.trees: List[Tree] = []  # host-side forest, one [K*T, heap] entry per round
         self._step_fn = None
         self._step_fn_custom = None
+        self._scan_fn = None
         self.iteration_offset = (
             init_booster.num_boosted_rounds() if init_booster is not None else 0
         )
@@ -283,12 +288,14 @@ class TpuEngine:
         )
         pad_to = -(-max(x.shape[0], self.n_devices) // self.n_devices) * self.n_devices
 
+        from xgboost_ray_tpu.distributed import put_rows_global
+
         def put_rows(arr, dtype, fill=0):
             arr = np.asarray(arr, dtype=dtype)
             if arr.shape[0] < pad_to:
                 pad_width = [(0, pad_to - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
                 arr = np.pad(arr, pad_width, constant_values=fill)
-            return jax.device_put(arr, self._row_sharding)
+            return put_rows_global(arr, self._row_sharding)
 
         x_dev = put_rows(x, np.float32, fill=np.nan)
         es.bins = self._bin_with_cuts(x_dev)
@@ -311,7 +318,10 @@ class TpuEngine:
         self.evals.append(es)
 
     # ------------------------------------------------------------------
-    def _make_step(self, custom: bool):
+    def _round_closures(self):
+        """The shared traced round body used by both the per-round step and
+        the lax.scan multi-round path — one definition so sampling/tree
+        semantics cannot diverge between the two compiled programs."""
         cfg = self.cfg
         params = self.params
         k_out = self.n_outputs
@@ -323,10 +333,12 @@ class TpuEngine:
         n_evals_dev = sum(1 for e in self.evals if not e.is_train)
         psum = lambda x: jax.lax.psum(x, "actors")
 
-        def tree_round(bins, valid, label, weight, margins, group_rows, gh_in, rng,
-                       eval_bins, eval_margins):
+        def tree_round(bins, valid, label, weight, margins, group_rows, gh_in,
+                       rng, eval_bins, eval_margins):
+            """One boosting round; gh_in is None unless a custom objective
+            supplied precomputed gradients."""
             w_eff = weight * valid.astype(jnp.float32)
-            if custom:
+            if gh_in is not None:
                 g, h = gh_in
             elif is_ranking:
                 g, h = obj.grad_hess_ranked(margins, label, w_eff, group_rows)
@@ -377,20 +389,13 @@ class TpuEngine:
             forest = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
             return new_margins, tuple(new_eval_margins), forest
 
-        def step(bins, valid, label, weight, margins, group_rows, gh_in, rng,
-                 eval_data):
-            eval_bins = tuple(d[0] for d in eval_data)
-            eval_margins = tuple(d[4] for d in eval_data)
-            new_margins, new_eval_margins, forest = tree_round(
-                bins, valid, label, weight, margins, group_rows, gh_in, rng,
-                eval_bins, eval_margins,
-            )
-            # device metric contributions, computed post-update
+        def metric_contribs(new_margins, new_eval_margins, label, w_eff, eval_data):
+            """Post-update psum'd (num, den) pairs per eval set x metric."""
             contribs = []
             ei = 0
             for es in self.evals:
                 if es.is_train:
-                    m, lab, w = new_margins, label, weight * valid.astype(jnp.float32)
+                    m, lab, w = new_margins, label, w_eff
                 else:
                     _, elab, ew, evalid, _ = eval_data[ei]
                     m, lab, w = (
@@ -398,14 +403,32 @@ class TpuEngine:
                         elab,
                         ew * evalid.astype(jnp.float32),
                     )
-                if not es.is_train:
                     ei += 1
                 set_contribs = []
                 for name in dev_metrics:
                     num, den = elementwise_contrib(name, m, lab, w)
                     set_contribs.append((psum(num), psum(den)))
                 contribs.append(tuple(set_contribs))
-            return new_margins, new_eval_margins, forest, tuple(contribs)
+            return tuple(contribs)
+
+        return tree_round, metric_contribs
+
+    def _make_step(self, custom: bool):
+        tree_round, metric_contribs = self._round_closures()
+
+        def step(bins, valid, label, weight, margins, group_rows, gh_in, rng,
+                 eval_data):
+            eval_bins = tuple(d[0] for d in eval_data)
+            eval_margins = tuple(d[4] for d in eval_data)
+            new_margins, new_eval_margins, forest = tree_round(
+                bins, valid, label, weight, margins, group_rows,
+                gh_in if custom else None, rng, eval_bins, eval_margins,
+            )
+            contribs = metric_contribs(
+                new_margins, new_eval_margins, label,
+                weight * valid.astype(jnp.float32), eval_data,
+            )
+            return new_margins, new_eval_margins, forest, contribs
 
         eval_specs = tuple(
             (P("actors"), P("actors"), P("actors"), P("actors"), P("actors"))
@@ -430,13 +453,139 @@ class TpuEngine:
                 P("actors"),
                 tuple(P("actors") for _ in eval_specs),
                 P(),
-                tuple(tuple((P(), P()) for _ in dev_metrics) for _ in self.evals),
+                tuple(
+                    tuple((P(), P()) for _ in self._device_metrics)
+                    for _ in self.evals
+                ),
             ),
             check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=(4,))
 
     # ------------------------------------------------------------------
+    def _make_scan_step(self):
+        """Multi-round variant: lax.scan over the round body inside one
+        shard_map program. Removes per-round host dispatch — the TPU analog
+        of the reference keeping its hot loop inside ``xgb.train``
+        (``xgboost_ray/main.py:745-752``) instead of stepping from Python.
+        Only built when no per-round host interaction is needed (no custom
+        objective, no host-side metrics)."""
+        tree_round, metric_contribs = self._round_closures()
+        seed_key = jax.random.PRNGKey(self.params.seed)
+
+        def run(bins, valid, label, weight, margins, group_rows, iterations,
+                eval_data):
+            eval_bins = tuple(d[0] for d in eval_data)
+            eval_margins0 = tuple(d[4] for d in eval_data)
+
+            def scan_body(carry, iteration):
+                margins_c, eval_margins_c = carry
+                rng = jax.random.fold_in(seed_key, iteration)
+                new_margins, new_eval_margins, forest = tree_round(
+                    bins, valid, label, weight, margins_c, group_rows, None,
+                    rng, eval_bins, eval_margins_c,
+                )
+                contribs = metric_contribs(
+                    new_margins, new_eval_margins, label,
+                    weight * valid.astype(jnp.float32), eval_data,
+                )
+                return (new_margins, new_eval_margins), (forest, contribs)
+
+            (margins_out, eval_margins_out), (forests, contribs) = jax.lax.scan(
+                scan_body, (margins, eval_margins0), iterations
+            )
+            return margins_out, eval_margins_out, forests, contribs
+
+        eval_specs = tuple(
+            (P("actors"), P("actors"), P("actors"), P("actors"), P("actors"))
+            for e in self.evals
+            if not e.is_train
+        )
+        mapped = shard_map(
+            run,
+            mesh=self.mesh,
+            in_specs=(
+                P("actors"),
+                P("actors"),
+                P("actors"),
+                P("actors"),
+                P("actors"),
+                P("actors") if self.group_rows is not None else P(),
+                P(),  # iterations
+                eval_specs,
+            ),
+            out_specs=(
+                P("actors"),
+                tuple(P("actors") for _ in eval_specs),
+                P(),
+                tuple(tuple((P(), P()) for _ in self._device_metrics) for _ in self.evals),
+            ),
+            check_vma=False,
+        )
+        return jax.jit(mapped, donate_argnums=(4,))
+
+    def can_batch_rounds(self) -> bool:
+        return not self._host_metrics
+
+    def step_many(self, iteration0: int, n_rounds: int) -> List[Dict[str, Dict[str, float]]]:
+        """Run ``n_rounds`` boosting rounds in one compiled program.
+
+        Returns the per-round metrics list (same schema as ``step``).
+        Programs are cached per n_rounds; callers should use a fixed chunk
+        size (e.g. checkpoint_frequency) to avoid recompiles.
+        """
+        if not self.can_batch_rounds():
+            raise RuntimeError("host-side metrics require per-round stepping")
+        if self._scan_fn is None:
+            self._scan_fn = self._make_scan_step()
+        iterations = jnp.arange(
+            self.iteration_offset + iteration0,
+            self.iteration_offset + iteration0 + n_rounds,
+        )
+        eval_data = tuple(
+            (es.bins, es.label, es.weight, es.valid, es.margins)
+            for es in self.evals
+            if not es.is_train
+        )
+        group_rows = (
+            self.group_rows if self.group_rows is not None else jnp.zeros((), jnp.int32)
+        )
+        new_margins, new_eval_margins, forests, contribs = self._scan_fn(
+            self.bins,
+            self.valid,
+            self.label_dev,
+            self.weight_dev,
+            self.margins,
+            group_rows,
+            iterations,
+            eval_data,
+        )
+        self.margins = new_margins
+        ei = 0
+        for es in self.evals:
+            if not es.is_train:
+                es.margins = new_eval_margins[ei]
+                ei += 1
+        forests_np = jax.tree.map(np.asarray, forests)  # [n, K*T, heap] fields
+        for r in range(n_rounds):
+            self.trees.append(jax.tree.map(lambda a: a[r], forests_np))
+
+        results: List[Dict[str, Dict[str, float]]] = []
+        contribs_np = jax.tree.map(np.asarray, contribs)
+        for r in range(n_rounds):
+            round_res: Dict[str, Dict[str, float]] = {}
+            for si, es in enumerate(self.evals):
+                row: Dict[str, float] = {}
+                for mi, name in enumerate(self._device_metrics):
+                    num = float(contribs_np[si][mi][0][r])
+                    den = float(contribs_np[si][mi][1][r])
+                    val = num / max(den, 1e-12)
+                    base, _ = parse_metric_name(name)
+                    row[name] = float(np.sqrt(val)) if base == "rmse" else val
+                round_res[es.name] = row
+            results.append(round_res)
+        return results
+
     def step(self, iteration: int, gh_custom=None) -> Dict[str, Dict[str, float]]:
         """Run one boosting round; returns {eval_name: {metric: value}}."""
         custom = gh_custom is not None

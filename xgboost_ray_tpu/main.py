@@ -79,6 +79,9 @@ class _XGBoostEnv:
     USE_SPREAD_STRATEGY: bool = True
     PLACEMENT_GROUP_TIMEOUT_S: int = 100
     STATUS_FREQUENCY_S: int = 30
+    # when set, wrap each training attempt in a jax.profiler trace written
+    # to this directory (xprof/tensorboard-compatible) — SURVEY §5.1 upgrade
+    PROFILE_DIR: str = ""
     ELASTIC_RESTART_DISABLED: bool = False
     ELASTIC_RESTART_RESOURCE_CHECK_S: float = 30.0
     ELASTIC_RESTART_GRACE_PERIOD_S: float = 10.0
@@ -293,6 +296,17 @@ def _handle_queue(queue: Queue, checkpoint: _Checkpoint, callback_returns: Dict)
             callback_returns.setdefault(rank, []).append(item)
 
 
+def _stop_profile_if_running():
+    if not ENV.PROFILE_DIR:
+        return
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+    except Exception:  # noqa: BLE001 - no trace running
+        pass
+
+
 class _FauxDMatrix:
     """Lightweight stand-in passed to custom objective/metric callables,
     exposing the xgboost DMatrix accessors they use."""
@@ -496,12 +510,94 @@ def _train(
     checkpoint_frequency = ray_params.checkpoint_frequency
     train_started = time.time()
     state.training_started_at = train_started
+    profile_dir = ENV.PROFILE_DIR
+    if profile_dir:
+        import jax
+
+        _stop_profile_if_running()  # clear any trace leaked by a prior abort
+        jax.profiler.start_trace(profile_dir)
+    round_times = state.additional_results.setdefault("round_times_s", [])
     stop_requested = False
     last_status = time.time()
 
     for model_cb in callbacks:
         if hasattr(model_cb, "before_training"):
             model_cb.before_training(proxy)
+
+    # Fast path: no per-round host interaction needed -> run whole
+    # checkpoint intervals as single compiled multi-round programs
+    # (lax.scan inside shard_map; see engine.step_many).
+    use_batched = (
+        not callbacks
+        and obj is None
+        and feval is None
+        and early_stopping_rounds is None
+        and engine.can_batch_rounds()
+        and boost_rounds_left > 1
+    )
+    if use_batched:
+        chunk = checkpoint_frequency if checkpoint_frequency else boost_rounds_left
+        completed = 0
+        while completed < boost_rounds_left:
+            if state.stop_event.is_set():
+                raise RayXGBoostTrainingStopped("Training was aborted.")
+            n = min(chunk, boost_rounds_left - completed)
+            chunk_started = time.time()
+            chunk_results = engine.step_many(completed, n)
+            round_times.extend([(time.time() - chunk_started) / n] * n)
+            for round_metrics in chunk_results:
+                for set_name, metrics in round_metrics.items():
+                    for metric_name, value in metrics.items():
+                        evals_result.setdefault(set_name, {}).setdefault(
+                            metric_name, []
+                        ).append(value)
+            completed += n
+            if verbose_eval and evals_result:
+                flat = "\t".join(
+                    f"{sn}-{mn}:{v[-1]:.5f}"
+                    for sn, ms in evals_result.items()
+                    for mn, v in ms.items()
+                )
+                print(f"[{completed - 1}]\t{flat}")
+            if checkpoint_frequency:
+                booster = engine.get_booster()
+                iteration = engine.iteration_offset + completed - 1
+                state.queue.put(
+                    (0, _Checkpoint(iteration, _serialize_booster(booster)))
+                )
+            _handle_queue(state.queue, state.checkpoint, callback_returns)
+            if ray_params.elastic_training and not ENV.ELASTIC_RESTART_DISABLED:
+                elastic_mod._maybe_schedule_new_actors(
+                    training_state=state,
+                    num_cpus_per_actor=ray_params.cpus_per_actor,
+                    num_gpus_per_actor=max(0, ray_params.gpus_per_actor),
+                    resources_per_actor=ray_params.resources_per_actor,
+                    ray_params=ray_params,
+                    load_data=[dtrain] + [e[0] for e in evals],
+                )
+                elastic_mod._update_scheduled_actor_states(state)
+            if time.time() - last_status > ENV.STATUS_FREQUENCY_S:
+                logger.info(
+                    f"[RayXGBoost] Training in progress "
+                    f"({time.time() - train_started:.0f}s, round {completed})."
+                )
+                last_status = time.time()
+
+        booster = engine.get_booster()
+        for actor in alive:
+            actor._distributed_callbacks.after_train(
+                actor, {"evals_result": evals_result}
+            )
+        _handle_queue(state.queue, state.checkpoint, callback_returns)
+        state.additional_results["callback_returns"] = callback_returns
+        _stop_profile_if_running()
+        train_time = time.time() - train_started
+        return booster, evals_result, {
+            "train_n": total_n,
+            "training_time_s": train_time,
+            "stopped_early": False,
+            "completed_rounds": completed,
+        }
 
     completed = 0
     for i in range(boost_rounds_left):
@@ -512,6 +608,7 @@ def _train(
             if hasattr(model_cb, "before_iteration"):
                 model_cb.before_iteration(proxy, i, evals_result)
 
+        round_started = time.time()
         gh_custom = None
         if obj is not None:
             margins = engine.get_margins()
@@ -522,6 +619,7 @@ def _train(
 
         round_metrics = engine.step(i, gh_custom=gh_custom)
         completed += 1
+        round_times.append(time.time() - round_started)
 
         # custom metric (feval) computed on gathered margins per eval set
         if feval is not None:
@@ -620,6 +718,7 @@ def _train(
 
     _handle_queue(state.queue, state.checkpoint, callback_returns)
     state.additional_results["callback_returns"] = callback_returns
+    _stop_profile_if_running()
 
     train_time = time.time() - train_started
     return booster, evals_result, {
@@ -680,9 +779,10 @@ def train(
 
     # Tune integration: auto-inject the report/checkpoint callback when
     # running inside a tuning session (mirror main.py:1477-1480)
-    kwargs_callbacks = list(kwargs.get("callbacks") or [])
+    from xgboost_ray_tpu.compat import wrap_callbacks
     from xgboost_ray_tpu import tune as tune_mod
 
+    kwargs_callbacks = wrap_callbacks(kwargs.get("callbacks"), num_boost_round)
     kwargs_callbacks = tune_mod._try_add_tune_callback(kwargs_callbacks)
 
     parsed = parse_params(params)  # early validation (tree_method etc.)
@@ -769,6 +869,7 @@ def train(
             total_training_time += stats["training_time_s"]
             break
         except RayXGBoostActorAvailable as exc:
+            _stop_profile_if_running()
             # elastic reintegration: free restart (mirror main.py:1661-1673)
             logger.info(f"[RayXGBoost] {exc} Restarting from checkpoint with "
                         f"reintegrated workers.")
@@ -778,6 +879,7 @@ def train(
             _rewire_actors(state)
             continue
         except (RayActorError, RayTaskError) as exc:
+            _stop_profile_if_running()
             if state.training_started_at:
                 total_training_time += time.time() - state.training_started_at
                 state.training_started_at = 0.0
@@ -816,6 +918,11 @@ def train(
             _rewire_actors(state)
             tries += 1
             continue
+        except BaseException:
+            # any other exit (user abort, unexpected error): don't leak a
+            # running profiler trace into the next train() call
+            _stop_profile_if_running()
+            raise
 
     if booster is None:
         # all rounds were already covered by the checkpoint

@@ -91,6 +91,85 @@ def hist_onehot(
     return acc.reshape(num_features, n_nodes, n_bins_total, 2).transpose(1, 0, 2, 3)
 
 
+def hist_partition(
+    bins: jnp.ndarray,
+    gh: jnp.ndarray,
+    pos: jnp.ndarray,
+    n_nodes: int,
+    n_bins_total: int,
+    block: int = 256,
+    block_chunk: int = 512,
+) -> jnp.ndarray:
+    """Node-contiguous blocked histogram — the deep-level TPU workhorse.
+
+    The one-hot-matmul formulation costs rows x nodes x bins FLOPs (the node
+    axis rides in the one-hot width), which explodes at deep levels. This
+    variant first *partitions rows by node* (stable sort + padded segment
+    layout, the XLA analog of gpu_hist's row partitioner), so every
+    ``block``-row tile belongs to exactly one node and the per-tile matmul is
+    only [bins x block] @ [block x 2]: total FLOPs ~ rows x bins x features,
+    independent of the node count. The final per-block scatter touches
+    O(n_blocks) elements only.
+    """
+    n, num_features = bins.shape
+    b32 = bins.astype(jnp.int32)
+    order = jnp.argsort(pos, stable=True)
+    pos_s = pos[order]
+    counts = jnp.bincount(pos, length=n_nodes)
+    seg_start = jnp.concatenate(
+        [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]]
+    )
+    padded_counts = ((counts + block - 1) // block) * block
+    padded_cum = jnp.cumsum(padded_counts)
+    padded_start = jnp.concatenate(
+        [jnp.zeros((1,), padded_cum.dtype), padded_cum[:-1]]
+    )
+    rank_in_node = jnp.arange(n) - seg_start[pos_s]
+    dest = (padded_start[pos_s] + rank_in_node).astype(jnp.int32)
+
+    cap = (-(-n // block) + n_nodes) * block  # static upper bound on slots
+    n_blocks = cap // block
+    row_of_slot = jnp.full((cap,), n, jnp.int32).at[dest].set(order.astype(jnp.int32))
+    node_of_block = jnp.clip(
+        jnp.searchsorted(padded_cum, jnp.arange(n_blocks) * block, side="right"),
+        0,
+        n_nodes,  # overflow blocks (all-sentinel) park in a scratch slot
+    )
+
+    bins_ext = jnp.concatenate([b32, jnp.zeros((1, num_features), jnp.int32)])
+    gh_ext = jnp.concatenate([gh, jnp.zeros((1, 2), gh.dtype)])
+    bp = bins_ext[row_of_slot].reshape(n_blocks, block, num_features)
+    ghp = gh_ext[row_of_slot].reshape(n_blocks, block, 2)
+
+    n_chunks = -(-n_blocks // block_chunk)
+    pad_blocks = n_chunks * block_chunk - n_blocks
+    if pad_blocks:
+        bp = jnp.pad(bp, ((0, pad_blocks), (0, 0), (0, 0)))
+        ghp = jnp.pad(ghp, ((0, pad_blocks), (0, 0), (0, 0)))
+        node_of_block = jnp.pad(node_of_block, (0, pad_blocks), constant_values=n_nodes)
+    bp = bp.reshape(n_chunks, block_chunk, block, num_features)
+    ghp = ghp.reshape(n_chunks, block_chunk, block, 2)
+    nodes_c = node_of_block.reshape(n_chunks, block_chunk)
+
+    def chunk_step(hist, args):
+        bc, gc, nodes = args  # [C, block, F], [C, block, 2], [C]
+
+        def feat_step(f, hist):
+            oh = jax.nn.one_hot(bc[:, :, f], n_bins_total, dtype=jnp.float32)
+            # [C, block, nbt]^T x [C, block, 2] -> [C, nbt, 2] per block
+            contrib = jnp.einsum(
+                "cbn,cbd->cnd", oh, gc, precision=jax.lax.Precision.HIGHEST
+            )
+            return hist.at[nodes, f].add(contrib)
+
+        hist = jax.lax.fori_loop(0, num_features, feat_step, hist)
+        return hist, None
+
+    hist0 = jnp.zeros((n_nodes + 1, num_features, n_bins_total, 2), jnp.float32)
+    hist, _ = jax.lax.scan(chunk_step, hist0, (bp, ghp, nodes_c))
+    return hist[:n_nodes]
+
+
 def node_sums(gh: jnp.ndarray, pos: jnp.ndarray, n_nodes: int) -> jnp.ndarray:
     """Per-node (grad, hess) totals: [n_nodes, 2] via segment-sum."""
     out = jnp.zeros((n_nodes, 2), jnp.float32)
@@ -108,6 +187,14 @@ def build_histogram(
 ) -> jnp.ndarray:
     if impl == "onehot":
         return hist_onehot(bins, gh, pos, n_nodes, n_bins_total, chunk=chunk)
+    if impl == "partition":
+        return hist_partition(bins, gh, pos, n_nodes, n_bins_total)
+    if impl == "mixed":
+        # shallow levels: node axis is cheap in the one-hot width; deep
+        # levels: row partitioning keeps FLOPs independent of node count
+        if n_nodes <= 4:
+            return hist_onehot(bins, gh, pos, n_nodes, n_bins_total, chunk=chunk)
+        return hist_partition(bins, gh, pos, n_nodes, n_bins_total)
     if impl == "pallas":
         try:
             from xgboost_ray_tpu.ops import hist_pallas
