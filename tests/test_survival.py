@@ -1,0 +1,80 @@
+"""survival:aft objective tests (label-bounds path end-to-end)."""
+
+import numpy as np
+import pytest
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+
+def _survival_data(n=400, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    # true log-time depends on features
+    log_t = 1.0 + 0.8 * x[:, 0] - 0.5 * x[:, 1] + 0.1 * rng.randn(n)
+    t = np.exp(log_t).astype(np.float32)
+    # right-censor 30% of rows at a random earlier time
+    censored = rng.rand(n) < 0.3
+    lower = t.copy()
+    upper = t.copy()
+    cens_time = (t * rng.uniform(0.3, 0.9, n)).astype(np.float32)
+    lower[censored] = cens_time[censored]
+    upper[censored] = np.inf
+    return x, lower, upper, t
+
+
+def test_aft_learns_survival_times():
+    x, lower, upper, t = _survival_data()
+    dtrain = RayDMatrix(x, label_lower_bound=lower, label_upper_bound=upper)
+    evals_result = {}
+    bst = train(
+        {"objective": "survival:aft", "eval_metric": ["aft-nloglik"],
+         "max_depth": 4, "eta": 0.3, "aft_loss_distribution": "normal",
+         "aft_loss_distribution_scale": 1.0},
+        dtrain, 30, evals=[(dtrain, "train")], evals_result=evals_result,
+        ray_params=RayParams(num_actors=2),
+    )
+    nll = evals_result["train"]["aft-nloglik"]
+    assert nll[-1] < nll[0]
+    pred = bst.predict(x)  # predicted survival times (exp of margin)
+    assert pred.shape == (400,)
+    assert np.all(pred > 0)
+    # predictions correlate with the true times
+    corr = np.corrcoef(np.log(pred), np.log(t))[0, 1]
+    assert corr > 0.8
+
+
+def test_aft_logistic_distribution_runs():
+    x, lower, upper, _ = _survival_data(seed=1)
+    dtrain = RayDMatrix(x, label_lower_bound=lower, label_upper_bound=upper)
+    bst = train(
+        {"objective": "survival:aft", "aft_loss_distribution": "logistic",
+         "eval_metric": ["aft-nloglik"], "max_depth": 3},
+        dtrain, 10, ray_params=RayParams(num_actors=2),
+    )
+    assert bst.num_boosted_rounds() == 10
+
+
+def test_aft_plain_label_is_uncensored():
+    rng = np.random.RandomState(2)
+    x = rng.randn(200, 3).astype(np.float32)
+    t = np.exp(1.0 + x[:, 0]).astype(np.float32)
+    dtrain = RayDMatrix(x, label=t)
+    bst = train({"objective": "survival:aft", "eval_metric": ["aft-nloglik"]},
+                dtrain, 15, ray_params=RayParams(num_actors=2))
+    pred = bst.predict(x)
+    assert np.corrcoef(np.log(pred), np.log(t))[0, 1] > 0.9
+
+
+def test_gamma_and_tweedie_objectives():
+    rng = np.random.RandomState(3)
+    x = rng.randn(300, 3).astype(np.float32)
+    mu = np.exp(0.5 + 0.8 * x[:, 0])
+    y = (mu * rng.gamma(2.0, 0.5, 300)).astype(np.float32)
+    for objective in ("reg:gamma", "reg:tweedie"):
+        dtrain = RayDMatrix(x, y)
+        bst = train({"objective": objective, "eval_metric": ["rmse"],
+                     "max_depth": 3, "eta": 0.2},
+                    dtrain, 20, ray_params=RayParams(num_actors=2))
+        pred = bst.predict(x)
+        assert np.all(pred > 0)
+        assert np.corrcoef(np.log(pred), np.log(mu))[0, 1] > 0.8, objective

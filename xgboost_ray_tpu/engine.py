@@ -70,6 +70,8 @@ class _EvalSet:
         self.n_rows = n_rows
         self.group_ptr = group_ptr
         self.is_train = is_train
+        self.lower_np = None
+        self.upper_np = None
         # set by engine when not aliased to the train set:
         self.bins = None
         self.label = None
@@ -108,10 +110,18 @@ class TpuEngine:
             params.objective
             if isinstance(params.objective, (CustomObjective,))
             else get_objective(
-                params.objective, params.num_class, params.scale_pos_weight
+                params.objective,
+                params.num_class,
+                params.scale_pos_weight,
+                tweedie_variance_power=params.tweedie_variance_power,
+                aft_loss_distribution=params.aft_loss_distribution,
+                aft_loss_distribution_scale=params.aft_loss_distribution_scale,
             )
         )
         self.is_ranking = isinstance(self.objective, RankingObjective)
+        from xgboost_ray_tpu.ops.survival import SurvivalObjective
+
+        self.is_survival = isinstance(self.objective, SurvivalObjective)
         self.n_outputs = self.objective.num_outputs
         base_score = (
             params.base_score
@@ -143,11 +153,17 @@ class TpuEngine:
         self._host_metrics = [m for m in names if not is_elementwise_metric(m)]
 
         # ---- host data assembly ------------------------------------------
-        x, label, weight, base_margin, qid = _concat_shards(shards)
+        x, label, weight, base_margin, qid, lo, hi = _concat_shards(shards)
+        if self.is_survival and lo is None and label is None:
+            raise ValueError(
+                "survival:aft requires label_lower_bound/label_upper_bound "
+                "(or a plain label, interpreted as uncensored times)."
+            )
         self.n_rows = x.shape[0]
         self.n_features = x.shape[1]
-        self.label_np = label
+        self.label_np = label if label is not None else lo
         self.weight_np = weight
+        self.lower_np, self.upper_np = lo, hi
         self.group_ptr = (
             None if qid is None else build_group_rows(qid)[1]
         )
@@ -174,6 +190,18 @@ class TpuEngine:
         self.weight_dev = put_rows(
             weight if weight is not None else np.ones(self.n_rows, np.float32), np.float32
         )
+        if self.is_survival:
+            if lo is None:
+                lo = label
+            if hi is None:
+                hi = lo
+            self.lower_np, self.upper_np = lo, hi
+            self.bounds_dev = (
+                put_rows(lo, np.float32, fill=1.0),
+                put_rows(hi, np.float32, fill=1.0),
+            )
+        else:
+            self.bounds_dev = None
 
         # ---- distributed sketch + binning (device, psum-merged) ----------
         self.bins, self.cuts = self._sketch_and_bin(x_dev, self.valid)
@@ -277,9 +305,11 @@ class TpuEngine:
             es = _EvalSet(name, self.n_rows, self.group_ptr, True)
             es.label_np = self.label_np
             es.weight_np = self.weight_np
+            es.lower_np = getattr(self, "lower_np", None)
+            es.upper_np = getattr(self, "upper_np", None)
             self.evals.append(es)
             return
-        x, label, weight, base_margin, qid = _concat_shards(eval_shards)
+        x, label, weight, base_margin, qid, lo, hi = _concat_shards(eval_shards)
         es = _EvalSet(
             name,
             x.shape[0],
@@ -304,8 +334,10 @@ class TpuEngine:
         es.weight = put_rows(
             weight if weight is not None else np.ones(x.shape[0], np.float32), np.float32
         )
-        es.label_np = label
+        es.label_np = label if label is not None else lo
         es.weight_np = weight
+        es.lower_np = lo if lo is not None else label
+        es.upper_np = hi if hi is not None else es.lower_np
         margins0 = np.full((x.shape[0], self.n_outputs), self.base_margin0, np.float32)
         if base_margin is not None:
             margins0 = margins0 + base_margin.reshape(x.shape[0], -1).astype(np.float32)
@@ -333,8 +365,10 @@ class TpuEngine:
         n_evals_dev = sum(1 for e in self.evals if not e.is_train)
         psum = lambda x: jax.lax.psum(x, "actors")
 
+        is_survival = self.is_survival
+
         def tree_round(bins, valid, label, weight, margins, group_rows, gh_in,
-                       rng, eval_bins, eval_margins):
+                       rng, bounds, eval_bins, eval_margins):
             """One boosting round; gh_in is None unless a custom objective
             supplied precomputed gradients."""
             w_eff = weight * valid.astype(jnp.float32)
@@ -342,6 +376,8 @@ class TpuEngine:
                 g, h = gh_in
             elif is_ranking:
                 g, h = obj.grad_hess_ranked(margins, label, w_eff, group_rows)
+            elif is_survival:
+                g, h = obj.grad_hess_bounds(margins, bounds[0], bounds[1], w_eff)
             else:
                 g, h = obj.grad_hess(margins, label, w_eff)
             new_margins = margins
@@ -417,12 +453,12 @@ class TpuEngine:
         tree_round, metric_contribs = self._round_closures()
 
         def step(bins, valid, label, weight, margins, group_rows, gh_in, rng,
-                 eval_data):
+                 bounds, eval_data):
             eval_bins = tuple(d[0] for d in eval_data)
             eval_margins = tuple(d[4] for d in eval_data)
             new_margins, new_eval_margins, forest = tree_round(
                 bins, valid, label, weight, margins, group_rows,
-                gh_in if custom else None, rng, eval_bins, eval_margins,
+                gh_in if custom else None, rng, bounds, eval_bins, eval_margins,
             )
             contribs = metric_contribs(
                 new_margins, new_eval_margins, label,
@@ -447,6 +483,7 @@ class TpuEngine:
                 P("actors") if self.group_rows is not None else P(),
                 (P("actors"), P("actors")) if custom else P(),
                 P(),  # rng
+                (P("actors"), P("actors")) if self.bounds_dev is not None else P(),
                 eval_specs,
             ),
             out_specs=(
@@ -474,7 +511,7 @@ class TpuEngine:
         seed_key = jax.random.PRNGKey(self.params.seed)
 
         def run(bins, valid, label, weight, margins, group_rows, iterations,
-                eval_data):
+                bounds, eval_data):
             eval_bins = tuple(d[0] for d in eval_data)
             eval_margins0 = tuple(d[4] for d in eval_data)
 
@@ -483,7 +520,7 @@ class TpuEngine:
                 rng = jax.random.fold_in(seed_key, iteration)
                 new_margins, new_eval_margins, forest = tree_round(
                     bins, valid, label, weight, margins_c, group_rows, None,
-                    rng, eval_bins, eval_margins_c,
+                    rng, bounds, eval_bins, eval_margins_c,
                 )
                 contribs = metric_contribs(
                     new_margins, new_eval_margins, label,
@@ -512,6 +549,7 @@ class TpuEngine:
                 P("actors"),
                 P("actors") if self.group_rows is not None else P(),
                 P(),  # iterations
+                (P("actors"), P("actors")) if self.bounds_dev is not None else P(),
                 eval_specs,
             ),
             out_specs=(
@@ -550,6 +588,7 @@ class TpuEngine:
         group_rows = (
             self.group_rows if self.group_rows is not None else jnp.zeros((), jnp.int32)
         )
+        bounds = self.bounds_dev if self.bounds_dev is not None else jnp.zeros((), jnp.float32)
         new_margins, new_eval_margins, forests, contribs = self._scan_fn(
             self.bins,
             self.valid,
@@ -558,6 +597,7 @@ class TpuEngine:
             self.margins,
             group_rows,
             iterations,
+            bounds,
             eval_data,
         )
         self.margins = new_margins
@@ -614,6 +654,7 @@ class TpuEngine:
             )
         else:
             gh_in = jnp.zeros((), jnp.float32)
+        bounds = self.bounds_dev if self.bounds_dev is not None else jnp.zeros((), jnp.float32)
         new_margins, new_eval_margins, forest, contribs = fn(
             self.bins,
             self.valid,
@@ -623,6 +664,7 @@ class TpuEngine:
             group_rows,
             gh_in,
             rng,
+            bounds,
             eval_data,
         )
         self.margins = new_margins
@@ -646,6 +688,18 @@ class TpuEngine:
             if self._host_metrics:
                 margin = self.get_margins(es)
                 for name in self._host_metrics:
+                    if name == "aft-nloglik":
+                        from xgboost_ray_tpu.ops import survival as survival_mod
+
+                        row[name] = survival_mod.aft_nloglik_np(
+                            margin,
+                            es.lower_np if es.lower_np is not None else self.lower_np,
+                            es.upper_np if es.upper_np is not None else self.upper_np,
+                            es.weight_np,
+                            distribution=self.params.aft_loss_distribution,
+                            sigma=self.params.aft_loss_distribution_scale,
+                        )
+                        continue
                     row[name] = compute_metric(
                         name,
                         margin,
@@ -704,6 +758,17 @@ def _concat_shards(shards):
             qs.append(np.asarray(q))
         else:
             qs.append(None)
+    lls, lus = [], []
+    has_ll = has_lu = False
+    for sh in shards:
+        ll = sh.get("label_lower_bound")
+        lu = sh.get("label_upper_bound")
+        if ll is not None:
+            has_ll = True
+        if lu is not None:
+            has_lu = True
+        lls.append(None if ll is None else np.asarray(ll, np.float32).ravel())
+        lus.append(None if lu is None else np.asarray(lu, np.float32).ravel())
     x = np.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
     y = np.concatenate(ys, axis=0) if len(ys) > 1 else ys[0]
     w = (np.concatenate(ws, axis=0) if len(ws) > 1 else ws[0]) if has_w else None
@@ -723,4 +788,20 @@ def _concat_shards(shards):
         q = np.concatenate(qs, axis=0) if len(qs) > 1 else qs[0]
     else:
         q = None
-    return x, y, w, b, q
+    if has_ll:
+        lls = [
+            l if l is not None else np.zeros(xi.shape[0], np.float32)
+            for l, xi in zip(lls, xs)
+        ]
+        ll = np.concatenate(lls, axis=0) if len(lls) > 1 else lls[0]
+    else:
+        ll = None
+    if has_lu:
+        lus = [
+            l if l is not None else np.full(xi.shape[0], np.inf, np.float32)
+            for l, xi in zip(lus, xs)
+        ]
+        lu = np.concatenate(lus, axis=0) if len(lus) > 1 else lus[0]
+    else:
+        lu = None
+    return x, y, w, b, q, ll, lu

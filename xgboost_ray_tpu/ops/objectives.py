@@ -133,13 +133,55 @@ def _make_poisson() -> Objective:
     )
 
 
+def _make_gamma() -> Objective:
+    # gamma deviance, log link: g = 1 - y*exp(-m), h = y*exp(-m)
+    def gh(margin, label, weight):
+        ym = label * jnp.exp(-jnp.clip(margin[:, 0], -30.0, 30.0))
+        g = (1.0 - ym) * weight
+        h = jnp.maximum(ym, 1e-6) * weight
+        return g[:, None], h[:, None]
+
+    return Objective(
+        name="reg:gamma",
+        grad_hess=gh,
+        transform=lambda m: jnp.exp(m[:, 0]),
+        default_metric="rmse",
+        base_score_to_margin=lambda s: float(jnp.log(jnp.maximum(s, 1e-16))),
+        default_base_score=0.5,
+    )
+
+
+def _make_tweedie(rho: float) -> Objective:
+    # tweedie deviance, log link (1 < rho < 2)
+    def gh(margin, label, weight):
+        m = jnp.clip(margin[:, 0], -30.0, 30.0)
+        a = label * jnp.exp((1.0 - rho) * m)
+        b = jnp.exp((2.0 - rho) * m)
+        g = (-a + b) * weight
+        h = jnp.maximum(-(1.0 - rho) * a + (2.0 - rho) * b, 1e-6) * weight
+        return g[:, None], h[:, None]
+
+    return Objective(
+        name="reg:tweedie",
+        grad_hess=gh,
+        transform=lambda m: jnp.exp(m[:, 0]),
+        default_metric="rmse",
+        base_score_to_margin=lambda s: float(jnp.log(jnp.maximum(s, 1e-16))),
+        default_base_score=0.5,
+    )
+
+
 RANKING_OBJECTIVES = ("rank:pairwise", "rank:ndcg", "rank:map")
+SURVIVAL_OBJECTIVES = ("survival:aft",)
 
 
 def get_objective(
     name: str,
     num_class: int = 0,
     scale_pos_weight: float = 1.0,
+    tweedie_variance_power: float = 1.5,
+    aft_loss_distribution: str = "normal",
+    aft_loss_distribution_scale: float = 1.0,
 ) -> Objective:
     """Resolve an xgboost objective string to an Objective bundle.
 
@@ -160,10 +202,20 @@ def get_objective(
         return _make_softmax(num_class, prob_output=(name == "multi:softprob"))
     if name == "count:poisson":
         return _make_poisson()
+    if name == "reg:gamma":
+        return _make_gamma()
+    if name == "reg:tweedie":
+        return _make_tweedie(tweedie_variance_power)
     if name in RANKING_OBJECTIVES:
         from xgboost_ray_tpu.ops import ranking
 
         return ranking.get_ranking_objective(name)
+    if name in SURVIVAL_OBJECTIVES:
+        from xgboost_ray_tpu.ops import survival
+
+        return survival.get_survival_objective(
+            name, aft_loss_distribution, aft_loss_distribution_scale
+        )
     raise ValueError(f"Unsupported objective: {name!r}")
 
 

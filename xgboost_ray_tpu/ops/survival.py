@@ -1,0 +1,164 @@
+"""Accelerated failure time (AFT) survival objective.
+
+Completes the label-bounds data path the reference carries end-to-end
+(``label_lower_bound``/``label_upper_bound`` through
+``xgboost_ray/matrix.py:283-358``) with the objective that consumes it:
+``survival:aft`` with normal/logistic error distributions, interval/right/
+left censoring, and the ``aft-nloglik`` metric.
+
+Model: log(T) = margin + sigma * Z. For an observation with bounds
+[t_lo, t_hi]: uncensored (t_lo == t_hi) uses the density, censored uses
+P(z_lo < Z < z_hi). Closed-form grad/hess w.r.t. the margin, hessians
+clamped for stability (same discipline as xgboost's AFT implementation).
+"""
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_EPS = 1e-12
+_SQRT2PI = float(np.sqrt(2.0 * np.pi))
+
+
+def _normal_pdf(z):
+    return jnp.exp(-0.5 * z * z) / _SQRT2PI
+
+
+def _normal_cdf(z):
+    return 0.5 * (1.0 + jax.lax.erf(z / np.sqrt(2.0)))
+
+
+def _logistic_pdf(z):
+    s = jax.nn.sigmoid(z)
+    return s * (1.0 - s)
+
+
+def _logistic_cdf(z):
+    return jax.nn.sigmoid(z)
+
+
+_DISTS = {
+    "normal": (_normal_pdf, _normal_cdf),
+    "logistic": (_logistic_pdf, _logistic_cdf),
+}
+
+
+def make_aft_grad_hess(distribution: str, sigma: float) -> Callable:
+    if distribution not in _DISTS:
+        raise ValueError(
+            f"aft_loss_distribution must be one of {sorted(_DISTS)}, got "
+            f"{distribution!r}"
+        )
+    pdf, cdf = _DISTS[distribution]
+
+    def grad_hess(margin, lower, upper, weight):
+        """margin [N, 1]; lower/upper raw times (upper may be +inf)."""
+        m = margin[:, 0]
+        log_lo = jnp.log(jnp.maximum(lower, _EPS))
+        z_lo = (log_lo - m) / sigma
+        uncensored = jnp.isfinite(upper) & (jnp.abs(upper - lower) < 1e-10)
+        right_censored = ~jnp.isfinite(upper)
+        z_hi = jnp.where(
+            right_censored, 0.0, (jnp.log(jnp.maximum(upper, _EPS)) - m) / sigma
+        )
+
+        # uncensored: L = -log pdf(z) + log(sigma t); dL/dm via autodiff-free forms
+        def uncensored_gh(z):
+            if distribution == "normal":
+                g = -z / sigma
+                h = jnp.ones_like(z) / (sigma * sigma)
+            else:  # logistic: -log pdf = z + 2 log(1+e^-z); d/dz = 1 - 2(1-s)
+                s = jax.nn.sigmoid(z)
+                g = -(2.0 * s - 1.0) / sigma
+                h = 2.0 * s * (1.0 - s) / (sigma * sigma)
+            return g, h
+
+        gu, hu = uncensored_gh(z_lo)
+
+        # censored: L = -log(F(z_hi) - F(z_lo));  dF/dm = -pdf/sigma
+        cdf_hi = jnp.where(right_censored, 1.0, cdf(z_hi))
+        pdf_hi = jnp.where(right_censored, 0.0, pdf(z_hi))
+        cdf_lo = cdf(z_lo)
+        pdf_lo = pdf(z_lo)
+        denom = jnp.maximum(cdf_hi - cdf_lo, _EPS)
+        gc = (pdf_hi - pdf_lo) / (sigma * denom)
+        # Gauss-Newton style hessian (positive, stable)
+        hc = jnp.maximum(
+            (pdf_lo - pdf_hi) ** 2 / (sigma * sigma * denom * denom),
+            1e-6,
+        )
+
+        g = jnp.where(uncensored, gu, gc) * weight
+        h = jnp.maximum(jnp.where(uncensored, hu, hc), 1e-6) * weight
+        return g[:, None], h[:, None]
+
+    return grad_hess
+
+
+def aft_nloglik_np(
+    margin: np.ndarray,
+    lower: np.ndarray,
+    upper: np.ndarray,
+    weight,
+    distribution: str = "normal",
+    sigma: float = 1.0,
+) -> float:
+    """Host-side mean negative log likelihood (metric ``aft-nloglik``)."""
+    from scipy import stats
+
+    m = np.asarray(margin, np.float64).reshape(-1)
+    lower = np.asarray(lower, np.float64)
+    upper = np.asarray(upper, np.float64)
+    w = np.ones_like(m) if weight is None else np.asarray(weight, np.float64)
+    dist = stats.norm if distribution == "normal" else stats.logistic
+    z_lo = (np.log(np.maximum(lower, _EPS)) - m) / sigma
+    uncensored = np.isfinite(upper) & (np.abs(upper - lower) < 1e-10)
+    nll = np.empty_like(m)
+    # uncensored: -log( pdf(z)/(sigma * t) )
+    nll[uncensored] = -(
+        dist.logpdf(z_lo[uncensored])
+        - np.log(sigma)
+        - np.log(np.maximum(lower[uncensored], _EPS))
+    )
+    cen = ~uncensored
+    cdf_hi = np.where(
+        np.isfinite(upper[cen]),
+        dist.cdf((np.log(np.maximum(upper[cen], _EPS)) - m[cen]) / sigma),
+        1.0,
+    )
+    nll[cen] = -np.log(np.maximum(cdf_hi - dist.cdf(z_lo[cen]), _EPS))
+    return float(np.sum(nll * w) / max(np.sum(w), _EPS))
+
+
+@dataclasses.dataclass(frozen=True)
+class SurvivalObjective:
+    """Objective consuming label bounds; the engine passes (lower, upper)."""
+
+    name: str
+    grad_hess_bounds: Callable
+    distribution: str
+    sigma: float
+    num_outputs: int = 1
+    default_metric: str = "aft-nloglik"
+    output_kind: str = "value"
+    default_base_score: float = 0.5
+    transform: Callable = staticmethod(lambda m: jnp.exp(m[:, 0]))
+    base_score_to_margin: Callable = staticmethod(
+        lambda s: float(np.log(max(s, 1e-16)))
+    )
+
+
+def get_survival_objective(
+    name: str, distribution: str = "normal", sigma: float = 1.0
+) -> SurvivalObjective:
+    if name != "survival:aft":
+        raise ValueError(f"Unsupported survival objective: {name!r}")
+    return SurvivalObjective(
+        name=name,
+        grad_hess_bounds=make_aft_grad_hess(distribution, sigma),
+        distribution=distribution,
+        sigma=sigma,
+    )

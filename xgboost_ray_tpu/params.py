@@ -66,8 +66,13 @@ class TrainParams:
     scale_pos_weight: float = 1.0
     tree_method: str = "tpu_hist"
     eval_metric: List[str] = dataclasses.field(default_factory=list)
+    # survival:aft
+    aft_loss_distribution: str = "normal"
+    aft_loss_distribution_scale: float = 1.0
+    # reg:tweedie
+    tweedie_variance_power: float = 1.5
     # tpu_hist internals
-    hist_impl: str = "auto"  # auto | scatter | onehot | pallas
+    hist_impl: str = "auto"  # auto | scatter | onehot | partition | mixed | pallas
     hist_chunk: int = 8192
 
 
