@@ -258,3 +258,24 @@ def test_hist_partition_skewed_nodes():
                        n_nodes, nb + 1, block=64, block_chunk=4)
     )
     np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_hist_pallas_interpret_matches_scatter():
+    from xgboost_ray_tpu.ops.hist_pallas import PALLAS_AVAILABLE, hist_pallas
+
+    if not PALLAS_AVAILABLE:
+        pytest.skip("pallas unavailable")
+    rng = np.random.RandomState(11)
+    n, f, nb, n_nodes = 300, 4, 8, 4
+    bins = rng.randint(0, nb + 1, size=(n, f)).astype(np.uint8)
+    gh = rng.randn(n, 2).astype(np.float32)
+    pos = rng.randint(0, n_nodes, size=n).astype(np.int32)
+    ref = np.asarray(
+        hist_scatter(jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(pos),
+                     n_nodes, nb + 1)
+    )
+    out = np.asarray(
+        hist_pallas(jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(pos),
+                    n_nodes, nb + 1, block=64, interpret=True)
+    )
+    np.testing.assert_allclose(out, ref, atol=1e-4)
