@@ -5,6 +5,7 @@ of granularity) but for the compute core our build owns.
 """
 
 import numpy as np
+import os
 import pytest
 
 import jax
@@ -261,21 +262,33 @@ def test_hist_partition_skewed_nodes():
 
 
 def test_hist_pallas_interpret_matches_scatter():
-    from xgboost_ray_tpu.ops.hist_pallas import PALLAS_AVAILABLE, hist_pallas
+    """Run in a subprocess: the hermetic conftest deregisters the tpu
+    platform, which pallas.tpu needs even for interpret mode."""
+    import subprocess
+    import sys
 
-    if not PALLAS_AVAILABLE:
-        pytest.skip("pallas unavailable")
-    rng = np.random.RandomState(11)
-    n, f, nb, n_nodes = 300, 4, 8, 4
-    bins = rng.randint(0, nb + 1, size=(n, f)).astype(np.uint8)
-    gh = rng.randn(n, 2).astype(np.float32)
-    pos = rng.randint(0, n_nodes, size=n).astype(np.int32)
-    ref = np.asarray(
-        hist_scatter(jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(pos),
-                     n_nodes, nb + 1)
+    code = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np, jax.numpy as jnp
+from xgboost_ray_tpu.ops.histogram import hist_scatter
+from xgboost_ray_tpu.ops.hist_pallas import PALLAS_AVAILABLE, hist_pallas
+assert PALLAS_AVAILABLE
+rng = np.random.RandomState(11)
+n, f, nb, n_nodes = 300, 4, 8, 4
+bins = rng.randint(0, nb + 1, size=(n, f)).astype(np.uint8)
+gh = rng.randn(n, 2).astype(np.float32)
+pos = rng.randint(0, n_nodes, size=n).astype(np.int32)
+ref = np.asarray(hist_scatter(jnp.asarray(bins), jnp.asarray(gh),
+                              jnp.asarray(pos), n_nodes, nb + 1))
+out = np.asarray(hist_pallas(jnp.asarray(bins), jnp.asarray(gh),
+                             jnp.asarray(pos), n_nodes, nb + 1,
+                             block=64, interpret=True))
+np.testing.assert_allclose(out, ref, atol=1e-4)
+print("PALLAS_OK")
+"""
+    result = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
-    out = np.asarray(
-        hist_pallas(jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(pos),
-                    n_nodes, nb + 1, block=64, interpret=True)
-    )
-    np.testing.assert_allclose(out, ref, atol=1e-4)
+    assert "PALLAS_OK" in result.stdout, result.stderr[-2000:]
