@@ -1,0 +1,113 @@
+"""DART (dropout) booster tests."""
+
+import numpy as np
+import pytest
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+
+def _data(n=300, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 5).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float32)
+    return x, y
+
+
+_BASE = {"objective": "binary:logistic", "eval_metric": ["logloss", "error"],
+         "max_depth": 3, "eta": 0.3}
+
+
+def test_dart_trains_and_predicts():
+    x, y = _data()
+    dtrain = RayDMatrix(x, y)
+    evals_result = {}
+    bst = train(dict(_BASE, booster="dart", rate_drop=0.2, one_drop=1),
+                dtrain, 15, evals=[(dtrain, "train")],
+                evals_result=evals_result, ray_params=RayParams(num_actors=2))
+    assert bst.num_boosted_rounds() == 15
+    assert bst.tree_weights is not None
+    assert bst.tree_weights.shape == (15,)
+    # dropout normalization keeps weights in (0, 1]
+    assert np.all(bst.tree_weights > 0) and np.all(bst.tree_weights <= 1.0)
+    assert evals_result["train"]["error"][-1] < 0.1
+    pred = bst.predict(x)
+    assert ((pred > 0.5) == y).mean() > 0.9
+
+
+def test_dart_zero_drop_matches_gbtree():
+    x, y = _data(seed=1)
+    bst_dart = train(dict(_BASE, booster="dart", rate_drop=0.0, skip_drop=0.0),
+                     RayDMatrix(x, y), 8, ray_params=RayParams(num_actors=2))
+    bst_gb = train(dict(_BASE), RayDMatrix(x, y), 8,
+                   ray_params=RayParams(num_actors=2))
+    np.testing.assert_allclose(
+        bst_dart.predict(x, output_margin=True),
+        bst_gb.predict(x, output_margin=True), atol=1e-4,
+    )
+
+
+def test_dart_forest_normalization():
+    x, y = _data(seed=2)
+    bst = train(dict(_BASE, booster="dart", rate_drop=0.3, one_drop=1,
+                     normalize_type="forest"),
+                RayDMatrix(x, y), 10, ray_params=RayParams(num_actors=2))
+    assert bst.num_boosted_rounds() == 10
+    pred = bst.predict(x)
+    assert ((pred > 0.5) == y).mean() > 0.85
+
+
+def test_dart_save_load_preserves_weights(tmp_path):
+    x, y = _data(seed=3)
+    bst = train(dict(_BASE, booster="dart", rate_drop=0.3, one_drop=1),
+                RayDMatrix(x, y), 8, ray_params=RayParams(num_actors=2))
+    p = str(tmp_path / "dart.json")
+    bst.save_model(p)
+    from xgboost_ray_tpu import RayXGBoostBooster
+    bst2 = RayXGBoostBooster.load_model(p)
+    np.testing.assert_allclose(bst.tree_weights, bst2.tree_weights)
+    np.testing.assert_allclose(bst.predict(x), bst2.predict(x), atol=1e-6)
+
+
+def test_dart_resume_from_checkpoint():
+    from xgboost_ray_tpu.callback import TrainingCallback
+    from xgboost_ray_tpu.exceptions import RayActorError
+
+    class FailOnce(TrainingCallback):
+        def __init__(self):
+            self.fired = False
+
+        def after_iteration(self, model, epoch, evals_log):
+            if not self.fired and epoch == 4:
+                self.fired = True
+                raise RayActorError("boom", ranks=[1])
+            return False
+
+    x, y = _data(seed=4)
+    bst = train(dict(_BASE, booster="dart", rate_drop=0.2, one_drop=1),
+                RayDMatrix(x, y), 10,
+                ray_params=RayParams(num_actors=2, max_actor_restarts=1,
+                                     checkpoint_frequency=2),
+                callbacks=[FailOnce()])
+    assert bst.num_boosted_rounds() == 10
+    assert bst.tree_weights.shape == (10,)
+
+
+def test_dart_invalid_params():
+    x, y = _data()
+    with pytest.raises(ValueError, match="num_parallel_tree"):
+        train(dict(_BASE, booster="dart", num_parallel_tree=4),
+              RayDMatrix(x, y), 3, ray_params=RayParams(num_actors=2))
+    with pytest.raises(ValueError, match="booster"):
+        train(dict(_BASE, booster="gblinear"),
+              RayDMatrix(x, y), 3, ray_params=RayParams(num_actors=2))
+
+
+def test_dart_via_sklearn():
+    from xgboost_ray_tpu.sklearn import RayXGBClassifier
+
+    x, y = _data(seed=5)
+    clf = RayXGBClassifier(n_estimators=10, booster="dart", rate_drop=0.2,
+                           one_drop=1, max_depth=3)
+    clf.fit(x, y, ray_params=RayParams(num_actors=2))
+    assert clf.get_booster().tree_weights is not None
+    assert (clf.predict(x, ray_params=RayParams(num_actors=2)) == y).mean() > 0.9

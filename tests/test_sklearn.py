@@ -221,3 +221,20 @@ def test_pandas_input(bc):
     clf.fit(df_tr, y_tr, ray_params=RP)
     pred = clf.predict(pd.DataFrame(x_te, columns=cols), ray_params=RP)
     assert (pred == y_te).mean() > 0.9
+
+
+def test_get_score_importance_types(bc):
+    x_tr, _, y_tr, _ = bc
+    clf = RayXGBClassifier(n_estimators=10, max_depth=3)
+    clf.fit(x_tr, y_tr, ray_params=RP)
+    bst = clf.get_booster()
+    w = bst.get_score("weight")
+    g = bst.get_score("gain")
+    tg = bst.get_score("total_gain")
+    assert w and g and tg
+    assert set(g) == set(w)
+    # total_gain = gain * weight per feature
+    for k in g:
+        np.testing.assert_allclose(tg[k], g[k] * w[k], rtol=1e-5)
+    with pytest.raises(ValueError):
+        bst.get_score("cover")

@@ -72,6 +72,7 @@ class _EvalSet:
         self.is_train = is_train
         self.lower_np = None
         self.upper_np = None
+        self.margins_static = None
         # set by engine when not aliased to the train set:
         self.bins = None
         self.label = None
@@ -92,6 +93,7 @@ class TpuEngine:
         devices: Optional[Sequence[Any]] = None,
         init_booster: Optional[RayXGBoostBooster] = None,
         feature_names: Optional[List[str]] = None,
+        total_rounds: Optional[int] = None,
     ):
         self.params = params
         self.feature_names = feature_names
@@ -210,17 +212,32 @@ class TpuEngine:
         self.group_rows = self._build_sharded_groups(qid) if self.is_ranking else None
 
         # ---- margins ------------------------------------------------------
-        margins0 = np.full((self.n_rows, self.n_outputs), self.base_margin0, np.float32)
+        margins_static = np.full(
+            (self.n_rows, self.n_outputs), self.base_margin0, np.float32
+        )
         if base_margin is not None:
-            margins0 = margins0 + base_margin.reshape(self.n_rows, -1).astype(np.float32)
+            margins_static = margins_static + base_margin.reshape(
+                self.n_rows, -1
+            ).astype(np.float32)
+        margins0 = margins_static
         self._init_trees: List[Tree] = []
+        self._init_tree_weights: Optional[np.ndarray] = None
         if init_booster is not None and init_booster.num_trees:
             margins0 = margins0 + (
                 init_booster.predict_margin_np(x)
                 - init_booster.base_score_margin_np()
             )
             self._init_trees = [init_booster.forest]
+            self._init_tree_weights = (
+                init_booster.tree_weights
+                if init_booster.tree_weights is not None
+                else np.ones(init_booster.num_trees, np.float32)
+            )
         self.margins = put_rows(margins0, np.float32)
+        self.dart = params.booster == "dart"
+        if self.dart:
+            self._margins_static_dev = put_rows(margins_static, np.float32)
+            self._dart_total_rounds = int(total_rounds or 0)
 
         # ---- eval sets ----------------------------------------------------
         self.evals: List[_EvalSet] = []
@@ -234,6 +251,9 @@ class TpuEngine:
         self._step_fn = None
         self._step_fn_custom = None
         self._scan_fn = None
+        self._dart_fn = None
+        if self.dart:
+            self._init_dart_forest()
         self.iteration_offset = (
             init_booster.num_boosted_rounds() if init_booster is not None else 0
         )
@@ -338,22 +358,31 @@ class TpuEngine:
         es.weight_np = weight
         es.lower_np = lo if lo is not None else label
         es.upper_np = hi if hi is not None else es.lower_np
-        margins0 = np.full((x.shape[0], self.n_outputs), self.base_margin0, np.float32)
+        margins_static = np.full(
+            (x.shape[0], self.n_outputs), self.base_margin0, np.float32
+        )
         if base_margin is not None:
-            margins0 = margins0 + base_margin.reshape(x.shape[0], -1).astype(np.float32)
+            margins_static = margins_static + base_margin.reshape(
+                x.shape[0], -1
+            ).astype(np.float32)
+        margins0 = margins_static
         if init_booster is not None and init_booster.num_trees:
             margins0 = margins0 + (
                 init_booster.predict_margin_np(x) - init_booster.base_score_margin_np()
             )
         es.margins = put_rows(margins0, np.float32)
+        if getattr(self, "dart", False):
+            es.margins_static = put_rows(margins_static, np.float32)
         del x_dev
         self.evals.append(es)
 
     # ------------------------------------------------------------------
-    def _round_closures(self):
-        """The shared traced round body used by both the per-round step and
-        the lax.scan multi-round path — one definition so sampling/tree
-        semantics cannot diverge between the two compiled programs."""
+    def _round_closures(self, update_evals: bool = True):
+        """The shared traced round body used by the per-round step, the
+        lax.scan multi-round path, and the dart step — one definition so
+        sampling/tree semantics cannot diverge between compiled programs.
+        ``update_evals=False`` skips incremental eval-margin updates (dart
+        recomputes margins from tree weights instead)."""
         cfg = self.cfg
         params = self.params
         k_out = self.n_outputs
@@ -362,7 +391,9 @@ class TpuEngine:
         is_ranking = self.is_ranking
         missing_bin = params.max_bin
         dev_metrics = list(self._device_metrics)
-        n_evals_dev = sum(1 for e in self.evals if not e.is_train)
+        n_evals_dev = (
+            sum(1 for e in self.evals if not e.is_train) if update_evals else 0
+        )
         psum = lambda x: jax.lax.psum(x, "actors")
 
         is_survival = self.is_survival
@@ -563,7 +594,7 @@ class TpuEngine:
         return jax.jit(mapped, donate_argnums=(4,))
 
     def can_batch_rounds(self) -> bool:
-        return not self._host_metrics
+        return not self._host_metrics and not self.dart
 
     def step_many(self, iteration0: int, n_rounds: int) -> List[Dict[str, Dict[str, float]]]:
         """Run ``n_rounds`` boosting rounds in one compiled program.
@@ -628,6 +659,10 @@ class TpuEngine:
 
     def step(self, iteration: int, gh_custom=None) -> Dict[str, Dict[str, float]]:
         """Run one boosting round; returns {eval_name: {metric: value}}."""
+        if self.dart:
+            if gh_custom is not None:
+                raise ValueError("custom objectives are not supported with dart")
+            return self.step_dart(iteration)
         custom = gh_custom is not None
         if custom:
             if self._step_fn_custom is None:
@@ -718,14 +753,273 @@ class TpuEngine:
 
     def get_booster(self) -> RayXGBoostBooster:
         forest = stack_trees(self._init_trees + self.trees)
+        tree_weights = None
+        if self.dart:
+            tree_weights = self.dart_weights[: self.dart_t].copy()
         booster = RayXGBoostBooster(
             forest,
             np.asarray(self.cuts),
             self.params,
             self.base_score,
             feature_names=self.feature_names,
+            tree_weights=tree_weights,
         )
         return booster
+
+
+    # ------------------------------------------------------------------
+    # DART (dropout) booster: per-round dropout over the forest built so
+    # far, with tree/forest normalization — the analog of xgboost's
+    # ``booster="dart"`` which reference users pass straight through.
+    # Margins are recomputed from the (capacity-padded, device-resident)
+    # forest each round via a vmapped binned walk, so dropping trees is a
+    # weight-vector edit, not a cache invalidation problem.
+    # ------------------------------------------------------------------
+
+    def _init_dart_forest(self):
+        k_out = self.n_outputs
+        heap = self.cfg.heap_size
+        n_init = self._init_trees[0].feature.shape[0] if self._init_trees else 0
+        t_cap = n_init + max(1, self._dart_total_rounds) * k_out
+
+        def empty(dtype, fill):
+            return np.full((t_cap, heap), fill, dtype)
+
+        feature = empty(np.int32, -1)
+        split_bin = empty(np.int32, 0)
+        threshold = empty(np.float32, 0.0)
+        default_left = empty(bool, False)
+        is_leaf = empty(bool, False)
+        value = empty(np.float32, 0.0)
+        gain = empty(np.float32, 0.0)
+        is_leaf[:, 0] = True  # empty slots predict 0 from a root leaf
+        if n_init:
+            init = self._init_trees[0]
+            feature[:n_init] = init.feature
+            split_bin[:n_init] = init.split_bin
+            threshold[:n_init] = init.threshold
+            default_left[:n_init] = init.default_left
+            is_leaf[:n_init] = init.is_leaf
+            value[:n_init] = init.value
+            gain[:n_init] = init.gain
+        self.dart_forest_dev = Tree(
+            feature=jnp.asarray(feature),
+            split_bin=jnp.asarray(split_bin),
+            threshold=jnp.asarray(threshold),
+            default_left=jnp.asarray(default_left),
+            is_leaf=jnp.asarray(is_leaf),
+            value=jnp.asarray(value),
+            gain=jnp.asarray(gain),
+        )
+        self.dart_weights = np.zeros(t_cap, np.float32)
+        if n_init:
+            self.dart_weights[:n_init] = self._init_tree_weights
+        self.dart_t = n_init
+        self._dart_t_cap = t_cap
+
+    def _make_dart_step(self):
+        tree_round, metric_contribs = self._round_closures(update_evals=False)
+        cfg = self.cfg
+        k_out = self.n_outputs
+        missing_bin = self.params.max_bin
+        t_cap = self._dart_t_cap
+        cls_onehot = jax.nn.one_hot(
+            jnp.arange(t_cap) % k_out, k_out, dtype=jnp.float32
+        )  # [t_cap, K]
+
+        def forest_margin(forest, bins_local, static, weights):
+            leaf = jax.vmap(
+                lambda tr: predict_tree_binned(tr, bins_local, cfg.max_depth, missing_bin)
+            )(forest)  # [t_cap, S]
+            contrib = jnp.einsum(
+                "ts,tk->sk", leaf * weights[:, None], cls_onehot,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            return static + contrib
+
+        def dart_step(bins, valid, label, weight, static_margins, group_rows,
+                      bounds, forest, w_eff, w_post, new_w, slot, rng, eval_data):
+            m_eff = forest_margin(forest, bins, static_margins, w_eff)
+            eval_bins = tuple(d[0] for d in eval_data)
+            # dart needs no incremental eval margins; dummy zeros of the right
+            # shape keep tree_round's interface
+            eval_margins = tuple(d[4] for d in eval_data)
+            new_margins, _, round_forest = tree_round(
+                bins, valid, label, weight, m_eff, group_rows, None, rng,
+                bounds, (), (),
+            )
+            del new_margins  # dart recomputes margins from weights instead
+            # insert the K new trees at [slot, slot+K)
+            forest = jax.tree.map(
+                lambda fa, ta: jax.lax.dynamic_update_slice(
+                    fa, ta.astype(fa.dtype), (slot,) + (0,) * (fa.ndim - 1)
+                ),
+                forest,
+                round_forest,
+            )
+            # post-round weights: dropped rescaled + new trees at new_w
+            slots = jnp.arange(t_cap)
+            w_full = jnp.where(
+                (slots >= slot) & (slots < slot + k_out), new_w, w_post
+            )
+            m_full = forest_margin(forest, bins, static_margins, w_full)
+            new_eval_margins = []
+            for e, d in enumerate(eval_data):
+                m_e = forest_margin(forest, eval_bins[e], d[5], w_full)
+                new_eval_margins.append(m_e)
+            contribs = metric_contribs(
+                m_full, new_eval_margins, label,
+                weight * valid.astype(jnp.float32), eval_data,
+            )
+            return m_full, tuple(new_eval_margins), forest, round_forest, contribs
+
+        eval_specs = tuple(
+            (P("actors"), P("actors"), P("actors"), P("actors"), P("actors"),
+             P("actors"))
+            for e in self.evals
+            if not e.is_train
+        )
+        mapped = shard_map(
+            dart_step,
+            mesh=self.mesh,
+            in_specs=(
+                P("actors"),  # bins
+                P("actors"),  # valid
+                P("actors"),  # label
+                P("actors"),  # weight
+                P("actors"),  # static margins
+                P("actors") if self.group_rows is not None else P(),
+                (P("actors"), P("actors")) if self.bounds_dev is not None else P(),
+                P(),  # forest (replicated)
+                P(),  # w_eff
+                P(),  # w_post
+                P(),  # new_w
+                P(),  # slot
+                P(),  # rng
+                eval_specs,
+            ),
+            out_specs=(
+                P("actors"),
+                tuple(P("actors") for _ in eval_specs),
+                P(),
+                P(),
+                tuple(
+                    tuple((P(), P()) for _ in self._device_metrics)
+                    for _ in self.evals
+                ),
+            ),
+            check_vma=False,
+        )
+        return jax.jit(mapped, donate_argnums=(7,))
+
+    def _dart_sample_drops(self, iteration: int):
+        """Host-side dropout sampling; deterministic in (seed, iteration)."""
+        params = self.params
+        t = self.dart_t
+        rng = np.random.RandomState(
+            (params.seed * 1_000_003 + self.iteration_offset + iteration) % (2 ** 31)
+        )
+        drop = np.zeros(self._dart_t_cap, bool)
+        if t == 0 or (params.skip_drop > 0 and rng.rand() < params.skip_drop):
+            return drop
+        weights = np.maximum(self.dart_weights[:t], 0.0)
+        if params.sample_type == "weighted":
+            probs = weights / max(weights.sum(), 1e-12)
+            drop[:t] = rng.rand(t) < np.minimum(probs * t * params.rate_drop, 1.0)
+        else:
+            drop[:t] = rng.rand(t) < params.rate_drop
+        if params.one_drop and not drop.any():
+            if params.sample_type == "weighted" and weights.sum() > 0:
+                idx = rng.choice(t, p=weights / weights.sum())
+            else:
+                idx = rng.randint(t)
+            drop[idx] = True
+        return drop
+
+    def step_dart(self, iteration: int) -> Dict[str, Dict[str, float]]:
+        params = self.params
+        if self._dart_fn is None:
+            self._dart_fn = self._make_dart_step()
+        lr = params.learning_rate
+        drop = self._dart_sample_drops(iteration)
+        k_dropped = int(drop.sum())
+        if k_dropped:
+            if params.normalize_type == "forest":
+                new_w, drop_scale = 1.0 / (1.0 + lr), 1.0 / (1.0 + lr)
+            else:  # "tree"
+                new_w = 1.0 / (k_dropped + lr)
+                drop_scale = k_dropped / (k_dropped + lr)
+        else:
+            new_w, drop_scale = 1.0, 1.0
+        w_eff = self.dart_weights.copy()
+        w_eff[drop] = 0.0
+        w_post = self.dart_weights.copy()
+        w_post[drop] *= drop_scale
+
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(params.seed), self.iteration_offset + iteration
+        )
+        eval_data = tuple(
+            (es.bins, es.label, es.weight, es.valid, es.margins, es.margins_static)
+            for es in self.evals
+            if not es.is_train
+        )
+        group_rows = (
+            self.group_rows if self.group_rows is not None else jnp.zeros((), jnp.int32)
+        )
+        bounds = (
+            self.bounds_dev if self.bounds_dev is not None else jnp.zeros((), jnp.float32)
+        )
+        m_full, new_eval_margins, forest, round_forest, contribs = self._dart_fn(
+            self.bins,
+            self.valid,
+            self.label_dev,
+            self.weight_dev,
+            self._margins_static_dev,
+            group_rows,
+            bounds,
+            self.dart_forest_dev,
+            jnp.asarray(w_eff),
+            jnp.asarray(w_post),
+            jnp.float32(new_w),
+            jnp.int32(self.dart_t),
+            rng,
+            eval_data,
+        )
+        self.margins = m_full
+        self.dart_forest_dev = forest
+        ei = 0
+        for es in self.evals:
+            if not es.is_train:
+                es.margins = new_eval_margins[ei]
+                ei += 1
+        self.trees.append(jax.tree.map(np.asarray, round_forest))
+        w_new_vec = w_post
+        w_new_vec[self.dart_t : self.dart_t + self.n_outputs] = new_w
+        self.dart_weights = w_new_vec
+        self.dart_t += self.n_outputs
+
+        results: Dict[str, Dict[str, float]] = {}
+        for si, es in enumerate(self.evals):
+            row: Dict[str, float] = {}
+            for mi, name in enumerate(self._device_metrics):
+                num, den = contribs[si][mi]
+                num, den = float(num), float(den)
+                val = num / max(den, 1e-12)
+                base, _ = parse_metric_name(name)
+                row[name] = float(np.sqrt(val)) if base == "rmse" else val
+            if self._host_metrics:
+                margin = self.get_margins(es)
+                for name in self._host_metrics:
+                    row[name] = compute_metric(
+                        name,
+                        margin,
+                        es.label_np if es.label_np is not None else self.label_np,
+                        es.weight_np,
+                        group_ptr=es.group_ptr,
+                    )
+            results[es.name] = row
+        return results
 
 
 def _concat_shards(shards):

@@ -484,6 +484,7 @@ def _train(
         evals=evals_in,
         init_booster=init_booster,
         feature_names=dtrain.resolved_feature_names,
+        total_rounds=boost_rounds_left,
     )
     total_n = sum(a.local_n(dtrain) for a in alive)
     state.additional_results["total_n"] = total_n

@@ -57,11 +57,16 @@ class RayXGBoostBooster:
         base_score: float,
         feature_names: Optional[List[str]] = None,
         feature_types: Optional[List[str]] = None,
+        tree_weights: Optional[np.ndarray] = None,
     ):
         self.forest = _forest_to_np(forest)
         self.cuts = np.asarray(cuts, dtype=np.float32)
         self.params = params
         self.base_score = float(base_score)
+        # per-tree output scales (DART dropout normalization); None == all 1.0
+        self.tree_weights = (
+            None if tree_weights is None else np.asarray(tree_weights, np.float32)
+        )
         self.feature_names = feature_names
         self.feature_types = feature_types
         self.best_iteration: Optional[int] = None
@@ -133,6 +138,7 @@ class RayXGBoostBooster:
         out = RayXGBoostBooster(
             sub, self.cuts, self.params, self.base_score, self.feature_names,
             self.feature_types,
+            tree_weights=None if self.tree_weights is None else self.tree_weights[sl],
         )
         return out
 
@@ -169,6 +175,9 @@ class RayXGBoostBooster:
                 num_outputs=k,
                 num_parallel_tree=self.params.num_parallel_tree,
                 ntree_limit=int(ntree_limit),
+                tree_weights=(
+                    None if self.tree_weights is None else jnp.asarray(self.tree_weights)
+                ),
             )
             out[lo:hi] = np.asarray(margin)
         return out
@@ -214,7 +223,13 @@ class RayXGBoostBooster:
             default_left=self.forest.default_left,
             is_leaf=self.forest.is_leaf,
             value=self.forest.value,
+            gain=self.forest.gain,
             cuts=self.cuts,
+            tree_weights=(
+                self.tree_weights
+                if self.tree_weights is not None
+                else np.zeros((0,), np.float32)
+            ),
         )
         import dataclasses as dc
 
@@ -242,8 +257,12 @@ class RayXGBoostBooster:
                 default_left=z["default_left"],
                 is_leaf=z["is_leaf"],
                 value=z["value"],
+                gain=(
+                    z["gain"] if "gain" in z else np.zeros_like(z["value"])
+                ),
             )
             cuts = z["cuts"]
+            tw = z["tree_weights"] if "tree_weights" in z else np.zeros((0,), np.float32)
         params = TrainParams(**d["params"])
         out = cls(
             forest,
@@ -252,6 +271,7 @@ class RayXGBoostBooster:
             d["base_score"],
             d.get("feature_names"),
             d.get("feature_types"),
+            tree_weights=tw if tw.size else None,
         )
         out.best_iteration = d.get("best_iteration")
         out.best_score = d.get("best_score")
@@ -303,6 +323,32 @@ class RayXGBoostBooster:
             rec(0, 0)
             dumps.append("\n".join(lines) + "\n")
         return dumps
+
+    def get_score(self, importance_type: str = "weight") -> Dict[str, float]:
+        """Per-feature importance (xgboost ``Booster.get_score`` analog):
+        weight (split counts), gain (mean split gain), total_gain."""
+        feat = self.forest.feature
+        leaf = self.forest.is_leaf
+        internal = (feat >= 0) & (~leaf)
+        used = feat[internal]
+        names = self.feature_names or [f"f{i}" for i in range(self.num_features)]
+        counts = np.bincount(used, minlength=self.num_features).astype(np.float64)
+        if importance_type == "weight":
+            vals = counts
+        elif importance_type in ("gain", "total_gain"):
+            gains = self.forest.gain[internal]
+            total = np.zeros(self.num_features, np.float64)
+            np.add.at(total, used, gains)
+            vals = total if importance_type == "total_gain" else (
+                np.divide(total, counts, out=np.zeros_like(total),
+                          where=counts > 0)
+            )
+        else:
+            raise ValueError(
+                f"Unsupported importance_type: {importance_type!r} "
+                f"(weight, gain, total_gain)"
+            )
+        return {names[i]: float(v) for i, v in enumerate(vals) if v > 0}
 
     def __getstate__(self):
         return self._to_dict()

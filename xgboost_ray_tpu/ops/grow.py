@@ -48,6 +48,7 @@ class Tree(NamedTuple):
     default_left: jnp.ndarray  # bool, where missing goes
     is_leaf: jnp.ndarray  # bool
     value: jnp.ndarray  # float32 leaf value (already scaled by learning_rate)
+    gain: jnp.ndarray  # float32 split gain at internal nodes (importances)
 
 
 def empty_tree(heap_size: int) -> Tree:
@@ -58,6 +59,7 @@ def empty_tree(heap_size: int) -> Tree:
         default_left=jnp.zeros((heap_size,), bool),
         is_leaf=jnp.zeros((heap_size,), bool),
         value=jnp.zeros((heap_size,), jnp.float32),
+        gain=jnp.zeros((heap_size,), jnp.float32),
     )
 
 
@@ -117,6 +119,7 @@ def build_tree(
             default_left=tree.default_left.at[sl].set(sp.default_left & valid_split),
             is_leaf=tree.is_leaf.at[sl].set(is_new_leaf),
             value=tree.value.at[sl].set(jnp.where(is_new_leaf, node_value, 0.0)),
+            gain=tree.gain.at[sl].set(jnp.where(valid_split, sp.gain, 0.0)),
         )
 
         newly_leafed = is_new_leaf[pos] & ~done

@@ -43,10 +43,13 @@ def predict_margin(
     num_outputs: int,
     num_parallel_tree: int = 1,
     ntree_limit: int = 0,
+    tree_weights: Optional[jnp.ndarray] = None,  # [T] per-tree scale (DART)
 ) -> jnp.ndarray:
     """Sum leaf values of all trees into per-class margins. Returns [N, K]."""
     t = forest.feature.shape[0]
     leaf = jax.vmap(lambda tr: _walk_one_tree(tr, x, max_depth))(forest)  # [T, N]
+    if tree_weights is not None:
+        leaf = leaf * tree_weights[:, None]
     if ntree_limit:
         keep = jnp.arange(t) < ntree_limit
         leaf = jnp.where(keep[:, None], leaf, 0.0)

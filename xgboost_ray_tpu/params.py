@@ -66,6 +66,13 @@ class TrainParams:
     scale_pos_weight: float = 1.0
     tree_method: str = "tpu_hist"
     eval_metric: List[str] = dataclasses.field(default_factory=list)
+    # booster selection: gbtree (default) or dart (dropout boosting)
+    booster: str = "gbtree"
+    rate_drop: float = 0.0
+    one_drop: int = 0
+    skip_drop: float = 0.0
+    sample_type: str = "uniform"  # uniform | weighted
+    normalize_type: str = "tree"  # tree | forest
     # survival:aft
     aft_loss_distribution: str = "normal"
     aft_loss_distribution_scale: float = 1.0
@@ -144,4 +151,16 @@ def parse_params(params: Optional[Dict[str, Any]]) -> TrainParams:
         raise ValueError("max_bin must be in (1, 1024]")
     if out.objective.startswith("multi:") and out.num_class < 2:
         raise ValueError("multi:* objectives require num_class >= 2")
+    if out.booster not in ("gbtree", "dart"):
+        raise ValueError(
+            f"Unsupported booster: {out.booster!r} (gbtree or dart; gblinear "
+            f"has no tree to build)."
+        )
+    if out.booster == "dart":
+        if out.num_parallel_tree != 1:
+            raise ValueError("dart does not support num_parallel_tree > 1")
+        if out.normalize_type not in ("tree", "forest"):
+            raise ValueError("normalize_type must be 'tree' or 'forest'")
+        if out.sample_type not in ("uniform", "weighted"):
+            raise ValueError("sample_type must be 'uniform' or 'weighted'")
     return out

@@ -113,8 +113,7 @@ class RayXGBMixin:
         params = {}
         for name in _PARAM_NAMES:
             if name in ("n_estimators", "early_stopping_rounds", "eval_metric",
-                        "missing", "n_jobs", "verbosity", "booster",
-                        "colsample_bynode"):
+                        "missing", "n_jobs", "verbosity", "colsample_bynode"):
                 continue
             val = getattr(self, name, None)
             if val is not None:
@@ -267,14 +266,18 @@ class RayXGBMixin:
 
     @property
     def feature_importances_(self) -> np.ndarray:
-        """Split-count ("weight") importance, normalized."""
+        """Normalized importance; type from ``importance_type`` (default
+        "gain", matching xgboost's sklearn wrapper), falling back to split
+        counts ("weight")."""
         booster = self.get_booster()
-        feat = booster.forest.feature
-        leaf = booster.forest.is_leaf
-        used = feat[(feat >= 0) & (~leaf)]
-        counts = np.bincount(used, minlength=booster.num_features).astype(np.float64)
-        total = counts.sum()
-        return (counts / total) if total > 0 else counts
+        importance_type = getattr(self, "importance_type", None) or "gain"
+        names = booster.feature_names or [
+            f"f{i}" for i in range(booster.num_features)
+        ]
+        score = booster.get_score(importance_type=importance_type)
+        vals = np.array([score.get(n, 0.0) for n in names], np.float64)
+        total = vals.sum()
+        return (vals / total) if total > 0 else vals
 
     def save_model(self, fname: str):
         self.get_booster().save_model(fname)
