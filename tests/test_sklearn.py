@@ -238,3 +238,17 @@ def test_get_score_importance_types(bc):
         np.testing.assert_allclose(tg[k], g[k] * w[k], rtol=1e-5)
     with pytest.raises(ValueError):
         bst.get_score("cover")
+
+
+def test_trees_to_dataframe_and_pred_contribs(bc):
+    x_tr, _, y_tr, _ = bc
+    clf = RayXGBClassifier(n_estimators=3, max_depth=2)
+    clf.fit(x_tr, y_tr, ray_params=RP)
+    bst = clf.get_booster()
+    df = bst.trees_to_dataframe()
+    assert set(df["Tree"]) == {0, 1, 2}
+    assert (df[df["IsLeaf"]]["Feature"] == "Leaf").all()
+    internal = df[~df["IsLeaf"]]
+    assert (internal["Gain"] > 0).all()
+    with pytest.raises(NotImplementedError):
+        bst.predict(x_tr[:5], pred_contribs=True)

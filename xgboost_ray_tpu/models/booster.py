@@ -187,12 +187,19 @@ class RayXGBoostBooster:
         data,
         output_margin: bool = False,
         pred_leaf: bool = False,
+        pred_contribs: bool = False,
+        pred_interactions: bool = False,
         ntree_limit: int = 0,
         iteration_range: Optional[Tuple[int, int]] = None,
         validate_features: bool = True,
         base_margin: Optional[np.ndarray] = None,
         **_ignored,
     ) -> np.ndarray:
+        if pred_contribs or pred_interactions:
+            raise NotImplementedError(
+                "pred_contribs/pred_interactions (SHAP values) are not "
+                "implemented by the tpu_hist predictor yet."
+            )
         x = self._coerce_features(data)
         if pred_leaf:
             forest_dev = Tree(*[jnp.asarray(f) for f in self.forest])
@@ -323,6 +330,42 @@ class RayXGBoostBooster:
             rec(0, 0)
             dumps.append("\n".join(lines) + "\n")
         return dumps
+
+    def trees_to_dataframe(self):
+        """Flat per-node table of the forest (xgboost analog); columns:
+        Tree, Node, ID, Feature, Split, Yes, No, Missing, Gain, IsLeaf, Value."""
+        import pandas as pd
+
+        rows = []
+        heap = self.forest.feature.shape[1]
+        for t in range(self.num_trees):
+            for idx in range(heap):
+                is_leaf = bool(self.forest.is_leaf[t, idx])
+                feat = int(self.forest.feature[t, idx])
+                if not is_leaf and feat < 0:
+                    continue  # unused slot
+                rows.append({
+                    "Tree": t,
+                    "Node": idx,
+                    "ID": f"{t}-{idx}",
+                    "Feature": "Leaf" if is_leaf else (
+                        self.feature_names[feat]
+                        if self.feature_names
+                        else f"f{feat}"
+                    ),
+                    "Split": None if is_leaf else float(self.forest.threshold[t, idx]),
+                    "Yes": None if is_leaf else f"{t}-{2 * idx + 1}",
+                    "No": None if is_leaf else f"{t}-{2 * idx + 2}",
+                    "Missing": None if is_leaf else (
+                        f"{t}-{2 * idx + 1}"
+                        if self.forest.default_left[t, idx]
+                        else f"{t}-{2 * idx + 2}"
+                    ),
+                    "Gain": float(self.forest.gain[t, idx]),
+                    "IsLeaf": is_leaf,
+                    "Value": float(self.forest.value[t, idx]),
+                })
+        return pd.DataFrame(rows)
 
     def get_score(self, importance_type: str = "weight") -> Dict[str, float]:
         """Per-feature importance (xgboost ``Booster.get_score`` analog):
