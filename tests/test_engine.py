@@ -238,3 +238,15 @@ def test_missing_values_routed_by_learned_default():
     shards = [{"data": x, "label": y}]
     eng, metrics = _train(shards, 2, rounds=10, evals=[(shards, "train")])
     assert metrics["train"]["error"] < 0.05
+
+
+def test_colsample_bynode_still_learns():
+    rng = np.random.RandomState(10)
+    x = rng.randn(400, 8).astype(np.float32)
+    y = (x[:, 3] > 0).astype(np.float32)
+    params = dict(_PARAMS)
+    params.update(colsample_bynode=0.6)
+    shards = [{"data": x, "label": y}]
+    eng, metrics = _train(shards, 2, rounds=15, params=params,
+                          evals=[(shards, "train")])
+    assert metrics["train"]["error"] < 0.05

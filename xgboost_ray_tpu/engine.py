@@ -59,7 +59,11 @@ def resolve_hist_impl(impl: str) -> str:
     if impl != "auto":
         return impl
     backend = jax.default_backend()
-    return "onehot" if backend == "tpu" else "scatter"
+    if backend == "cpu":
+        return "scatter"
+    # accelerators: one-hot MXU matmuls while the node fan-out is small,
+    # node-contiguous row partitioning beyond (FLOPs independent of fan-out)
+    return "mixed"
 
 
 class _EvalSet:
@@ -434,14 +438,19 @@ class TpuEngine:
                         fmask = fmask | (
                             jnp.arange(bins.shape[1]) == jnp.argmax(fmask)
                         )
+                    need_level_rng = (
+                        params.colsample_bylevel < 1.0
+                        or params.colsample_bynode < 1.0
+                    )
                     tree, row_value = build_tree(
                         bins,
                         ghk,
                         self.cuts,
                         cfg,
                         feature_mask=fmask,
-                        level_rng=key if params.colsample_bylevel < 1.0 else None,
+                        level_rng=key if need_level_rng else None,
                         colsample_bylevel=params.colsample_bylevel,
+                        colsample_bynode=params.colsample_bynode,
                         allreduce=psum,
                     )
                     trees.append(tree)

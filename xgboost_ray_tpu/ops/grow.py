@@ -69,8 +69,9 @@ def build_tree(
     cuts: jnp.ndarray,  # [F, max_bin-1] raw cut values for threshold recovery
     cfg: GrowConfig,
     feature_mask: Optional[jnp.ndarray] = None,  # [F] bool (colsample_bytree)
-    level_rng: Optional[jnp.ndarray] = None,  # PRNG key for colsample_bylevel
+    level_rng: Optional[jnp.ndarray] = None,  # PRNG key for level/node sampling
     colsample_bylevel: float = 1.0,
+    colsample_bynode: float = 1.0,
     allreduce: Callable[[jnp.ndarray], jnp.ndarray] = lambda x: x,
 ):
     """Grow one tree. Returns (Tree, row_value[N]) — row_value is the leaf
@@ -103,6 +104,17 @@ def build_tree(
             # never mask out every feature
             lmask = lmask | (jnp.arange(num_features) == jnp.argmax(lmask))
             fmask = lmask if fmask is None else (fmask & lmask)
+        if colsample_bynode < 1.0 and level_rng is not None:
+            k = jax.random.fold_in(jax.random.fold_in(level_rng, d), 7919)
+            nmask = (
+                jax.random.uniform(k, (n_nodes, num_features)) < colsample_bynode
+            )
+            # never mask out every feature of a node
+            nmask = nmask | (
+                jnp.arange(num_features)[None, :]
+                == jnp.argmax(nmask, axis=1)[:, None]
+            )
+            fmask = nmask if fmask is None else (nmask & fmask[None, :])
 
         sp = find_splits(hist, node_gh, cfg.split, feature_mask=fmask)
         valid_split = sp.valid & active

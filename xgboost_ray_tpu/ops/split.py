@@ -90,7 +90,13 @@ def find_splits(
     default_left = gain_missing_left >= gain_missing_right
     gain = jnp.maximum(gain_missing_left, gain_missing_right)  # [n, F, B-1]
     if feature_mask is not None:
-        gain = jnp.where(feature_mask[None, :, None], gain, -jnp.inf)
+        # [F] (tree/level sampling) or [n_nodes, F] (per-node sampling)
+        mask = (
+            feature_mask[None, :, None]
+            if feature_mask.ndim == 1
+            else feature_mask[:, :, None]
+        )
+        gain = jnp.where(mask, gain, -jnp.inf)
 
     flat = gain.reshape(n_nodes, -1)
     best = jnp.argmax(flat, axis=-1)  # first max -> deterministic ties

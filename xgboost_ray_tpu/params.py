@@ -31,8 +31,6 @@ _IGNORED = {
     "sampling_method",
     "max_leaves",
     "grow_policy",
-    "monotone_constraints",
-    "interaction_constraints",
     "validate_parameters",
     "single_precision_histogram",
     "use_label_encoder",
@@ -59,6 +57,7 @@ class TrainParams:
     subsample: float = 1.0
     colsample_bytree: float = 1.0
     colsample_bylevel: float = 1.0
+    colsample_bynode: float = 1.0
     max_bin: int = 256
     base_score: Optional[float] = None
     seed: int = 0
@@ -104,6 +103,15 @@ def parse_params(params: Optional[Dict[str, Any]]) -> TrainParams:
     if tree_method != "tpu_hist":
         raise ValueError(f"Unsupported tree_method: {tree_method!r}")
     out.tree_method = tree_method
+
+    for constraint in ("monotone_constraints", "interaction_constraints"):
+        val = params.pop(constraint, None)
+        if val not in (None, "", "()", {}, []):
+            raise NotImplementedError(
+                f"{constraint} are not supported by tpu_hist yet; remove the "
+                f"parameter (silently ignoring a constraint would change "
+                f"model semantics)."
+            )
 
     updater = params.pop("updater", None)
     if updater and "grow_colmaker" in str(updater):

@@ -113,16 +113,11 @@ class RayXGBMixin:
         params = {}
         for name in _PARAM_NAMES:
             if name in ("n_estimators", "early_stopping_rounds", "eval_metric",
-                        "missing", "n_jobs", "verbosity", "colsample_bynode"):
+                        "missing", "n_jobs", "verbosity"):
                 continue
             val = getattr(self, name, None)
             if val is not None:
                 params[name] = val
-        # colsample_bynode has no direct tpu_hist analog; approximate with
-        # per-level sampling so RF variants still decorrelate trees
-        bynode = getattr(self, "colsample_bynode", None)
-        if bynode is not None and getattr(self, "colsample_bylevel", None) is None:
-            params["colsample_bylevel"] = bynode
         if getattr(self, "eval_metric", None) is not None:
             params["eval_metric"] = self.eval_metric
         if getattr(self, "random_state", None) is not None:
