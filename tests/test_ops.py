@@ -292,3 +292,53 @@ print("PALLAS_OK")
         timeout=300, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     assert "PALLAS_OK" in result.stdout, result.stderr[-2000:]
+
+
+def test_build_tree_impls_produce_identical_trees():
+    """scatter / partition (incremental ordering) / mixed must grow the exact
+    same tree — the partition path's O(N) order maintenance is pure layout."""
+    rng = np.random.RandomState(12)
+    x = rng.randn(800, 6).astype(np.float32)
+    g = rng.randn(800).astype(np.float32)
+    h = np.ones(800, np.float32)
+    cuts = binning.sketch_cuts_np(x, max_bin=16)
+    bins = binning.bin_matrix_np(x, cuts, max_bin=16)
+    gh = jnp.asarray(np.stack([g, h], 1))
+    outs = {}
+    for impl in ("scatter", "partition", "mixed"):
+        cfg = GrowConfig(max_depth=5, max_bin=16,
+                         split=SplitParams(learning_rate=1.0), hist_impl=impl)
+        tree, rv = build_tree(jnp.asarray(bins), gh, jnp.asarray(cuts), cfg)
+        outs[impl] = (np.asarray(rv), np.asarray(tree.feature),
+                      np.asarray(tree.value))
+    for impl in ("partition", "mixed"):
+        np.testing.assert_allclose(outs[impl][0], outs["scatter"][0], atol=1e-4)
+        np.testing.assert_array_equal(outs[impl][1], outs["scatter"][1])
+        np.testing.assert_allclose(outs[impl][2], outs["scatter"][2], atol=1e-4)
+
+
+def test_update_partition_order_maintains_sorted_invariant():
+    from xgboost_ray_tpu.ops.histogram import update_partition_order
+
+    rng = np.random.RandomState(13)
+    n = 500
+    order = jnp.arange(n, dtype=jnp.int32)
+    counts = jnp.full((1,), n, jnp.int32)
+    pos = np.zeros(n, np.int64)
+    for level in range(4):
+        go_right = rng.rand(n) < 0.4
+        new_pos = pos * 2 + go_right
+        order, counts = update_partition_order(
+            order, counts, jnp.asarray(go_right)
+        )
+        pos = new_pos
+        o = np.asarray(order)
+        assert sorted(o.tolist()) == list(range(n))  # a permutation
+        assert np.all(np.diff(pos[o]) >= 0)  # sorted by node
+        np.testing.assert_array_equal(
+            np.asarray(counts), np.bincount(pos, minlength=2 ** (level + 1))
+        )
+        # stability: within a node, original relative order preserved
+        for node in np.unique(pos):
+            rows = o[pos[o] == node]
+            assert np.all(np.diff(rows) > 0) or len(rows) <= 1

@@ -22,7 +22,13 @@ from typing import Callable, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-from xgboost_ray_tpu.ops.histogram import build_histogram, node_sums
+from xgboost_ray_tpu.ops.histogram import (
+    build_histogram,
+    hist_onehot,
+    hist_partition_presorted,
+    node_sums,
+    update_partition_order,
+)
 from xgboost_ray_tpu.ops.split import SplitParams, find_splits, leaf_weight
 
 
@@ -88,12 +94,27 @@ def build_tree(
     row_value = jnp.zeros((n,), jnp.float32)
     active = jnp.ones((1,), bool)
 
+    # partition-based impls keep rows sorted by node across levels with an
+    # O(N) stable segment split (no per-level argsort)
+    track_order = cfg.hist_impl in ("partition", "mixed")
+    if track_order:
+        order = jnp.arange(n, dtype=jnp.int32)
+        counts = jnp.full((1,), n, jnp.int32)
+
     for d in range(cfg.max_depth):
         n_nodes = 1 << d
         base = n_nodes - 1
-        hist = build_histogram(
-            bins, gh, pos, n_nodes, nbt, impl=cfg.hist_impl, chunk=cfg.hist_chunk
-        )
+        if track_order and (cfg.hist_impl == "partition" or n_nodes > 4):
+            hist = hist_partition_presorted(
+                bins, gh, order, counts, n_nodes, nbt
+            )
+        elif cfg.hist_impl == "mixed":
+            hist = hist_onehot(bins, gh, pos, n_nodes, nbt, chunk=cfg.hist_chunk)
+        else:
+            hist = build_histogram(
+                bins, gh, pos, n_nodes, nbt, impl=cfg.hist_impl,
+                chunk=cfg.hist_chunk,
+            )
         hist = allreduce(hist)
         node_gh = hist[:, 0, :, :].sum(axis=1)  # [n_nodes, 2] (feature 0 covers all rows)
 
@@ -143,8 +164,11 @@ def build_tree(
         go_right = jnp.where(
             b == missing_bin, ~sp.default_left[pos], b > sp.split_bin[pos]
         )
-        pos = pos * 2 + jnp.where(done, 0, go_right.astype(jnp.int32))
+        effective_right = jnp.where(done, False, go_right)
+        pos = pos * 2 + effective_right.astype(jnp.int32)
         active = jnp.repeat(valid_split, 2)
+        if track_order:
+            order, counts = update_partition_order(order, counts, effective_right)
 
     # Final level: every still-active node is a leaf.
     n_nodes = 1 << cfg.max_depth

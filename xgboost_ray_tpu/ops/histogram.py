@@ -91,6 +91,125 @@ def hist_onehot(
     return acc.reshape(num_features, n_nodes, n_bins_total, 2).transpose(1, 0, 2, 3)
 
 
+def update_partition_order(
+    order: jnp.ndarray,  # [N] rows sorted stably by current pos
+    counts: jnp.ndarray,  # [n_nodes] rows per node at the current level
+    go_right: jnp.ndarray,  # [N] bool, indexed by ORIGINAL row id
+) -> tuple:
+    """O(N) stable segment split: maintain the sorted-by-node row order across
+    one level of tree growth without re-sorting (the XLA analog of gpu_hist's
+    incremental row partitioner). Returns (new_order, new_counts) for the
+    2*n_nodes children."""
+    n = order.shape[0]
+    n_nodes = counts.shape[0]
+    seg_start = jnp.concatenate(
+        [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]]
+    )
+    seg_of_slot = jnp.searchsorted(
+        jnp.cumsum(counts), jnp.arange(n), side="right"
+    )
+    gr_s = go_right[order]
+    left_s = ~gr_s
+    # exclusive cumulative left/right counts, segment-relative
+    cum_left = jnp.cumsum(left_s) - left_s
+    cum_right = jnp.cumsum(gr_s) - gr_s
+    left_before = cum_left[seg_start]  # [n_nodes] lefts before each segment
+    right_before = cum_right[seg_start]
+    rank_left = cum_left - left_before[seg_of_slot]
+    rank_right = cum_right - right_before[seg_of_slot]
+    # child segment sizes
+    seg_end = jnp.cumsum(counts) - 1
+    total_left = jnp.where(
+        counts > 0, cum_left[jnp.maximum(seg_end, 0)] + left_s[jnp.maximum(seg_end, 0)]
+        - left_before, 0
+    )
+    left_count = total_left
+    right_count = counts - left_count
+    new_counts = jnp.stack([left_count, right_count], axis=1).reshape(-1)
+    new_start = jnp.concatenate(
+        [jnp.zeros((1,), new_counts.dtype), jnp.cumsum(new_counts)[:-1]]
+    )
+    child = 2 * seg_of_slot + gr_s.astype(seg_of_slot.dtype)
+    rank = jnp.where(gr_s, rank_right, rank_left)
+    dest = new_start[child] + rank
+    new_order = jnp.zeros_like(order).at[dest].set(order)
+    return new_order, new_counts
+
+
+def hist_partition_presorted(
+    bins: jnp.ndarray,
+    gh: jnp.ndarray,
+    order: jnp.ndarray,  # [N] rows sorted stably by node
+    counts: jnp.ndarray,  # [n_nodes]
+    n_nodes: int,
+    n_bins_total: int,
+    block: int = 256,
+    block_chunk: int = 512,
+) -> jnp.ndarray:
+    """hist_partition with the sort/bincount already maintained by the caller
+    (see ``update_partition_order``)."""
+    n, num_features = bins.shape
+    b32 = bins.astype(jnp.int32)
+    seg_start = jnp.concatenate(
+        [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]]
+    )
+    padded_counts = ((counts + block - 1) // block) * block
+    padded_cum = jnp.cumsum(padded_counts)
+    padded_start = jnp.concatenate(
+        [jnp.zeros((1,), padded_cum.dtype), padded_cum[:-1]]
+    )
+    seg_of_slot = jnp.searchsorted(jnp.cumsum(counts), jnp.arange(n), side="right")
+    rank_in_node = jnp.arange(n) - seg_start[seg_of_slot]
+    dest = (padded_start[seg_of_slot] + rank_in_node).astype(jnp.int32)
+
+    cap = (-(-n // block) + n_nodes) * block
+    n_blocks = cap // block
+    row_of_slot = jnp.full((cap,), n, jnp.int32).at[dest].set(order.astype(jnp.int32))
+    node_of_block = jnp.clip(
+        jnp.searchsorted(padded_cum, jnp.arange(n_blocks) * block, side="right"),
+        0,
+        n_nodes,
+    )
+    bins_ext = jnp.concatenate([b32, jnp.zeros((1, num_features), jnp.int32)])
+    gh_ext = jnp.concatenate([gh, jnp.zeros((1, 2), gh.dtype)])
+    bp = bins_ext[row_of_slot].reshape(n_blocks, block, num_features)
+    ghp = gh_ext[row_of_slot].reshape(n_blocks, block, 2)
+    return _blocked_hist(
+        bp, ghp, node_of_block, n_nodes, n_bins_total, num_features, block_chunk
+    )
+
+
+def _blocked_hist(bp, ghp, node_of_block, n_nodes, n_bins_total, num_features,
+                  block_chunk):
+    n_blocks = bp.shape[0]
+    n_chunks = -(-n_blocks // block_chunk)
+    pad_blocks = n_chunks * block_chunk - n_blocks
+    if pad_blocks:
+        bp = jnp.pad(bp, ((0, pad_blocks), (0, 0), (0, 0)))
+        ghp = jnp.pad(ghp, ((0, pad_blocks), (0, 0), (0, 0)))
+        node_of_block = jnp.pad(node_of_block, (0, pad_blocks), constant_values=n_nodes)
+    bp = bp.reshape(n_chunks, block_chunk, -1, num_features)
+    ghp = ghp.reshape(n_chunks, block_chunk, -1, 2)
+    nodes_c = node_of_block.reshape(n_chunks, block_chunk)
+
+    def chunk_step(hist, args):
+        bc, gc, nodes = args
+
+        def feat_step(f, hist):
+            oh = jax.nn.one_hot(bc[:, :, f], n_bins_total, dtype=jnp.float32)
+            contrib = jnp.einsum(
+                "cbn,cbd->cnd", oh, gc, precision=jax.lax.Precision.HIGHEST
+            )
+            return hist.at[nodes, f].add(contrib)
+
+        hist = jax.lax.fori_loop(0, num_features, feat_step, hist)
+        return hist, None
+
+    hist0 = jnp.zeros((n_nodes + 1, num_features, n_bins_total, 2), jnp.float32)
+    hist, _ = jax.lax.scan(chunk_step, hist0, (bp, ghp, nodes_c))
+    return hist[:n_nodes]
+
+
 def hist_partition(
     bins: jnp.ndarray,
     gh: jnp.ndarray,
