@@ -10,7 +10,11 @@ import numpy as np
 import pandas as pd
 
 from xgboost_ray_tpu import RayDMatrix, RayFileType, RayParams, train
-from examples.higgs import make_synthetic
+
+try:
+    from examples.higgs import make_synthetic
+except ImportError:  # running as a plain script from examples/
+    from higgs import make_synthetic
 
 
 def ensure_parquet_dir(path: str, n_files: int = 8):

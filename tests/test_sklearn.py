@@ -252,3 +252,17 @@ def test_trees_to_dataframe_and_pred_contribs(bc):
     assert (internal["Gain"] > 0).all()
     with pytest.raises(NotImplementedError):
         bst.predict(x_tr[:5], pred_contribs=True)
+
+
+def test_apply_returns_leaf_indices(bc):
+    x_tr, _, y_tr, _ = bc
+    clf = RayXGBClassifier(n_estimators=4, max_depth=3)
+    clf.fit(x_tr, y_tr, ray_params=RP)
+    leaves = clf.apply(x_tr[:20])
+    assert leaves.shape == (20, 4)
+    heap_size = 2 ** 4 - 1
+    assert leaves.min() >= 0 and leaves.max() < heap_size
+    # every returned node must actually be a leaf
+    bst = clf.get_booster()
+    for t in range(4):
+        assert bst.forest.is_leaf[t, leaves[:, t]].all()

@@ -259,6 +259,15 @@ class RayXGBMixin:
             _remote=_remote, **kwargs,
         )
 
+    def apply(self, X, ntree_limit: int = 0) -> np.ndarray:
+        """Per-tree leaf heap index for each sample (xgboost ``apply`` analog)."""
+        booster = self.get_booster()
+        x = booster._coerce_features(X)
+        leaves = booster.predict(x, pred_leaf=True, validate_features=False)
+        if ntree_limit:
+            leaves = leaves[:, :ntree_limit]
+        return leaves
+
     @property
     def feature_importances_(self) -> np.ndarray:
         """Normalized importance; type from ``importance_type`` (default
