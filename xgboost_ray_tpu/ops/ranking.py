@@ -78,24 +78,30 @@ def _pairwise_lambdas(s, y, valid, use_ndcg_delta: bool):
     return g, h
 
 
-def make_rank_grad_hess(name: str, group_chunk: int = 256) -> Callable:
+def make_rank_grad_hess(name: str, group_chunk: int = 0) -> Callable:
     use_ndcg = name in ("rank:ndcg", "rank:map")
 
     def grad_hess(margin, label, weight, group_rows):
         """margin [N, 1], label [N], weight [N], group_rows [NG, G] -> g, h [N, 1]."""
         n = label.shape[0]
         ng, gsz = group_rows.shape
+        if group_chunk:
+            chunk = group_chunk
+        else:
+            # bound the [chunk, G, G] pair tensors to ~64M float32 elements
+            # (MSLR-scale groups of ~1200 docs -> chunk ~44)
+            chunk = int(np.clip(64_000_000 // max(gsz * gsz, 1), 1, 256))
         s_ext = jnp.concatenate([margin[:, 0], jnp.zeros((1,), margin.dtype)])
         y_ext = jnp.concatenate([label, jnp.zeros((1,), label.dtype)])
         valid = group_rows < n
         rows = jnp.minimum(group_rows, n)  # sentinel -> slot n
 
-        n_chunks = -(-ng // group_chunk)
-        pad = n_chunks * group_chunk - ng
+        n_chunks = -(-ng // chunk)
+        pad = n_chunks * chunk - ng
         rows_p = jnp.pad(rows, ((0, pad), (0, 0)), constant_values=n)
         valid_p = jnp.pad(valid, ((0, pad), (0, 0)), constant_values=False)
-        rows_c = rows_p.reshape(n_chunks, group_chunk, gsz)
-        valid_c = valid_p.reshape(n_chunks, group_chunk, gsz)
+        rows_c = rows_p.reshape(n_chunks, chunk, gsz)
+        valid_c = valid_p.reshape(n_chunks, chunk, gsz)
 
         def chunk_step(acc, args):
             r, v = args
