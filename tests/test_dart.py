@@ -111,3 +111,21 @@ def test_dart_via_sklearn():
     clf.fit(x, y, ray_params=RayParams(num_actors=2))
     assert clf.get_booster().tree_weights is not None
     assert (clf.predict(x, ray_params=RayParams(num_actors=2)) == y).mean() > 0.9
+
+
+def test_dart_multiclass():
+    rng = np.random.RandomState(6)
+    n = 240
+    y = rng.randint(0, 3, n).astype(np.float32)
+    x = np.eye(3, dtype=np.float32)[y.astype(int)] + 0.05 * rng.randn(n, 3).astype(
+        np.float32
+    )
+    bst = train({"objective": "multi:softprob", "num_class": 3, "max_depth": 3,
+                 "booster": "dart", "rate_drop": 0.2, "one_drop": 1,
+                 "eta": 0.5},
+                RayDMatrix(x, y), 8, ray_params=RayParams(num_actors=2))
+    assert bst.num_trees == 24  # 8 rounds x 3 classes
+    assert bst.tree_weights.shape == (24,)
+    proba = bst.predict(x)
+    assert proba.shape == (n, 3)
+    assert (proba.argmax(axis=1) == y.astype(int)).mean() > 0.95
