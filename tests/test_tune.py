@@ -157,3 +157,12 @@ def test_tuner_isolates_trial_failures(tmp_path, xy):
     result = tuner.fit()
     assert result.trials[1].error is not None
     assert result.get_best_trial().config["max_depth"] == 2
+
+
+def test_placement_strategy_selection(monkeypatch, tmp_path):
+    from xgboost_ray_tpu.main import _get_placement_strategy
+
+    assert _get_placement_strategy(in_tune_session=False) == "SPREAD"
+    assert _get_placement_strategy(in_tune_session=True) == "PACK"
+    monkeypatch.setenv("RXGB_USE_SPREAD_STRATEGY", "0")
+    assert _get_placement_strategy(in_tune_session=False) == "PACK"

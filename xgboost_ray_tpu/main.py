@@ -283,6 +283,17 @@ def _create_actor(
     )
 
 
+def _get_placement_strategy(in_tune_session: bool) -> str:
+    """SPREAD for standalone training (fault isolation), PACK inside tuning
+    trials — the reference's strategy choice (``main.py:1581-1599``,
+    ``tune.py:123``), gated on RXGB_USE_SPREAD_STRATEGY. On TPU the mesh
+    placement is physical, but schedulers above (multi-slice trial runners)
+    still consume this hint via get_tune_resources()."""
+    if in_tune_session:
+        return "PACK"
+    return "SPREAD" if ENV.USE_SPREAD_STRATEGY else "PACK"
+
+
 def _handle_queue(queue: Queue, checkpoint: _Checkpoint, callback_returns: Dict):
     """Drain the callback queue (mirror of ``main.py:902-922``)."""
     while not queue.empty():
@@ -436,9 +447,12 @@ def _train(
         newly_created += 1
     alive_actors = sum(1 for a in state.actors if a is not None)
     if ray_params.verbose:
+        from xgboost_ray_tpu import tune as tune_mod
+
+        strategy = _get_placement_strategy(tune_mod.is_session_enabled())
         logger.info(
             f"[RayXGBoost] Created {newly_created} new actors "
-            f"({alive_actors} total actors)."
+            f"({alive_actors} total actors, {strategy} placement)."
         )
 
     # 2) locality / FIXED shard assignment (mirror main.py:1161-1165)
