@@ -1,0 +1,84 @@
+"""Microbenchmark the histogram implementations (the tpu_hist hot op).
+
+Run on real hardware to pin ``resolve_hist_impl``'s accelerator default:
+
+    python tools/bench_hist.py                    # ambient backend
+    JAX_PLATFORMS=cpu python tools/bench_hist.py  # CPU sanity
+
+Prints per-(impl, n_nodes) timings plus a full build_tree comparison; the
+winning impl per fan-out regime is what `mixed` should select.
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--rows", type=int, default=1_000_000)
+    parser.add_argument("--features", type=int, default=28)
+    parser.add_argument("--max-bin", type=int, default=256)
+    parser.add_argument("--depth", type=int, default=6)
+    parser.add_argument("--repeats", type=int, default=3)
+    parser.add_argument("--impls", nargs="+",
+                        default=["scatter", "onehot", "partition"])
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from xgboost_ray_tpu.ops import binning
+    from xgboost_ray_tpu.ops.grow import GrowConfig, build_tree
+    from xgboost_ray_tpu.ops.histogram import build_histogram
+    from xgboost_ray_tpu.ops.split import SplitParams
+
+    print(f"backend={jax.default_backend()} rows={args.rows} "
+          f"features={args.features} bins={args.max_bin}")
+
+    rng = np.random.RandomState(0)
+    nbt = args.max_bin + 1
+    bins_np = rng.randint(0, nbt, size=(args.rows, args.features))
+    bins = jnp.asarray(bins_np.astype(
+        np.uint8 if nbt <= 256 else np.int16))
+    gh = jnp.asarray(rng.randn(args.rows, 2).astype(np.float32))
+
+    for n_nodes in (1, 8, 64):
+        pos = jnp.asarray(
+            rng.randint(0, n_nodes, size=args.rows).astype(np.int32))
+        for impl in args.impls:
+            try:
+                fn = jax.jit(
+                    lambda b, g, p, impl=impl, nn=n_nodes: build_histogram(
+                        b, g, p, nn, nbt, impl=impl))
+                fn(bins, gh, pos).block_until_ready()  # compile
+                t0 = time.time()
+                for _ in range(args.repeats):
+                    fn(bins, gh, pos).block_until_ready()
+                dt = (time.time() - t0) / args.repeats
+                print(f"  hist n_nodes={n_nodes:3d} {impl:10s} {dt * 1e3:9.2f} ms")
+            except Exception as exc:  # noqa: BLE001
+                print(f"  hist n_nodes={n_nodes:3d} {impl:10s} FAILED: "
+                      f"{str(exc)[:80]}")
+
+    # full tree builds (includes partition-order maintenance, split search)
+    x = rng.randn(args.rows, args.features).astype(np.float32)
+    cuts = jnp.asarray(binning.sketch_cuts_np(x[:100_000], args.max_bin))
+    for impl in args.impls + ["mixed"]:
+        try:
+            cfg = GrowConfig(max_depth=args.depth, max_bin=args.max_bin,
+                             split=SplitParams(), hist_impl=impl)
+            fn = jax.jit(lambda b, g: build_tree(b, g, cuts, cfg)[1])
+            fn(bins, gh).block_until_ready()
+            t0 = time.time()
+            for _ in range(args.repeats):
+                fn(bins, gh).block_until_ready()
+            dt = (time.time() - t0) / args.repeats
+            print(f"  tree depth={args.depth} {impl:10s} {dt * 1e3:9.2f} ms")
+        except Exception as exc:  # noqa: BLE001
+            print(f"  tree depth={args.depth} {impl:10s} FAILED: {str(exc)[:80]}")
+
+
+if __name__ == "__main__":
+    main()
