@@ -34,6 +34,24 @@ def test_dart_trains_and_predicts():
     assert ((pred > 0.5) == y).mean() > 0.9
 
 
+def test_dart_with_separate_validation_set():
+    # Regression test: dart eval_data entries carry an extra static-margin
+    # slot (6-tuples); metric_contribs must not assume the 5-tuple shape.
+    x, y = _data(seed=7)
+    xv, yv = _data(n=120, seed=8)
+    dtrain = RayDMatrix(x, y)
+    dvalid = RayDMatrix(xv, yv)
+    evals_result = {}
+    bst = train(dict(_BASE, booster="dart", rate_drop=0.2, one_drop=1),
+                dtrain, 10,
+                evals=[(dtrain, "train"), (dvalid, "valid")],
+                evals_result=evals_result,
+                ray_params=RayParams(num_actors=2))
+    assert bst.num_boosted_rounds() == 10
+    assert len(evals_result["valid"]["logloss"]) == 10
+    assert evals_result["valid"]["error"][-1] < 0.2
+
+
 def test_dart_zero_drop_matches_gbtree():
     x, y = _data(seed=1)
     bst_dart = train(dict(_BASE, booster="dart", rate_drop=0.0, skip_drop=0.0),

@@ -65,6 +65,28 @@ def test_crlf_line_endings(tmp_path):
     np.testing.assert_allclose(matrix, [[1.0, 2.0], [3.0, 4.0]])
 
 
+def test_crlf_blank_lines_do_not_overflow(tmp_path):
+    # A CRLF file with blank body lines (bare "\r\n"): the row counter skips
+    # them, and the parser must skip them identically or it writes one NaN row
+    # per blank line past the rows*cols buffer (heap overflow).
+    p = str(tmp_path / "crlf_blank.csv")
+    with open(p, "wb") as f:
+        f.write(b"x,y\r\n1.0,2.0\r\n\r\n3.0,4.0\r\n\r\n\r\n5.0,6.0\r\n")
+    matrix, names = native.read_csv_numpy(p)
+    assert names == ["x", "y"]
+    assert matrix.shape == (3, 2)
+    np.testing.assert_allclose(matrix, [[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+
+
+def test_lf_blank_lines_do_not_overflow(tmp_path):
+    p = str(tmp_path / "lf_blank.csv")
+    with open(p, "wb") as f:
+        f.write(b"x,y\n1.0,2.0\n\n3.0,4.0\n\n")
+    matrix, names = native.read_csv_numpy(p)
+    assert matrix.shape == (2, 2)
+    np.testing.assert_allclose(matrix, [[1.0, 2.0], [3.0, 4.0]])
+
+
 def test_headerless_numeric_falls_back(tmp_path):
     p = str(tmp_path / "nh.csv")
     with open(p, "w") as f:

@@ -317,6 +317,37 @@ def test_build_tree_impls_produce_identical_trees():
         np.testing.assert_allclose(outs[impl][2], outs["scatter"][2], atol=1e-4)
 
 
+def test_sibling_subtraction_matches_direct_build():
+    """Deriving the larger child as parent - smaller child must grow the same
+    tree as building both children directly (fp-subtraction noise aside)."""
+    rng = np.random.RandomState(21)
+    x = rng.randn(1000, 5).astype(np.float32)
+    g = rng.randn(1000).astype(np.float32)
+    h = np.abs(rng.randn(1000)).astype(np.float32) + 0.5
+    cuts = binning.sketch_cuts_np(x, max_bin=32)
+    bins = binning.bin_matrix_np(x, cuts, max_bin=32)
+    gh = jnp.asarray(np.stack([g, h], 1))
+    outs = {}
+    for impl in ("scatter", "mixed"):
+        for sib in (True, False):
+            cfg = GrowConfig(max_depth=6, max_bin=32,
+                             split=SplitParams(learning_rate=1.0),
+                             hist_impl=impl, sibling_subtract=sib)
+            tree, rv = build_tree(jnp.asarray(bins), gh, jnp.asarray(cuts), cfg)
+            outs[(impl, sib)] = (np.asarray(rv), np.asarray(tree.feature),
+                                 np.asarray(tree.value))
+    for impl in ("scatter", "mixed"):
+        np.testing.assert_array_equal(
+            outs[(impl, True)][1], outs[(impl, False)][1]
+        )
+        np.testing.assert_allclose(
+            outs[(impl, True)][0], outs[(impl, False)][0], atol=1e-3
+        )
+        np.testing.assert_allclose(
+            outs[(impl, True)][2], outs[(impl, False)][2], atol=1e-3
+        )
+
+
 def test_update_partition_order_maintains_sorted_invariant():
     from xgboost_ray_tpu.ops.histogram import update_partition_order
 

@@ -34,7 +34,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from xgboost_ray_tpu.models.booster import RayXGBoostBooster, stack_trees
 from xgboost_ray_tpu.ops import binning
-from xgboost_ray_tpu.ops.grow import GrowConfig, Tree, build_tree, predict_tree_binned
+from xgboost_ray_tpu.ops.grow import (
+    SALT_BYTREE,
+    SALT_SUBSAMPLE,
+    GrowConfig,
+    Tree,
+    build_tree,
+    predict_tree_binned,
+)
 from xgboost_ray_tpu.ops.metrics import (
     compute_metric,
     elementwise_contrib,
@@ -150,6 +157,7 @@ class TpuEngine:
             ),
             hist_impl=resolve_hist_impl(params.hist_impl),
             hist_chunk=params.hist_chunk,
+            sibling_subtract=params.sibling_subtract,
         )
 
         # metrics
@@ -423,14 +431,17 @@ class TpuEngine:
                     key = jax.random.fold_in(rng, k * t_par + t)
                     ghk = jnp.stack([g[:, k], h[:, k]], axis=1)
                     if params.subsample < 1.0:
-                        skey = jax.random.fold_in(key, jax.lax.axis_index("actors") + 1)
+                        skey = jax.random.fold_in(
+                            jax.random.fold_in(key, SALT_SUBSAMPLE),
+                            jax.lax.axis_index("actors"),
+                        )
                         keep = (
                             jax.random.uniform(skey, (ghk.shape[0],)) < params.subsample
                         )
                         ghk = ghk * keep[:, None]
                     fmask = None
                     if params.colsample_bytree < 1.0:
-                        fkey = jax.random.fold_in(key, 0)
+                        fkey = jax.random.fold_in(key, SALT_BYTREE)
                         fmask = (
                             jax.random.uniform(fkey, (bins.shape[1],))
                             < params.colsample_bytree
@@ -473,7 +484,9 @@ class TpuEngine:
                 if es.is_train:
                     m, lab, w = new_margins, label, w_eff
                 else:
-                    _, elab, ew, evalid, _ = eval_data[ei]
+                    # dart passes 6-tuples (extra static-margin slot); take the
+                    # shared (label, weight, valid) prefix positions only.
+                    elab, ew, evalid = eval_data[ei][1:4]
                     m, lab, w = (
                         new_eval_margins[ei],
                         elab,

@@ -721,7 +721,9 @@ def _train(
 
     booster = engine.get_booster()
     if es_metric is not None and es_best_iter >= 0:
-        booster.best_iteration = es_best_iter
+        # es_best_iter is attempt-local; xgboost reports the *global* boosting
+        # round, so rebase by the continuation offset (xgb_model / restart).
+        booster.best_iteration = engine.iteration_offset + es_best_iter
         booster.best_score = es_best
 
     for model_cb in callbacks:

@@ -57,6 +57,14 @@ bool is_na_token(const char* begin, const char* end) {
          !std::strncmp(low, "null", 5);
 }
 
+// A line in data[begin, end) counts as a row iff this is false. Must be the
+// single source of truth for both the row counters and the parser, or the
+// parser writes a different number of rows than fcsv_rows() promised.
+bool is_blank_line(const char* data, size_t begin, size_t end) {
+  if (end <= begin) return true;                          // empty (LF only)
+  return end - begin == 1 && data[begin] == '\r';         // bare CR from CRLF
+}
+
 float parse_field(const char* begin, const char* end) {
   while (begin < end && (*begin == ' ' || *begin == '\t')) ++begin;
   while (end > begin && (end[-1] == ' ' || end[-1] == '\t' || end[-1] == '\r')) --end;
@@ -77,7 +85,7 @@ void parse_span(const CsvFile& file, size_t begin, size_t end, int64_t row0,
   while (pos < end) {
     size_t line_end = pos;
     while (line_end < end && data[line_end] != '\n') ++line_end;
-    if (line_end > pos) {  // skip blank lines
+    if (!is_blank_line(data, pos, line_end)) {
       float* out_row = out + row * file.cols;
       size_t field_start = pos;
       int64_t col = 0;
@@ -138,7 +146,7 @@ int64_t fcsv_open(const char* path, int skip_header) {
   while (scan < s.size()) {
     size_t eol = s.find('\n', scan);
     if (eol == std::string::npos) eol = s.size();
-    if (eol > scan && !(eol - scan == 1 && s[scan] == '\r')) ++rows;
+    if (!is_blank_line(s.data(), scan, eol)) ++rows;
     scan = eol + 1;
   }
   file->rows = rows;
@@ -211,7 +219,7 @@ int fcsv_parse(int64_t handle, float* out, int n_threads) {
           size_t eol = s.find('\n', scan);
           if (eol == std::string::npos || eol >= starts[t + 1])
             eol = starts[t + 1];
-          if (eol > scan && !(eol - scan == 1 && s[scan] == '\r')) ++rows;
+          if (!is_blank_line(s.data(), scan, eol)) ++rows;
           scan = eol + 1;
         }
         chunk_rows[t] = rows;

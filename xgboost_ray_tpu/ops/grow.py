@@ -31,6 +31,15 @@ from xgboost_ray_tpu.ops.histogram import (
 )
 from xgboost_ray_tpu.ops.split import SplitParams, find_splits, leaf_weight
 
+# Disjoint fold_in domains for the per-tree sampling mechanisms, so row
+# subsampling and the three column-sampling masks never draw from overlapping
+# PRNG streams (a bare fold_in(key, d) for bylevel would collide with
+# fold_in(key, 0) for bytree and fold_in(key, rank+1) for subsample).
+SALT_SUBSAMPLE = 0x51D1
+SALT_BYTREE = 0x51D2
+SALT_BYLEVEL = 0x51D3
+SALT_BYNODE = 0x51D4
+
 
 @dataclasses.dataclass(frozen=True)
 class GrowConfig:
@@ -39,6 +48,11 @@ class GrowConfig:
     split: SplitParams = dataclasses.field(default_factory=SplitParams)
     hist_impl: str = "scatter"
     hist_chunk: int = 8192
+    # Build only the globally-smaller child's histogram per parent and derive
+    # the sibling as parent - child (xgboost hist/gpu_hist's core trick):
+    # halves the built/allreduced histogram tensor at every level >= 1, and
+    # halves the one-hot matmul FLOPs for the onehot path.
+    sibling_subtract: bool = True
 
     @property
     def heap_size(self) -> int:
@@ -97,36 +111,71 @@ def build_tree(
     # partition-based impls keep rows sorted by node across levels with an
     # O(N) stable segment split (no per-level argsort)
     track_order = cfg.hist_impl in ("partition", "mixed")
+    order = counts = None
     if track_order:
         order = jnp.arange(n, dtype=jnp.int32)
         counts = jnp.full((1,), n, jnp.int32)
 
+    prev_hist = None
     for d in range(cfg.max_depth):
         n_nodes = 1 << d
         base = n_nodes - 1
-        if track_order and (cfg.hist_impl == "partition" or n_nodes > 4):
-            hist = hist_partition_presorted(
-                bins, gh, order, counts, n_nodes, nbt
+
+        def _build(gh_b, pos_b, order_b, counts_b, nn):
+            """One histogram build over nn node slots with the configured impl."""
+            if track_order and (cfg.hist_impl == "partition" or nn > 4):
+                return hist_partition_presorted(bins, gh_b, order_b, counts_b, nn, nbt)
+            if cfg.hist_impl == "mixed":
+                return hist_onehot(bins, gh_b, pos_b, nn, nbt, chunk=cfg.hist_chunk)
+            return build_histogram(
+                bins, gh_b, pos_b, nn, nbt, impl=cfg.hist_impl, chunk=cfg.hist_chunk,
             )
-        elif cfg.hist_impl == "mixed":
-            hist = hist_onehot(bins, gh, pos, n_nodes, nbt, chunk=cfg.hist_chunk)
+
+        if cfg.sibling_subtract and d > 0 and prev_hist is not None:
+            # Sibling subtraction: per parent, build only the globally-smaller
+            # child's histogram (indexed by parent -> half the tensor and half
+            # the one-hot width) and derive the sibling as parent - child.
+            # The choice must be identical on every shard, so it is made from
+            # allreduced per-child row counts.
+            n_par = n_nodes // 2
+            child_counts = allreduce(
+                jnp.zeros((n_nodes,), jnp.float32).at[pos].add(
+                    (~done).astype(jnp.float32)
+                )
+            )
+            # [n_par] True when the right child is the (weakly) smaller one
+            small_is_right = child_counts[1::2] <= child_counts[0::2]
+            parent_pos = pos >> 1
+            is_right = (pos & 1).astype(bool)
+            sel = (is_right == small_is_right[parent_pos]) & ~done
+            gh_sel = gh * sel[:, None].astype(gh.dtype)
+            counts_par = (
+                counts.reshape(-1, 2).sum(axis=1) if track_order else None
+            )
+            hist_small = allreduce(
+                _build(gh_sel, parent_pos, order, counts_par, n_par)
+            )
+            hist_big = prev_hist - hist_small
+            sir = small_is_right[:, None, None, None]
+            left = jnp.where(sir, hist_big, hist_small)
+            right = jnp.where(sir, hist_small, hist_big)
+            hist = jnp.stack([left, right], axis=1).reshape(
+                (n_nodes,) + hist_small.shape[1:]
+            )
         else:
-            hist = build_histogram(
-                bins, gh, pos, n_nodes, nbt, impl=cfg.hist_impl,
-                chunk=cfg.hist_chunk,
-            )
-        hist = allreduce(hist)
+            hist = allreduce(_build(gh, pos, order, counts, n_nodes))
+        prev_hist = hist
         node_gh = hist[:, 0, :, :].sum(axis=1)  # [n_nodes, 2] (feature 0 covers all rows)
 
         fmask = feature_mask
         if colsample_bylevel < 1.0 and level_rng is not None:
-            k = jax.random.fold_in(level_rng, d)
+            k = jax.random.fold_in(jax.random.fold_in(level_rng, SALT_BYLEVEL), d)
             lmask = jax.random.uniform(k, (num_features,)) < colsample_bylevel
             # never mask out every feature
             lmask = lmask | (jnp.arange(num_features) == jnp.argmax(lmask))
             fmask = lmask if fmask is None else (fmask & lmask)
         if colsample_bynode < 1.0 and level_rng is not None:
-            k = jax.random.fold_in(jax.random.fold_in(level_rng, d), 7919)
+            k = jax.random.fold_in(jax.random.fold_in(level_rng, SALT_BYNODE), d)
             nmask = (
                 jax.random.uniform(k, (n_nodes, num_features)) < colsample_bynode
             )

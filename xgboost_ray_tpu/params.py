@@ -80,6 +80,9 @@ class TrainParams:
     # tpu_hist internals
     hist_impl: str = "auto"  # auto | scatter | onehot | partition | mixed | pallas
     hist_chunk: int = 8192
+    # build only the smaller child's histogram per parent, derive the sibling
+    # by subtraction (xgboost hist-core behavior); disable for A/B debugging
+    sibling_subtract: bool = True
 
 
 def parse_params(params: Optional[Dict[str, Any]]) -> TrainParams:
@@ -138,6 +141,12 @@ def parse_params(params: Optional[Dict[str, Any]]) -> TrainParams:
             try:
                 if name == "base_score":
                     value = float(value)
+                elif field_type is bool:
+                    value = (
+                        value.strip().lower() in ("1", "true", "yes")
+                        if isinstance(value, str)
+                        else bool(value)
+                    )
                 elif field_type is float:
                     value = float(value)
                 elif field_type is int:
