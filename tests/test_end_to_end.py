@@ -371,12 +371,73 @@ def test_distributed_callbacks_fire_in_order():
     assert ("init", 0) in events and ("init", 1) in events
 
 
-def test_feature_weights_accepted_and_stored():
-    x, y, _ = _one_hot_fixture()
-    fw = np.array([1.0, 1.0, 0.5, 0.5], np.float32)
+def test_feature_weights_bias_column_sampling():
+    """Reference testFeatureWeightsParam (test_end_to_end.py:429-468): with
+    colsample_bynode=0.1 and fw[i] = i over 10 features, feature 0 (weight 0)
+    must never be drawn and feature 9 must dominate split counts."""
+    rng = np.random.RandomState(1994)
+    x = rng.randn(1000, 10).astype(np.float32)
+    y = rng.randn(1000).astype(np.float32)
+    fw = np.arange(10, dtype=np.float32)
     dtrain = RayDMatrix(x, y, feature_weights=fw)
-    bst = train(_PARAMS, dtrain, 5, ray_params=RayParams(num_actors=2))
-    assert bst.num_boosted_rounds() == 5
+    bst = train(
+        {"objective": "reg:squarederror", "eval_metric": ["rmse"],
+         "colsample_bynode": 0.1, "max_depth": 4},
+        dtrain, 50, ray_params=RayParams(num_actors=2),
+    )
+    fmap = bst.get_fscore()
+    assert fmap.get("f0") is None
+    assert fmap and max(fmap.values()) == fmap.get("f9")
+
+
+def test_feature_weights_zero_forces_remaining_feature():
+    """fw = [1, 0, 0, ...]: every split lands on feature 0."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(400, 5).astype(np.float32)
+    y = (x[:, 0] + 0.2 * x[:, 1] > 0).astype(np.float32)
+    fw = np.array([1.0, 0.0, 0.0, 0.0, 0.0], np.float32)
+    bst = train(
+        {"objective": "binary:logistic", "colsample_bytree": 0.5,
+         "max_depth": 3},
+        RayDMatrix(x, y, feature_weights=fw), 8,
+        ray_params=RayParams(num_actors=2),
+    )
+    fmap = bst.get_fscore()
+    assert set(fmap) == {"f0"}
+
+
+def test_feature_weights_change_the_model():
+    """The knob must actually alter training (no silent no-op)."""
+    rng = np.random.RandomState(4)
+    x = rng.randn(500, 6).astype(np.float32)
+    y = (x[:, 0] + x[:, 3] > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "colsample_bytree": 0.5,
+              "max_depth": 3}
+    bst_plain = train(params, RayDMatrix(x, y), 6,
+                      ray_params=RayParams(num_actors=2))
+    fw = np.array([0.0, 1.0, 1.0, 0.0, 1.0, 1.0], np.float32)
+    bst_fw = train(params, RayDMatrix(x, y, feature_weights=fw), 6,
+                   ray_params=RayParams(num_actors=2))
+    assert bst_fw.get_fscore() != bst_plain.get_fscore()
+    assert "f0" not in bst_fw.get_fscore()
+    assert "f3" not in bst_fw.get_fscore()
+
+
+def test_feature_weights_validation():
+    x = np.random.RandomState(5).randn(50, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    with pytest.raises(ValueError, match="entries"):
+        train({"objective": "binary:logistic"},
+              RayDMatrix(x, y, feature_weights=np.ones(3, np.float32)), 2,
+              ray_params=RayParams(num_actors=2))
+    with pytest.raises(ValueError, match="non-negative"):
+        train({"objective": "binary:logistic"},
+              RayDMatrix(x, y, feature_weights=np.array([1, -1, 1, 1.0])), 2,
+              ray_params=RayParams(num_actors=2))
+    with pytest.raises(ValueError, match="all zero"):
+        train({"objective": "binary:logistic"},
+              RayDMatrix(x, y, feature_weights=np.zeros(4, np.float32)), 2,
+              ray_params=RayParams(num_actors=2))
 
 
 def test_batched_rounds_match_per_round_path():

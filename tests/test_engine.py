@@ -250,3 +250,35 @@ def test_colsample_bynode_still_learns():
     eng, metrics = _train(shards, 2, rounds=15, params=params,
                           evals=[(shards, "train")])
     assert metrics["train"]["error"] < 0.05
+
+
+def test_incremental_forest_stacking_consistent():
+    """get_booster() between checkpoint intervals must see the same forest as
+    a from-scratch stack (the cache appends instead of re-concatenating)."""
+    import numpy as np
+    from xgboost_ray_tpu.engine import TpuEngine
+    from xgboost_ray_tpu.models.booster import stack_trees
+    from xgboost_ray_tpu.params import parse_params
+
+    rng = np.random.RandomState(17)
+    x = rng.randn(200, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    shards = [{"data": x, "label": y, "weight": None, "base_margin": None,
+               "label_lower_bound": None, "label_upper_bound": None,
+               "qid": None}]
+    eng = TpuEngine(shards, parse_params({"objective": "binary:logistic",
+                                          "max_depth": 3}), num_actors=1)
+    snapshots = []
+    for i in range(6):
+        eng.step(i)
+        if i % 2 == 1:
+            snapshots.append(eng.get_booster())
+    direct = stack_trees(eng.trees)
+    cached = eng._stacked_forest()
+    for a, b in zip(direct, cached):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # earlier snapshots must be unaffected by later appends
+    assert snapshots[0].forest.feature.shape[0] < snapshots[-1].forest.feature.shape[0]
+    p0 = snapshots[0].predict(x, output_margin=True)
+    eng.step(6)
+    np.testing.assert_array_equal(p0, snapshots[0].predict(x, output_margin=True))

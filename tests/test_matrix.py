@@ -246,6 +246,57 @@ def test_device_quantile_dmatrix_alias(xy):
     assert shard["data"].shape == (64, 4)
 
 
+def test_device_quantile_dmatrix_max_bin_forwarded(xy):
+    """max_bin on the matrix must reach the engine (not be silently dropped):
+    with max_bin=2 only one cut per feature exists, so the model differs from
+    the default 256-bin one."""
+    from xgboost_ray_tpu import RayParams, train
+
+    rng = np.random.RandomState(7)
+    x = rng.randn(300, 4).astype(np.float32)
+    y = (x[:, 0] + 0.3 * x[:, 1] > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "max_depth": 3}
+    bst_default = train(params, RayDMatrix(x, y), 5,
+                        ray_params=RayParams(num_actors=2))
+    bst_coarse = train(params, RayDeviceQuantileDMatrix(x, y, max_bin=2), 5,
+                       ray_params=RayParams(num_actors=2))
+    p_def = bst_default.predict(x, output_margin=True)
+    p_coarse = bst_coarse.predict(x, output_margin=True)
+    assert not np.allclose(p_def, p_coarse)
+    # coarse binning leaves at most 1 distinct threshold per feature
+    thr = np.asarray(bst_coarse.forest.threshold)[
+        np.asarray(bst_coarse.forest.feature) >= 0
+    ]
+    assert len({float(t) for t in thr}) <= 4  # <= n_features distinct cuts
+
+
+def test_sample_weights_shift_sketch_cuts(xy):
+    """Weighted rows must pull quantile-sketch cut points toward their mass:
+    training with extreme weights on the upper half must change the model vs
+    unweighted (sketch weight-awareness, xgboost parity)."""
+    from xgboost_ray_tpu import RayParams, train
+    from xgboost_ray_tpu.engine import TpuEngine
+    from xgboost_ray_tpu.params import parse_params
+
+    rng = np.random.RandomState(11)
+    x = np.sort(rng.randn(400, 1).astype(np.float32), axis=0)
+    y = (x[:, 0] > 0).astype(np.float32)
+    w_hi = np.where(x[:, 0] > np.quantile(x[:, 0], 0.9), 1000.0, 0.001).astype(
+        np.float32
+    )
+    parsed = parse_params({"max_bin": 8})
+    shard_plain = [{"data": x, "label": y, "weight": None, "base_margin": None,
+                    "label_lower_bound": None, "label_upper_bound": None,
+                    "qid": None}]
+    shard_w = [dict(shard_plain[0], weight=w_hi)]
+    eng_plain = TpuEngine(shard_plain, parsed, num_actors=1)
+    eng_w = TpuEngine(shard_w, parsed, num_actors=1)
+    cuts_plain = np.asarray(eng_plain.cuts)
+    cuts_w = np.asarray(eng_w.cuts)
+    # weighted cuts concentrate in the heavy region (higher values)
+    assert np.median(cuts_w) > np.median(cuts_plain)
+
+
 def test_uid_identity(xy):
     x, y = xy
     a = RayDMatrix(x, y)

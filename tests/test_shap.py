@@ -1,0 +1,135 @@
+"""pred_contribs (Saabas path attribution) tests.
+
+Reference surface: ``xgb.Booster.predict(pred_contribs=True)`` passed through
+by the reference's actor predict (``xgboost_ray/main.py:795-810``). The
+defining property (shared by Saabas and exact tree-SHAP): contributions +
+bias sum exactly to the margin prediction per row.
+"""
+
+import numpy as np
+import pytest
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+
+def _sum_check(bst, x, atol=1e-4):
+    contribs = bst.predict(x, pred_contribs=True, approx_contribs=True)
+    margins = bst.predict(x, output_margin=True)
+    if contribs.ndim == 2:  # [N, F+1]
+        np.testing.assert_allclose(contribs.sum(axis=1), margins, atol=atol)
+    else:  # [N, K, F+1]
+        np.testing.assert_allclose(contribs.sum(axis=2), margins, atol=atol)
+    return contribs
+
+
+def test_contribs_sum_to_margin_binary():
+    rng = np.random.RandomState(0)
+    x = rng.randn(300, 6).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 2] > 0).astype(np.float32)
+    bst = train({"objective": "binary:logistic", "max_depth": 4},
+                RayDMatrix(x, y), 10, ray_params=RayParams(num_actors=2))
+    contribs = _sum_check(bst, x)
+    assert contribs.shape == (300, 7)
+    # informative features get the bulk of absolute attribution
+    mass = np.abs(contribs[:, :-1]).sum(axis=0)
+    assert mass[0] == mass.max()
+
+
+def test_contribs_sum_to_margin_multiclass():
+    rng = np.random.RandomState(1)
+    x = rng.randn(240, 5).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32) + (x[:, 1] > 0).astype(np.int32)
+    bst = train({"objective": "multi:softprob", "num_class": 3, "max_depth": 3},
+                RayDMatrix(x, y.astype(np.float32)), 6,
+                ray_params=RayParams(num_actors=2))
+    contribs = _sum_check(bst, x)
+    assert contribs.shape == (240, 3, 6)
+
+
+def test_contribs_single_feature_tree():
+    """A dataset only feature 0 can explain: all non-bias attribution must
+    land on feature 0, and bias must equal base margin + root expectations."""
+    rng = np.random.RandomState(2)
+    x = np.zeros((200, 3), np.float32)
+    x[:, 0] = rng.randn(200)
+    y = (x[:, 0] > 0).astype(np.float32)
+    bst = train({"objective": "binary:logistic", "max_depth": 2},
+                RayDMatrix(x, y), 3, ray_params=RayParams(num_actors=2))
+    contribs = _sum_check(bst, x)
+    np.testing.assert_allclose(contribs[:, 1], 0.0, atol=1e-6)
+    np.testing.assert_allclose(contribs[:, 2], 0.0, atol=1e-6)
+    assert np.abs(contribs[:, 0]).max() > 0.1
+    # bias is constant across rows
+    assert np.allclose(contribs[:, -1], contribs[0, -1])
+
+
+def test_contribs_hand_computed_stump():
+    """Depth-1 regression stump: contribution = leaf - root expectation."""
+    x = np.array([[0.0], [0.0], [10.0], [10.0]], np.float32)
+    y = np.array([0.0, 0.0, 1.0, 1.0], np.float32)
+    bst = train({"objective": "reg:squarederror", "max_depth": 1,
+                 "eta": 1.0, "lambda": 0.0, "base_score": 0.5},
+                RayDMatrix(x, y), 1, ray_params=RayParams(num_actors=2))
+    contribs = bst.predict(x, pred_contribs=True, approx_contribs=True)
+    # root expectation is the mean residual = 0; leaves are -0.5 / +0.5
+    np.testing.assert_allclose(contribs[:, -1], 0.5, atol=1e-5)  # bias=base
+    np.testing.assert_allclose(contribs[:, 0], [-0.5, -0.5, 0.5, 0.5], atol=1e-5)
+
+
+def test_contribs_with_random_forest_averaging():
+    rng = np.random.RandomState(3)
+    x = rng.randn(200, 4).astype(np.float32)
+    y = (x[:, 1] > 0).astype(np.float32)
+    bst = train({"objective": "binary:logistic", "max_depth": 3,
+                 "num_parallel_tree": 3, "subsample": 0.8},
+                RayDMatrix(x, y), 4, ray_params=RayParams(num_actors=2))
+    _sum_check(bst, x)
+
+
+def test_contribs_with_dart_weights():
+    rng = np.random.RandomState(4)
+    x = rng.randn(200, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    bst = train({"objective": "binary:logistic", "booster": "dart",
+                 "rate_drop": 0.2, "one_drop": 1, "max_depth": 3},
+                RayDMatrix(x, y), 8, ray_params=RayParams(num_actors=2))
+    _sum_check(bst, x)
+
+
+def test_contribs_save_load_roundtrip(tmp_path):
+    rng = np.random.RandomState(5)
+    x = rng.randn(100, 3).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    bst = train({"objective": "binary:logistic", "max_depth": 3},
+                RayDMatrix(x, y), 5, ray_params=RayParams(num_actors=2))
+    p = str(tmp_path / "m.json")
+    bst.save_model(p)
+    from xgboost_ray_tpu.models.booster import Booster
+
+    loaded = Booster.load_model(p)
+    np.testing.assert_allclose(
+        loaded.predict(x, pred_contribs=True, approx_contribs=True),
+        bst.predict(x, pred_contribs=True, approx_contribs=True), atol=1e-6,
+    )
+
+
+def test_exact_shap_request_warns():
+    """pred_contribs without approx_contribs=True (the xgboost exact-SHAP
+    contract) must warn that values are the Saabas approximation."""
+    rng = np.random.RandomState(7)
+    x = rng.randn(50, 3).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    bst = train({"objective": "binary:logistic"}, RayDMatrix(x, y), 2,
+                ray_params=RayParams(num_actors=2))
+    with pytest.warns(UserWarning, match="Saabas"):
+        bst.predict(x, pred_contribs=True)
+
+
+def test_pred_interactions_still_raises():
+    rng = np.random.RandomState(6)
+    x = rng.randn(50, 3).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    bst = train({"objective": "binary:logistic"}, RayDMatrix(x, y), 2,
+                ray_params=RayParams(num_actors=2))
+    with pytest.raises(NotImplementedError, match="pred_interactions"):
+        bst.predict(x, pred_interactions=True)

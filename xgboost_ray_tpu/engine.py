@@ -41,6 +41,7 @@ from xgboost_ray_tpu.ops.grow import (
     Tree,
     build_tree,
     predict_tree_binned,
+    sample_feature_mask,
 )
 from xgboost_ray_tpu.ops.metrics import (
     compute_metric,
@@ -105,6 +106,7 @@ class TpuEngine:
         init_booster: Optional[RayXGBoostBooster] = None,
         feature_names: Optional[List[str]] = None,
         total_rounds: Optional[int] = None,
+        feature_weights: Optional[Any] = None,
     ):
         self.params = params
         self.feature_names = feature_names
@@ -175,6 +177,23 @@ class TpuEngine:
             )
         self.n_rows = x.shape[0]
         self.n_features = x.shape[1]
+
+        # feature_weights bias the colsample_* draws (Gumbel-top-k weighted
+        # sampling without replacement; xgboost set_info(feature_weights=...))
+        self._log_fw = None
+        if feature_weights is not None:
+            fw = np.asarray(feature_weights, np.float32).ravel()
+            if fw.shape[0] != self.n_features:
+                raise ValueError(
+                    f"feature_weights has {fw.shape[0]} entries but the data "
+                    f"has {self.n_features} features."
+                )
+            if (fw < 0).any():
+                raise ValueError("feature_weights must be non-negative.")
+            if fw.sum() <= 0:
+                raise ValueError("feature_weights must not be all zero.")
+            with np.errstate(divide="ignore"):
+                self._log_fw = jnp.asarray(np.log(fw))
         self.label_np = label if label is not None else lo
         self.weight_np = weight
         self.lower_np, self.upper_np = lo, hi
@@ -218,7 +237,11 @@ class TpuEngine:
             self.bounds_dev = None
 
         # ---- distributed sketch + binning (device, psum-merged) ----------
-        self.bins, self.cuts = self._sketch_and_bin(x_dev, self.valid)
+        # Weight-aware: xgboost's quantile sketch weighs samples (hessian/user
+        # weight), so cut points concentrate where the weighted mass is.
+        # weight_dev is all-ones when the user passed no weights, which makes
+        # the weighted sketch bit-identical to the unweighted one.
+        self.bins, self.cuts = self._sketch_and_bin(x_dev, self.valid, self.weight_dev)
 
         # ---- ranking group structure (per device block) ------------------
         self.group_rows = self._build_sharded_groups(qid) if self.is_ranking else None
@@ -260,6 +283,11 @@ class TpuEngine:
         del x_dev  # raw features no longer needed on device
 
         self.trees: List[Tree] = []  # host-side forest, one [K*T, heap] entry per round
+        # incremental stacked-forest cache (amortized O(1) copies per tree;
+        # re-stacking the whole forest per checkpoint interval was O(T^2))
+        self._stack_entries = 0  # how many of (_init_trees + trees) are stacked
+        self._stack_rows = 0  # filled tree rows in the buffers
+        self._stack_buf: Optional[Tree] = None
         self._step_fn = None
         self._step_fn_custom = None
         self._scan_fn = None
@@ -271,14 +299,14 @@ class TpuEngine:
         )
 
     # ------------------------------------------------------------------
-    def _sketch_and_bin(self, x_dev, valid):
+    def _sketch_and_bin(self, x_dev, valid, weight_dev):
         max_bin = self.params.max_bin
 
-        def fn(x, v):
+        def fn(x, v, w):
             mn, mx = binning.feature_min_max(x, v)
             mn = jax.lax.pmin(mn, "actors")
             mx = jax.lax.pmax(mx, "actors")
-            hist = binning.sketch_histogram(x, v, mn, mx)
+            hist = binning.sketch_histogram(x, v, mn, mx, weight=w)
             hist = jax.lax.psum(hist, "actors")
             cuts = binning.cuts_from_sketch(mn, mx, hist, max_bin)
             bins = binning.bin_matrix(x, cuts, max_bin)
@@ -287,10 +315,10 @@ class TpuEngine:
         mapped = shard_map(
             fn,
             mesh=self.mesh,
-            in_specs=(P("actors"), P("actors")),
+            in_specs=(P("actors"), P("actors"), P("actors")),
             out_specs=(P("actors"), P()),
         )
-        bins, cuts = jax.jit(mapped)(x_dev, valid)
+        bins, cuts = jax.jit(mapped)(x_dev, valid, weight_dev)
         return bins, cuts
 
     def _bin_with_cuts(self, x_dev):
@@ -442,12 +470,9 @@ class TpuEngine:
                     fmask = None
                     if params.colsample_bytree < 1.0:
                         fkey = jax.random.fold_in(key, SALT_BYTREE)
-                        fmask = (
-                            jax.random.uniform(fkey, (bins.shape[1],))
-                            < params.colsample_bytree
-                        )
-                        fmask = fmask | (
-                            jnp.arange(bins.shape[1]) == jnp.argmax(fmask)
+                        fmask = sample_feature_mask(
+                            fkey, bins.shape[1], params.colsample_bytree,
+                            self._log_fw,
                         )
                     need_level_rng = (
                         params.colsample_bylevel < 1.0
@@ -463,6 +488,7 @@ class TpuEngine:
                         colsample_bylevel=params.colsample_bylevel,
                         colsample_bynode=params.colsample_bynode,
                         allreduce=psum,
+                        feature_log_weights=self._log_fw,
                     )
                     trees.append(tree)
                     new_margins = new_margins.at[:, k].add(row_value / t_par)
@@ -773,8 +799,35 @@ class TpuEngine:
             return np.asarray(self.margins)[: self.n_rows]
         return np.asarray(es.margins)[: es.n_rows]
 
+    def _stacked_forest(self) -> Tree:
+        """Stacked [T, heap] forest with incremental appends: only rounds added
+        since the last call are copied into capacity-doubling buffers, so T/k
+        checkpoints over T rounds cost O(T) total tree copies, not O(T^2)."""
+        all_trees = self._init_trees + self.trees
+        if not all_trees:
+            raise ValueError("empty forest")
+        if self._stack_entries == len(all_trees):
+            return Tree(*[f[: self._stack_rows] for f in self._stack_buf])
+        add = stack_trees(all_trees[self._stack_entries :])
+        rows = add.feature.shape[0]
+        need = self._stack_rows + rows
+        if self._stack_buf is None or need > self._stack_buf.feature.shape[0]:
+            cap = max(need, 2 * (self._stack_buf.feature.shape[0] if self._stack_buf is not None else 0))
+            grown = []
+            for i, f in enumerate(add):
+                buf = np.empty((cap,) + f.shape[1:], f.dtype)
+                if self._stack_rows:
+                    buf[: self._stack_rows] = self._stack_buf[i][: self._stack_rows]
+                grown.append(buf)
+            self._stack_buf = Tree(*grown)
+        for i, f in enumerate(add):
+            self._stack_buf[i][self._stack_rows : need] = f
+        self._stack_rows = need
+        self._stack_entries = len(all_trees)
+        return Tree(*[f[: self._stack_rows] for f in self._stack_buf])
+
     def get_booster(self) -> RayXGBoostBooster:
-        forest = stack_trees(self._init_trees + self.trees)
+        forest = self._stacked_forest()
         tree_weights = None
         if self.dart:
             tree_weights = self.dart_weights[: self.dart_t].copy()
@@ -807,31 +860,19 @@ class TpuEngine:
         def empty(dtype, fill):
             return np.full((t_cap, heap), fill, dtype)
 
-        feature = empty(np.int32, -1)
-        split_bin = empty(np.int32, 0)
-        threshold = empty(np.float32, 0.0)
-        default_left = empty(bool, False)
-        is_leaf = empty(bool, False)
-        value = empty(np.float32, 0.0)
-        gain = empty(np.float32, 0.0)
-        is_leaf[:, 0] = True  # empty slots predict 0 from a root leaf
+        fills = {"feature": (np.int32, -1), "split_bin": (np.int32, 0),
+                 "threshold": (np.float32, 0.0), "default_left": (bool, False),
+                 "is_leaf": (bool, False), "value": (np.float32, 0.0),
+                 "gain": (np.float32, 0.0), "cover": (np.float32, 0.0),
+                 "base_weight": (np.float32, 0.0)}
+        bufs = {name: empty(dtype, fill) for name, (dtype, fill) in fills.items()}
+        bufs["is_leaf"][:, 0] = True  # empty slots predict 0 from a root leaf
         if n_init:
             init = self._init_trees[0]
-            feature[:n_init] = init.feature
-            split_bin[:n_init] = init.split_bin
-            threshold[:n_init] = init.threshold
-            default_left[:n_init] = init.default_left
-            is_leaf[:n_init] = init.is_leaf
-            value[:n_init] = init.value
-            gain[:n_init] = init.gain
+            for name in Tree._fields:
+                bufs[name][:n_init] = getattr(init, name)
         self.dart_forest_dev = Tree(
-            feature=jnp.asarray(feature),
-            split_bin=jnp.asarray(split_bin),
-            threshold=jnp.asarray(threshold),
-            default_left=jnp.asarray(default_left),
-            is_leaf=jnp.asarray(is_leaf),
-            value=jnp.asarray(value),
-            gain=jnp.asarray(gain),
+            **{name: jnp.asarray(bufs[name]) for name in Tree._fields}
         )
         self.dart_weights = np.zeros(t_cap, np.float32)
         if n_init:

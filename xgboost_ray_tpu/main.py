@@ -483,6 +483,20 @@ def _train(
     # 4) build the mesh engine over the alive actors' shards
     alive = [a for a in state.actors if a is not None]
     parsed = parse_params(params)
+    # RayDeviceQuantileDMatrix(max_bin=...) governs the binning of its data
+    # (reference matrix.py:977-1033 honors it); an explicit conflicting
+    # params['max_bin'] wins, with a warning.
+    dm_max_bin = getattr(dtrain, "max_bin", None)
+    if dm_max_bin:
+        if "max_bin" in (params or {}) and int(params["max_bin"]) != int(dm_max_bin):
+            logger.warning(
+                "params['max_bin']=%s overrides %s(max_bin=%s).",
+                params["max_bin"], type(dtrain).__name__, dm_max_bin,
+            )
+        else:
+            if not 1 < int(dm_max_bin) <= 1024:
+                raise ValueError("max_bin must be in (1, 1024]")
+            parsed.max_bin = int(dm_max_bin)
     train_shards = [a.get_shard(dtrain) for a in alive]
     evals_in = []
     for deval, name in evals:
@@ -499,6 +513,7 @@ def _train(
         init_booster=init_booster,
         feature_names=dtrain.resolved_feature_names,
         total_rounds=boost_rounds_left,
+        feature_weights=dtrain.feature_weights,
     )
     total_n = sum(a.local_n(dtrain) for a in alive)
     state.additional_results["total_n"] = total_n

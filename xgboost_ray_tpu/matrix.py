@@ -405,6 +405,16 @@ class RayDMatrix:
         if num_actors is not None and not lazy:
             self.load_data(num_actors)
 
+    @property
+    def feature_weights(self) -> Optional[np.ndarray]:
+        """Per-feature sampling weights (length n_features), resolved to a
+        float32 array; biases the engine's colsample_* draws (reference
+        surface: xgboost_ray/matrix.py:283-358 -> DMatrix feature_weights)."""
+        fw = getattr(self.loader, "feature_weights", None)
+        if fw is None:
+            return None
+        return np.asarray(fw, dtype=np.float32).ravel()
+
     @staticmethod
     def _can_load_distributed(data: Data) -> bool:
         if isinstance(data, str):

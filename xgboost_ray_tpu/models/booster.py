@@ -72,6 +72,9 @@ class RayXGBoostBooster:
         self.best_iteration: Optional[int] = None
         self.best_score: Optional[float] = None
         self._attributes: Dict[str, str] = {}
+        # False only for models loaded from pre-stats serializations, whose
+        # cover/base_weight were zero-filled (contributions would be garbage)
+        self._has_node_stats: bool = True
 
     # -- introspection -----------------------------------------------------
 
@@ -140,6 +143,7 @@ class RayXGBoostBooster:
             self.feature_types,
             tree_weights=None if self.tree_weights is None else self.tree_weights[sl],
         )
+        out._has_node_stats = self._has_node_stats
         return out
 
     def base_score_margin_np(self) -> float:
@@ -182,6 +186,45 @@ class RayXGBoostBooster:
             out[lo:hi] = np.asarray(margin)
         return out
 
+    def predict_contribs_np(
+        self, x: np.ndarray, ntree_limit: int = 0,
+        base_margin: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """Per-feature contributions [N, F+1] (binary/regression) or
+        [N, K, F+1] (multiclass), bias last; rows sum to the margin."""
+        if not self._has_node_stats:
+            raise ValueError(
+                "This model was saved by a version without per-node statistics "
+                "(cover/base_weight); prediction contributions would be "
+                "all-zero. Re-train or re-save the model with this version."
+            )
+        n = x.shape[0]
+        k = self.num_outputs
+        m0 = self.base_score_margin_np()
+        forest_dev = Tree(*[jnp.asarray(f) for f in self.forest])
+        out = np.empty((n, k, self.num_features + 1), np.float32)
+        for lo in range(0, n, _PREDICT_CHUNK):
+            hi = min(lo + _PREDICT_CHUNK, n)
+            out[lo:hi] = np.asarray(
+                predict_ops.predict_contribs(
+                    forest_dev,
+                    jnp.asarray(x[lo:hi]),
+                    max_depth=self.max_depth,
+                    num_outputs=k,
+                    num_parallel_tree=self.params.num_parallel_tree,
+                    ntree_limit=int(ntree_limit),
+                    tree_weights=(
+                        None
+                        if self.tree_weights is None
+                        else jnp.asarray(self.tree_weights)
+                    ),
+                )
+            )
+        out[:, :, -1] += m0
+        if base_margin is not None:
+            out[:, :, -1] += np.asarray(base_margin, np.float32).reshape(n, -1)
+        return out[:, 0, :] if k == 1 else out
+
     def predict(
         self,
         data,
@@ -193,14 +236,32 @@ class RayXGBoostBooster:
         iteration_range: Optional[Tuple[int, int]] = None,
         validate_features: bool = True,
         base_margin: Optional[np.ndarray] = None,
+        approx_contribs: bool = False,
         **_ignored,
     ) -> np.ndarray:
-        if pred_contribs or pred_interactions:
+        if pred_contribs and not approx_contribs:
+            import warnings
+
+            warnings.warn(
+                "pred_contribs uses the Saabas path-attribution approximation "
+                "(xgboost's approx_contribs=True semantics); exact tree-SHAP "
+                "is not implemented. Pass approx_contribs=True to silence.",
+                UserWarning,
+                stacklevel=2,
+            )
+        if pred_interactions:
             raise NotImplementedError(
-                "pred_contribs/pred_interactions (SHAP values) are not "
+                "pred_interactions (SHAP interaction values) are not "
                 "implemented by the tpu_hist predictor yet."
             )
         x = self._coerce_features(data)
+        if pred_contribs:
+            booster = self
+            if iteration_range is not None and iteration_range != (0, 0):
+                booster = self.slice_rounds(iteration_range[0], iteration_range[1])
+            return booster.predict_contribs_np(
+                x, ntree_limit=ntree_limit, base_margin=base_margin
+            )
         if pred_leaf:
             forest_dev = Tree(*[jnp.asarray(f) for f in self.forest])
             return np.asarray(
@@ -224,19 +285,13 @@ class RayXGBoostBooster:
         buf = io.BytesIO()
         np.savez_compressed(
             buf,
-            feature=self.forest.feature,
-            split_bin=self.forest.split_bin,
-            threshold=self.forest.threshold,
-            default_left=self.forest.default_left,
-            is_leaf=self.forest.is_leaf,
-            value=self.forest.value,
-            gain=self.forest.gain,
             cuts=self.cuts,
             tree_weights=(
                 self.tree_weights
                 if self.tree_weights is not None
                 else np.zeros((0,), np.float32)
             ),
+            **{name: getattr(self.forest, name) for name in Tree._fields},
         )
         import dataclasses as dc
 
@@ -257,16 +312,15 @@ class RayXGBoostBooster:
     def _from_dict(cls, d: Dict[str, Any]) -> "RayXGBoostBooster":
         raw = base64.b64decode(d["arrays_npz_b64"])
         with np.load(io.BytesIO(raw)) as z:
+            # stats fields default to zeros for models saved before they
+            # existed; such models cannot produce contributions (see
+            # _has_node_stats guard) but predict/resume normally
+            has_stats = "base_weight" in z
             forest = Tree(
-                feature=z["feature"],
-                split_bin=z["split_bin"],
-                threshold=z["threshold"],
-                default_left=z["default_left"],
-                is_leaf=z["is_leaf"],
-                value=z["value"],
-                gain=(
-                    z["gain"] if "gain" in z else np.zeros_like(z["value"])
-                ),
+                **{
+                    name: (z[name] if name in z else np.zeros_like(z["value"]))
+                    for name in Tree._fields
+                }
             )
             cuts = z["cuts"]
             tw = z["tree_weights"] if "tree_weights" in z else np.zeros((0,), np.float32)
@@ -283,6 +337,7 @@ class RayXGBoostBooster:
         out.best_iteration = d.get("best_iteration")
         out.best_score = d.get("best_score")
         out._attributes = dict(d.get("attributes") or {})
+        out._has_node_stats = has_stats
         return out
 
     def save_model(self, fname: str) -> None:
@@ -314,15 +369,27 @@ class RayXGBoostBooster:
                     return
                 indent = "\t" * depth
                 if self.forest.is_leaf[t, idx]:
-                    lines.append(f"{indent}{idx}:leaf={self.forest.value[t, idx]:.6g}")
+                    stats = (
+                        f",cover={self.forest.cover[t, idx]:.6g}" if with_stats else ""
+                    )
+                    lines.append(
+                        f"{indent}{idx}:leaf={self.forest.value[t, idx]:.6g}{stats}"
+                    )
                     return
                 f = self.forest.feature[t, idx]
                 if f < 0:
                     return  # unused slot
                 thr = self.forest.threshold[t, idx]
                 miss = 2 * idx + 1 if self.forest.default_left[t, idx] else 2 * idx + 2
+                stats = (
+                    f",gain={self.forest.gain[t, idx]:.6g}"
+                    f",cover={self.forest.cover[t, idx]:.6g}"
+                    if with_stats
+                    else ""
+                )
                 lines.append(
-                    f"{indent}{idx}:[f{f}<{thr:.6g}] yes={2*idx+1},no={2*idx+2},missing={miss}"
+                    f"{indent}{idx}:[f{f}<{thr:.6g}] "
+                    f"yes={2*idx+1},no={2*idx+2},missing={miss}{stats}"
                 )
                 rec(2 * idx + 1, depth + 1)
                 rec(2 * idx + 2, depth + 1)
@@ -392,6 +459,10 @@ class RayXGBoostBooster:
                 f"(weight, gain, total_gain)"
             )
         return {names[i]: float(v) for i, v in enumerate(vals) if v > 0}
+
+    def get_fscore(self) -> Dict[str, float]:
+        """xgboost ``Booster.get_fscore`` alias: split counts per feature."""
+        return self.get_score(importance_type="weight")
 
     def __getstate__(self):
         return self._to_dict()

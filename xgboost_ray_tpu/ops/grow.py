@@ -41,6 +41,37 @@ SALT_BYLEVEL = 0x51D3
 SALT_BYNODE = 0x51D4
 
 
+def sample_feature_mask(
+    key: jnp.ndarray,
+    n_features: int,
+    rate: float,
+    log_fw: Optional[jnp.ndarray] = None,
+    batch: Optional[int] = None,
+) -> jnp.ndarray:
+    """Draw a boolean feature-sampling mask ([F], or [batch, F]).
+
+    Without feature weights: independent Bernoulli(rate) per feature (with a
+    never-empty guard) — the historical behavior. With ``log_fw`` (log of the
+    user's per-feature weights, -inf for weight 0): weighted sampling WITHOUT
+    replacement of k = max(1, round(rate * F)) features via Gumbel-top-k, the
+    semantics of xgboost's ``feature_weights`` (zero-weight features are never
+    drawn; reference surface: xgboost_ray/matrix.py:283-358 + its
+    tests/test_end_to_end.py:429-468 demo).
+    """
+    shape = (n_features,) if batch is None else (batch, n_features)
+    if log_fw is None:
+        mask = jax.random.uniform(key, shape) < rate
+        # never mask out every feature (of a node)
+        guard = jnp.arange(n_features) == jnp.argmax(mask, axis=-1, keepdims=batch is not None)
+        return mask | guard
+    k = max(1, int(round(rate * n_features)))
+    scores = log_fw + jax.random.gumbel(key, shape)
+    kth = jax.lax.top_k(scores, k)[0][..., -1:]
+    mask = (scores >= kth) & jnp.isfinite(log_fw)
+    guard = jnp.arange(n_features) == jnp.argmax(scores, axis=-1, keepdims=batch is not None)
+    return mask | guard
+
+
 @dataclasses.dataclass(frozen=True)
 class GrowConfig:
     max_depth: int = 6
@@ -69,6 +100,10 @@ class Tree(NamedTuple):
     is_leaf: jnp.ndarray  # bool
     value: jnp.ndarray  # float32 leaf value (already scaled by learning_rate)
     gain: jnp.ndarray  # float32 split gain at internal nodes (importances)
+    cover: jnp.ndarray  # float32 hessian sum reaching each node (xgb 'cover')
+    base_weight: jnp.ndarray  # float32 lr-scaled leaf_weight of EVERY node
+    #   (internal nodes included) — the E[f(x)|node] estimate Saabas/SHAP
+    #   path attribution needs; equals `value` at real leaves
 
 
 def empty_tree(heap_size: int) -> Tree:
@@ -80,6 +115,8 @@ def empty_tree(heap_size: int) -> Tree:
         is_leaf=jnp.zeros((heap_size,), bool),
         value=jnp.zeros((heap_size,), jnp.float32),
         gain=jnp.zeros((heap_size,), jnp.float32),
+        cover=jnp.zeros((heap_size,), jnp.float32),
+        base_weight=jnp.zeros((heap_size,), jnp.float32),
     )
 
 
@@ -93,6 +130,7 @@ def build_tree(
     colsample_bylevel: float = 1.0,
     colsample_bynode: float = 1.0,
     allreduce: Callable[[jnp.ndarray], jnp.ndarray] = lambda x: x,
+    feature_log_weights: Optional[jnp.ndarray] = None,  # [F] log(fw), -inf at 0
 ):
     """Grow one tree. Returns (Tree, row_value[N]) — row_value is the leaf
     value each row receives (learning-rate scaled), used to update margins
@@ -170,19 +208,15 @@ def build_tree(
         fmask = feature_mask
         if colsample_bylevel < 1.0 and level_rng is not None:
             k = jax.random.fold_in(jax.random.fold_in(level_rng, SALT_BYLEVEL), d)
-            lmask = jax.random.uniform(k, (num_features,)) < colsample_bylevel
-            # never mask out every feature
-            lmask = lmask | (jnp.arange(num_features) == jnp.argmax(lmask))
+            lmask = sample_feature_mask(
+                k, num_features, colsample_bylevel, feature_log_weights
+            )
             fmask = lmask if fmask is None else (fmask & lmask)
         if colsample_bynode < 1.0 and level_rng is not None:
             k = jax.random.fold_in(jax.random.fold_in(level_rng, SALT_BYNODE), d)
-            nmask = (
-                jax.random.uniform(k, (n_nodes, num_features)) < colsample_bynode
-            )
-            # never mask out every feature of a node
-            nmask = nmask | (
-                jnp.arange(num_features)[None, :]
-                == jnp.argmax(nmask, axis=1)[:, None]
+            nmask = sample_feature_mask(
+                k, num_features, colsample_bynode, feature_log_weights,
+                batch=n_nodes,
             )
             fmask = nmask if fmask is None else (nmask & fmask[None, :])
 
@@ -202,6 +236,10 @@ def build_tree(
             is_leaf=tree.is_leaf.at[sl].set(is_new_leaf),
             value=tree.value.at[sl].set(jnp.where(is_new_leaf, node_value, 0.0)),
             gain=tree.gain.at[sl].set(jnp.where(valid_split, sp.gain, 0.0)),
+            cover=tree.cover.at[sl].set(jnp.where(active, node_gh[:, 1], 0.0)),
+            base_weight=tree.base_weight.at[sl].set(
+                jnp.where(active, node_value, 0.0)
+            ),
         )
 
         newly_leafed = is_new_leaf[pos] & ~done
@@ -228,6 +266,10 @@ def build_tree(
     tree = tree._replace(
         is_leaf=tree.is_leaf.at[sl].set(active),
         value=tree.value.at[sl].set(jnp.where(active, node_value, 0.0)),
+        cover=tree.cover.at[sl].set(jnp.where(active, node_gh[:, 1], 0.0)),
+        base_weight=tree.base_weight.at[sl].set(
+            jnp.where(active, node_value, 0.0)
+        ),
     )
     row_value = jnp.where(done, row_value, node_value[pos])
     return tree, row_value

@@ -62,6 +62,74 @@ def predict_margin(
     return base_margin + (leaf.T @ onehot) / num_parallel_tree
 
 
+@functools.partial(jax.jit, static_argnames=("max_depth", "num_outputs", "num_parallel_tree", "ntree_limit"))
+def predict_contribs(
+    forest: Tree,  # stacked trees: each field [T, heap]
+    x: jnp.ndarray,  # [N, F] float32 raw features
+    max_depth: int,
+    num_outputs: int,
+    num_parallel_tree: int = 1,
+    ntree_limit: int = 0,
+    tree_weights: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Per-feature prediction contributions (xgboost ``pred_contribs`` with
+    ``approx_contribs=True`` — Saabas path attribution; reference surface:
+    ``xgb.Booster.predict`` passed through at ``xgboost_ray/main.py:795-810``).
+
+    Walking x's path, each split's expected-value change
+    ``base_weight[child] - base_weight[node]`` is credited to the split
+    feature; the bias column gets ``base_weight[root]``. The credits telescope,
+    so each row of the result sums exactly to that row's margin (minus the
+    base-score offset, which the caller adds to the bias column).
+
+    Returns [T-summed] contributions ``[N, K, F+1]`` (bias last).
+
+    Trees are accumulated with ``lax.scan`` (not vmap) so peak memory is the
+    O(N*K*F) accumulator, never a [T, N, F] intermediate.
+    """
+    n, num_features = x.shape
+    t = forest.feature.shape[0]
+
+    scale = jnp.ones((t,), jnp.float32)
+    if tree_weights is not None:
+        scale = scale * tree_weights
+    if ntree_limit:
+        scale = jnp.where(jnp.arange(t) < ntree_limit, scale, 0.0)
+    scale = scale / num_parallel_tree
+    cls = (jnp.arange(t) // num_parallel_tree) % num_outputs
+    onehot = jax.nn.one_hot(cls, num_outputs, dtype=jnp.float32) * scale[:, None]  # [T, K]
+
+    def tree_step(acc, args):
+        tree, oh = args  # Tree of [heap] fields, [K]
+        feat_acc, bias_acc = acc
+        idx = jnp.zeros((n,), jnp.int32)
+        contrib = jnp.zeros((n, num_features), jnp.float32)
+        for _ in range(max_depth):
+            stepped = ~tree.is_leaf[idx] & (tree.feature[idx] >= 0)
+            f = jnp.clip(tree.feature[idx], 0, num_features - 1)
+            xv = jnp.take_along_axis(x, f[:, None], axis=1)[:, 0]
+            go_right = jnp.where(
+                jnp.isnan(xv), ~tree.default_left[idx], xv >= tree.threshold[idx]
+            )
+            nxt = jnp.where(stepped, 2 * idx + 1 + go_right.astype(jnp.int32), idx)
+            delta = jnp.where(
+                stepped, tree.base_weight[nxt] - tree.base_weight[idx], 0.0
+            )
+            contrib = contrib.at[jnp.arange(n), f].add(delta)
+            idx = nxt
+        feat_acc = feat_acc + jnp.einsum("nf,k->nkf", contrib, oh)
+        bias_acc = bias_acc + tree.base_weight[0] * oh
+        return (feat_acc, bias_acc), None
+
+    acc0 = (
+        jnp.zeros((n, num_outputs, num_features), jnp.float32),
+        jnp.zeros((num_outputs,), jnp.float32),
+    )
+    (feat_part, bias_part), _ = jax.lax.scan(tree_step, acc0, (forest, onehot))
+    bias = jnp.broadcast_to(bias_part[None, :, None], (n, num_outputs, 1))
+    return jnp.concatenate([feat_part, bias], axis=2)
+
+
 def predict_leaf_index(
     forest: Tree, x: jnp.ndarray, max_depth: int
 ) -> jnp.ndarray:
