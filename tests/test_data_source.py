@@ -94,3 +94,93 @@ def test_fixed_sharding_assigns_partitions(tmp_path):
     s1 = dm.get_data(1, 2)
     assert s0["data"].shape[0] + s1["data"].shape[0] == 64
     assert s0["data"].shape[0] == 32  # 2 files each
+
+
+# --- the reference's even/uneven x colocated/redistribute scenario grid ------
+# (xgboost_ray/tests/test_data_source.py:38-166). Our greedy assigner's exact
+# round-robin order may differ; each scenario asserts the properties the
+# reference's expected maps encode: full coverage, the same per-actor share
+# distribution, and no assignment less local than the reference's.
+
+
+def _run_scenario(part_nodes, actor_nodes, expected_actor_parts):
+    host_to_parts = {}
+    for part, node in enumerate(part_nodes):
+        host_to_parts.setdefault(f"node{node}", []).append(part)
+    actors = {rank: f"node{node}" for rank, node in enumerate(actor_nodes)}
+    out = assign_partitions_to_actors(host_to_parts, actors)
+
+    # full, exactly-once coverage
+    assigned = sorted(p for parts in out.values() for p in parts)
+    assert assigned == list(range(len(part_nodes)))
+    # same share distribution as the reference's expected map
+    assert sorted(len(v) for v in out.values()) == sorted(
+        len(v) for v in expected_actor_parts.values()
+    )
+    # locality: at least as many co-located (part, actor) pairs as expected
+    def colocated(assignment):
+        return sum(
+            1
+            for rank, parts in assignment.items()
+            for p in parts
+            if part_nodes[p] == actor_nodes[rank]
+        )
+
+    assert colocated(out) >= colocated(expected_actor_parts)
+    return out
+
+
+def test_assign_even_trivial():
+    _run_scenario(
+        part_nodes=[0, 0, 1, 1, 2, 2, 3, 3],
+        actor_nodes=[0, 1, 2, 3],
+        expected_actor_parts={0: [0, 1], 1: [2, 3], 2: [4, 5], 3: [6, 7]},
+    )
+
+
+def test_assign_even_redistribute_one():
+    _run_scenario(
+        part_nodes=[0, 0, 0, 1, 1, 1, 2, 2],
+        actor_nodes=[0, 0, 1, 2],
+        expected_actor_parts={0: [0, 2], 1: [1, 5], 2: [3, 4], 3: [6, 7]},
+    )
+
+
+def test_assign_even_redistribute_most():
+    _run_scenario(
+        part_nodes=[0, 0, 0, 0, 0, 0, 0, 0],
+        actor_nodes=[0, 1, 2, 3],
+        expected_actor_parts={0: [0, 1], 1: [2, 5], 2: [3, 6], 3: [4, 7]},
+    )
+
+
+def test_assign_uneven_trivial():
+    _run_scenario(
+        part_nodes=[0, 0, 0, 1, 1, 2, 2, 2],
+        actor_nodes=[0, 1, 2],
+        expected_actor_parts={0: [0, 1, 2], 1: [3, 4], 2: [5, 6, 7]},
+    )
+
+
+def test_assign_uneven_redistribute():
+    _run_scenario(
+        part_nodes=[0, 0, 1, 1, 1, 1, 2, 3],
+        actor_nodes=[0, 1, 2],
+        expected_actor_parts={0: [0, 1, 5], 1: [2, 3, 4], 2: [6, 7]},
+    )
+
+
+def test_assign_uneven_redistribute_colocated():
+    _run_scenario(
+        part_nodes=[0, 0, 0, 0, 0, 0, 0],
+        actor_nodes=[0, 0, 1],
+        expected_actor_parts={0: [0, 2, 4], 1: [1, 3], 2: [5, 6]},
+    )
+
+
+def test_assign_uneven_redistribute_all():
+    _run_scenario(
+        part_nodes=[1, 1, 1, 1, 0, 0, 0],
+        actor_nodes=[1, 1, 2],
+        expected_actor_parts={0: [0, 2, 4], 1: [1, 3], 2: [5, 6]},
+    )

@@ -303,3 +303,64 @@ def test_uid_identity(xy):
     b = RayDMatrix(x, y)
     assert a != b and hash(a) != hash(b)
     assert a == a
+
+
+def test_detect_distributed(tmp_path, xy):
+    # reference testDetectDistributed (test_matrix.py:364-391)
+    x, y = xy
+    df = pd.DataFrame(np.asarray(x), columns=["a", "b", "c", "d"])
+    df["label"] = np.asarray(y)
+    parquet_file = str(tmp_path / "file.parquet")
+    csv_file = str(tmp_path / "file.csv")
+    df.to_parquet(parquet_file)
+    df.to_csv(csv_file, index=False)
+
+    assert RayDMatrix(parquet_file, lazy=True).distributed
+    # a single CSV file cannot be row-split: central loading
+    assert not RayDMatrix(csv_file, lazy=True).distributed
+    assert RayDMatrix([parquet_file] * 3, lazy=True).distributed
+    assert RayDMatrix([csv_file] * 3, lazy=True).distributed
+
+
+def test_distributed_true_with_single_csv_rejected(tmp_path, xy):
+    x, y = xy
+    df = pd.DataFrame(np.asarray(x), columns=["a", "b", "c", "d"])
+    csv_file = str(tmp_path / "file.csv")
+    df.to_csv(csv_file, index=False)
+    with pytest.raises(ValueError, match="[Dd]istributed"):
+        RayDMatrix(csv_file, distributed=True, lazy=True)
+
+
+def test_distributed_true_with_ndarray_rejected(xy):
+    x, y = xy
+    with pytest.raises(ValueError, match="[Dd]istributed"):
+        RayDMatrix(np.asarray(x), np.asarray(y), distributed=True, lazy=True)
+
+
+def test_assert_enough_shards_for_actors(tmp_path, xy):
+    # reference testTooManyActorsDistributed (test_matrix.py:393-398)
+    x, y = xy
+    df = pd.DataFrame(np.asarray(x), columns=["a", "b", "c", "d"])
+    df["label"] = np.asarray(y)
+    files = []
+    for i in range(2):
+        p = str(tmp_path / f"p{i}.parquet")
+        df.to_parquet(p)
+        files.append(p)
+    dm = RayDMatrix(files, label="label", lazy=True)
+    dm.assert_enough_shards_for_actors(2)  # fine
+    with pytest.raises(RuntimeError, match="fewer actors"):
+        dm.assert_enough_shards_for_actors(4)
+
+
+def test_distributed_array_label_requires_column_name(tmp_path, xy):
+    # reference matrix.py:533-538 semantics
+    x, y = xy
+    df = pd.DataFrame(np.asarray(x), columns=["a", "b", "c", "d"])
+    files = []
+    for i in range(2):
+        p = str(tmp_path / f"q{i}.parquet")
+        df.to_parquet(p)
+        files.append(p)
+    with pytest.raises(ValueError, match="column names"):
+        RayDMatrix(files, label=np.asarray(y), lazy=True)

@@ -1,0 +1,94 @@
+"""Multi-process rehearsal of the multi-host path (VERDICT #6).
+
+The reference's most battle-tested layer is its tracker + multi-node flow,
+which its tests simulate without a real cluster
+(``xgboost_ray/tests/conftest.py:36-71``). The analogous technique here:
+launch 2 real ``jax.distributed`` processes x 4 virtual CPU devices each and
+train over the resulting 8-device, 2-host mesh, checking bit-level agreement
+with a single-process run on the same global mesh shape.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _make_data(n=800, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 5).astype(np.float32)
+    y = (x[:, 0] + 0.4 * x[:, 1] + 0.3 * rng.randn(n) > 0).astype(np.float32)
+    return x, y
+
+
+def test_two_process_training_matches_single_process(tmp_path):
+    # single-process expectations on the same global data / 8-shard layout
+    from xgboost_ray_tpu.engine import TpuEngine
+    from xgboost_ray_tpu.matrix import RayShardingMode, _get_sharding_indices
+    from xgboost_ray_tpu.params import parse_params
+
+    x, y = _make_data()
+    n, num_actors, rounds = x.shape[0], 8, 4
+    shards = []
+    for rank in range(num_actors):
+        idx = _get_sharding_indices(RayShardingMode.INTERLEAVED, rank, num_actors, n)
+        shards.append({
+            "data": x[idx], "label": y[idx], "weight": None,
+            "base_margin": None, "label_lower_bound": None,
+            "label_upper_bound": None, "qid": None,
+        })
+    params = parse_params({"objective": "binary:logistic",
+                           "eval_metric": ["logloss", "auc"], "max_depth": 3})
+    eng = TpuEngine(shards, params, num_actors=num_actors,
+                    evals=[(shards, "train")])
+    results = [eng.step(i) for i in range(rounds)]
+    bst = eng.get_booster()
+    expected = str(tmp_path / "expected.npz")
+    np.savez(
+        expected, x=x, y=y, rounds=rounds,
+        logloss=[r["train"]["logloss"] for r in results],
+        auc=[r["train"]["auc"] for r in results],
+        margins=bst.predict(x, output_margin=True),
+    )
+
+    port = _free_port()
+    child = os.path.join(os.path.dirname(__file__), "_multihost_child.py")
+    env = dict(os.environ)
+    env.pop("PYTEST_CURRENT_TEST", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo_root] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, child, f"127.0.0.1:{port}", str(pid), expected],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"child {pid} failed:\n{out[-4000:]}"
+        assert f"CHILD{pid} OK" in out

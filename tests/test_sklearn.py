@@ -250,8 +250,13 @@ def test_trees_to_dataframe_and_pred_contribs(bc):
     assert (df[df["IsLeaf"]]["Feature"] == "Leaf").all()
     internal = df[~df["IsLeaf"]]
     assert (internal["Gain"] > 0).all()
-    with pytest.raises(NotImplementedError):
-        bst.predict(x_tr[:5], pred_contribs=True)
+    contribs = bst.predict(x_tr[:5], pred_contribs=True, approx_contribs=True)
+    assert contribs.shape == (5, x_tr.shape[1] + 1)
+    np.testing.assert_allclose(
+        contribs.sum(axis=1),
+        bst.predict(x_tr[:5], output_margin=True),
+        atol=1e-4,
+    )
 
 
 def test_apply_returns_leaf_indices(bc):

@@ -1,0 +1,230 @@
+"""sklearn-facade parity scenarios ported from the reference suite.
+
+The reference's ``tests/test_sklearn.py`` (1,307 lines) is itself a port of
+the upstream xgboost sklearn suite; these are the behaviors it locks down
+that our ``tests/test_sklearn.py`` did not yet: stacking, validation weights,
+pickling, parameter access, resume, base-margin boosting, estimator typing,
+random-state determinism, sklearn meta-estimator interop.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from sklearn.datasets import load_breast_cancer, load_iris, make_regression
+from sklearn.model_selection import GridSearchCV
+from sklearn.ensemble import StackingClassifier, StackingRegressor
+from sklearn.feature_selection import SelectFromModel
+from sklearn.linear_model import LogisticRegression, Ridge
+
+from xgboost_ray_tpu import RayParams
+from xgboost_ray_tpu.sklearn import (
+    RayXGBClassifier,
+    RayXGBRegressor,
+    RayXGBRFClassifier,
+)
+
+_RP = RayParams(num_actors=2)
+
+
+def _bc():
+    x, y = load_breast_cancer(return_X_y=True)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def test_stacking_regression():
+    # reference test_sklearn.py:210-229
+    x, y = make_regression(n_samples=300, n_features=8, random_state=0)
+    x = x.astype(np.float32)
+    y = y.astype(np.float32)
+    stack = StackingRegressor(
+        estimators=[("xgb", RayXGBRegressor(n_estimators=5, max_depth=3,
+                                            ray_params=_RP))],
+        final_estimator=Ridge(),
+        cv=2,
+    )
+    stack.fit(x, y)
+    assert stack.score(x, y) > 0.6
+
+
+def test_stacking_classification():
+    # reference test_sklearn.py:231-256
+    x, y = _bc()
+    stack = StackingClassifier(
+        estimators=[("xgb", RayXGBClassifier(n_estimators=5, max_depth=3,
+                                             ray_params=_RP))],
+        final_estimator=LogisticRegression(max_iter=200),
+        cv=2,
+    )
+    stack.fit(x, y)
+    assert stack.score(x, y) > 0.9
+
+
+def test_validation_weights_change_eval_metric():
+    # reference test_sklearn.py:634-806: eval-set weights must flow into the
+    # validation metric — weighting easy rows differently changes logloss
+    rng = np.random.RandomState(0)
+    x = rng.randn(400, 5).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    xv, yv = x[:100], y[:100]
+    results = {}
+    for tag, wv in (("flat", np.ones(100, np.float32)),
+                    ("skew", np.linspace(0.01, 10.0, 100).astype(np.float32))):
+        clf = RayXGBClassifier(n_estimators=5, max_depth=3, ray_params=_RP)
+        clf.fit(x, y, eval_set=[(xv, yv)], sample_weight_eval_set=[wv],
+                verbose=False)
+        results[tag] = clf.evals_result()["validation_0"]["logloss"]
+    assert results["flat"] != results["skew"]
+
+
+def test_sklearn_random_state_determinism():
+    # reference test_sklearn.py:518-533
+    x, y = _bc()
+    preds = []
+    for seed in (11, 11, 12):
+        clf = RayXGBClassifier(n_estimators=4, max_depth=3, subsample=0.6,
+                               colsample_bytree=0.6, random_state=seed,
+                               ray_params=_RP)
+        clf.fit(x, y)
+        preds.append(clf.predict_proba(x)[:, 1])
+    np.testing.assert_array_equal(preds[0], preds[1])
+    assert not np.array_equal(preds[0], preds[2])
+
+
+def test_parameters_access_and_set_params():
+    # reference test_sklearn.py:548-572
+    clf = RayXGBClassifier(n_estimators=3, max_depth=4, learning_rate=0.5)
+    params = clf.get_params()
+    assert params["max_depth"] == 4
+    assert params["learning_rate"] == 0.5
+    clf.set_params(max_depth=2)
+    assert clf.get_params()["max_depth"] == 2
+    xgb_params = clf.get_xgb_params()
+    assert "n_estimators" not in xgb_params
+    assert xgb_params["max_depth"] == 2
+
+
+def test_kwargs_grid_search():
+    # reference test_sklearn.py:582-601
+    x, y = load_iris(return_X_y=True)
+    x = x.astype(np.float32)
+    clf = RayXGBClassifier(n_estimators=2, max_depth=2, ray_params=_RP,
+                           num_class=3, objective="multi:softprob")
+    grid = GridSearchCV(clf, {"learning_rate": [0.1, 0.3]}, cv=2)
+    grid.fit(x, y.astype(np.float32))
+    assert set(grid.cv_results_["param_learning_rate"]) == {0.1, 0.3}
+
+
+def test_select_from_model_uses_importances():
+    # reference test_sklearn.py:262-275
+    rng = np.random.RandomState(1)
+    x = rng.randn(300, 6).astype(np.float32)
+    y = (x[:, 2] > 0).astype(np.float32)
+    clf = RayXGBClassifier(n_estimators=5, max_depth=3, ray_params=_RP)
+    clf.fit(x, y)
+    sel = SelectFromModel(clf, prefit=True, threshold="mean")
+    picked = sel.get_support()
+    assert picked[2]
+
+
+def test_num_parallel_tree_forest_size():
+    # reference test_sklearn.py:277-313
+    x, y = _bc()
+    clf = RayXGBRFClassifier(n_estimators=3, max_depth=3, ray_params=_RP)
+    clf.fit(x, y)
+    bst = clf.get_booster()
+    # RF variant: one boosting round of n_estimators parallel trees
+    assert bst.num_trees == 3
+    assert bst.num_boosted_rounds() == 1
+    assert len(bst.get_dump()) == 3
+
+
+def test_boost_from_prediction():
+    # reference test_sklearn.py:1196-1213: margins from model A fed as
+    # base_margin for model B must equal training A+B rounds jointly
+    x, y = _bc()
+    clf_full = RayXGBClassifier(n_estimators=8, max_depth=3, ray_params=_RP)
+    clf_full.fit(x, y)
+    full = clf_full.get_booster().predict(x, output_margin=True)
+
+    clf_a = RayXGBClassifier(n_estimators=4, max_depth=3, ray_params=_RP)
+    clf_a.fit(x, y)
+    margin_a = clf_a.get_booster().predict(x, output_margin=True)
+    clf_b = RayXGBClassifier(n_estimators=4, max_depth=3, ray_params=_RP)
+    clf_b.fit(x, y, base_margin=margin_a)
+    margin_b = clf_b.get_booster().predict(
+        x, output_margin=True, base_margin=margin_a
+    )
+    np.testing.assert_allclose(full, margin_b, atol=1e-3)
+
+
+def test_estimator_type_tags():
+    # reference test_sklearn.py:1216-1238 (modern sklearn uses the tag
+    # system instead of the removed _estimator_type attribute)
+    from sklearn.base import is_classifier, is_regressor
+
+    assert is_classifier(RayXGBClassifier())
+    assert not is_regressor(RayXGBClassifier())
+    assert is_regressor(RayXGBRegressor())
+    x, y = _bc()
+    clf = RayXGBClassifier(n_estimators=2, ray_params=_RP)
+    clf.fit(x, y)
+    assert list(clf.classes_) == [0, 1]
+    assert clf.n_classes_ == 2
+
+
+def test_pickle_estimator_and_booster():
+    # reference test_sklearn.py:808-847 save/load + pickle paths
+    x, y = _bc()
+    clf = RayXGBClassifier(n_estimators=4, max_depth=3, ray_params=_RP)
+    clf.fit(x, y)
+    expect = clf.predict_proba(x)
+    clf2 = pickle.loads(pickle.dumps(clf))
+    np.testing.assert_allclose(clf2.predict_proba(x), expect, atol=1e-6)
+    bst2 = pickle.loads(pickle.dumps(clf.get_booster()))
+    np.testing.assert_allclose(
+        bst2.predict(x),
+        clf.get_booster().predict(x),
+        atol=1e-6,
+    )
+
+
+def test_classifier_resume_from_model(tmp_path):
+    # reference test_sklearn.py:913-955
+    x, y = _bc()
+    clf_a = RayXGBClassifier(n_estimators=4, max_depth=3, ray_params=_RP)
+    clf_a.fit(x, y)
+    err_a = 1.0 - (clf_a.predict(x) == y).mean()
+    path = str(tmp_path / "a.json")
+    clf_a.save_model(path)
+
+    clf_b = RayXGBClassifier(n_estimators=4, max_depth=3, ray_params=_RP)
+    clf_b.fit(x, y, xgb_model=path)
+    assert clf_b.get_booster().num_boosted_rounds() == 8
+    err_b = 1.0 - (clf_b.predict(x) == y).mean()
+    assert err_b <= err_a + 1e-9
+
+
+def test_constraint_parameters_rejected():
+    # monotone constraints: explicit rejection, not a silent no-op
+    # (reference test_sklearn.py:957-988 trains them; our compat table
+    # documents the NotImplementedError)
+    x, y = _bc()
+    clf = RayXGBClassifier(n_estimators=2, monotone_constraints="(1,-1)",
+                           ray_params=_RP)
+    with pytest.raises(NotImplementedError, match="monotone"):
+        clf.fit(x, y)
+
+
+def test_multiclass_num_class_inferred():
+    # reference test_sklearn.py:159-208
+    x, y = load_iris(return_X_y=True)
+    x = x.astype(np.float32)
+    clf = RayXGBClassifier(n_estimators=4, max_depth=3, ray_params=_RP)
+    clf.fit(x, y.astype(np.float32))
+    assert clf.n_classes_ == 3
+    proba = clf.predict_proba(x)
+    assert proba.shape == (x.shape[0], 3)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+    assert (clf.predict(x) == y).mean() > 0.9

@@ -38,34 +38,54 @@ def assign_partitions_to_actors(
 
     host_to_parts = {h: list(p) for h, p in host_to_parts.items()}
     assignment: Dict[int, List[Any]] = defaultdict(list)
+    ranks = sorted(actor_rank_hosts)
 
-    # 1) co-located pass: actors take local partitions round-robin up to max
+    def deficit() -> int:
+        """Partitions still owed to actors below their min share."""
+        return sum(max(0, min_parts - len(assignment[r])) for r in ranks)
+
+    # 1) co-located pass up to the min share, round-robin
     progress = True
     while progress:
         progress = False
-        for rank, host in actor_rank_hosts.items():
-            if len(assignment[rank]) >= max_parts:
+        for rank in ranks:
+            if len(assignment[rank]) >= min_parts:
                 continue
-            local = host_to_parts.get(host)
+            local = host_to_parts.get(actor_rank_hosts[rank])
             if local:
                 assignment[rank].append(local.pop(0))
                 progress = True
 
-    # 2) spill: remaining partitions round-robin to actors below min/max
+    # 2) co-located pass beyond min up to max — but an actor may only take an
+    #    extra local partition if enough partitions remain for every actor
+    #    still below min (the reference's expected maps encode exactly this
+    #    reservation, tests/test_data_source.py:128-166)
+    progress = True
+    while progress:
+        progress = False
+        remaining = sum(len(p) for p in host_to_parts.values())
+        for rank in ranks:
+            if len(assignment[rank]) >= max_parts:
+                continue
+            local = host_to_parts.get(actor_rank_hosts[rank])
+            if not local:
+                continue
+            if remaining - 1 < deficit():
+                continue  # reserved for a starving (non-co-located) actor
+            assignment[rank].append(local.pop(0))
+            remaining -= 1
+            progress = True
+
+    # 3) spill the remainder: fill everyone to min first, then to max
     rest = [p for parts in host_to_parts.values() for p in parts]
-    ranks = sorted(actor_rank_hosts)
     while rest:
-        placed = False
-        for bound in (min_parts, max_parts):
-            for rank in ranks:
-                if not rest:
-                    break
-                if len(assignment[rank]) < bound:
-                    assignment[rank].append(rest.pop(0))
-                    placed = True
-            if not rest:
-                break
-        if not placed:  # all at max; shouldn't happen, but don't loop forever
+        under_min = [r for r in ranks if len(assignment[r]) < min_parts]
+        targets = under_min or [r for r in ranks if len(assignment[r]) < max_parts]
+        if not targets:  # all at max; shouldn't happen, but don't loop forever
             assignment[ranks[0]].append(rest.pop(0))
+            continue
+        for rank in targets:
+            if rest:
+                assignment[rank].append(rest.pop(0))
 
     return dict(assignment)

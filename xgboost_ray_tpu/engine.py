@@ -24,7 +24,7 @@ reference's split between actor hot loop and driver control flow.
 """
 
 import logging
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -45,8 +45,8 @@ from xgboost_ray_tpu.ops.grow import (
 )
 from xgboost_ray_tpu.ops.metrics import (
     compute_metric,
-    elementwise_contrib,
-    is_elementwise_metric,
+    device_metric_contrib,
+    is_device_metric,
     parse_metric_name,
 )
 from xgboost_ray_tpu.ops.objectives import CustomObjective, get_objective
@@ -93,6 +93,21 @@ class _EvalSet:
         self.margins = None
         self.label_np = None
         self.weight_np = None
+        self.group_rows_dev = None  # sharded [NG, G] layout for device ndcg/map
+
+
+class _EvalArrs(NamedTuple):
+    """Device arrays of one non-train eval set, as passed into the sharded
+    step programs. Optional members hold scalar placeholders (P() specs) when
+    absent so the pytree structure is static."""
+
+    bins: Any
+    label: Any
+    weight: Any
+    valid: Any
+    margins: Any
+    group_rows: Any  # [NG, G] or scalar placeholder
+    margins_static: Any  # dart only; scalar placeholder otherwise
 
 
 class TpuEngine:
@@ -162,11 +177,10 @@ class TpuEngine:
             sibling_subtract=params.sibling_subtract,
         )
 
-        # metrics
+        # metrics (device/host split happens after eval sets exist — ndcg/map
+        # are device metrics only when every eval set has a group layout)
         names = list(params.eval_metric) or [self.objective.default_metric]
         self.metric_names = names
-        self._device_metrics = [m for m in names if is_elementwise_metric(m)]
-        self._host_metrics = [m for m in names if not is_elementwise_metric(m)]
 
         # ---- host data assembly ------------------------------------------
         x, label, weight, base_margin, qid, lo, hi = _concat_shards(shards)
@@ -201,27 +215,37 @@ class TpuEngine:
             None if qid is None else build_group_rows(qid)[1]
         )
 
-        pad_to = -(-max(self.n_rows, self.n_devices) // self.n_devices) * self.n_devices
+        # Multi-host: `shards` holds only THIS process's ranks (in the order of
+        # this process's devices within jax.devices()); row counts are
+        # allgathered to agree on the global padded layout. Single-host this
+        # degenerates to local == global.
+        self._local_rows = self.n_rows
+        self.n_rows, self._local_pad, pad_to = self._global_row_layout(
+            self._local_rows
+        )
         self._row_sharding = NamedSharding(self.mesh, P("actors"))
 
         from xgboost_ray_tpu.distributed import put_rows_global
 
         def put_rows(arr, dtype, fill=0):
-            arr = np.asarray(arr, dtype=dtype)
-            if arr.shape[0] < pad_to:
-                pad_width = [(0, pad_to - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
-                arr = np.pad(arr, pad_width, constant_values=fill)
             # multi-host: arr holds this process's local rows and is assembled
             # into the global sharded array without cross-host copies
+            arr = np.asarray(arr, dtype=dtype)
+            if arr.shape[0] < self._local_pad:
+                pad_width = [(0, self._local_pad - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+                arr = np.pad(arr, pad_width, constant_values=fill)
             return put_rows_global(arr, self._row_sharding)
 
         self._put_rows = put_rows
         self.pad_to = pad_to
         x_dev = put_rows(x, np.float32, fill=np.nan)
-        self.valid = put_rows(np.ones(self.n_rows, bool), bool, fill=False)
+        self.valid = put_rows(np.ones(self._local_rows, bool), bool, fill=False)
         self.label_dev = put_rows(label, np.float32)
         self.weight_dev = put_rows(
-            weight if weight is not None else np.ones(self.n_rows, np.float32), np.float32
+            weight
+            if weight is not None
+            else np.ones(self._local_rows, np.float32),
+            np.float32,
         )
         if self.is_survival:
             if lo is None:
@@ -244,19 +268,33 @@ class TpuEngine:
         self.bins, self.cuts = self._sketch_and_bin(x_dev, self.valid, self.weight_dev)
 
         # ---- ranking group structure (per device block) ------------------
-        self.group_rows = self._build_sharded_groups(qid) if self.is_ranking else None
+        # built whenever qid exists (ranking gradients AND device ndcg/map
+        # metrics use the same padded per-shard group layout)
+        self.group_rows = (
+            self._build_sharded_groups(qid) if qid is not None else None
+        )
+        if self.is_ranking and self.group_rows is None:
+            raise ValueError(f"objective {self.objective.name!r} requires qid")
 
         # ---- margins ------------------------------------------------------
         margins_static = np.full(
-            (self.n_rows, self.n_outputs), self.base_margin0, np.float32
+            (self._local_rows, self.n_outputs), self.base_margin0, np.float32
         )
         if base_margin is not None:
             margins_static = margins_static + base_margin.reshape(
-                self.n_rows, -1
+                self._local_rows, -1
             ).astype(np.float32)
         margins0 = margins_static
         self._init_trees: List[Tree] = []
         self._init_tree_weights: Optional[np.ndarray] = None
+        # propagate the "was saved without per-node stats" marker through
+        # continuation so pred_contribs keeps raising instead of silently
+        # attributing zero to the init trees
+        self._init_has_stats = (
+            getattr(init_booster, "_has_node_stats", True)
+            if init_booster is not None
+            else True
+        )
         if init_booster is not None and init_booster.num_trees:
             margins0 = margins0 + (
                 init_booster.predict_margin_np(x)
@@ -282,6 +320,25 @@ class TpuEngine:
 
         del x_dev  # raw features no longer needed on device
 
+        has_groups = all(
+            (self.group_rows is not None)
+            if es.is_train
+            else (es.group_rows_dev is not None)
+            for es in self.evals
+        )
+        self._device_metrics = [
+            m for m in self.metric_names if is_device_metric(m, has_groups)
+        ]
+        self._host_metrics = [
+            m for m in self.metric_names if not is_device_metric(m, has_groups)
+        ]
+        if self._host_metrics and jax.process_count() > 1:
+            raise NotImplementedError(
+                f"metrics {self._host_metrics} need host-side computation, "
+                f"which is not supported on multi-host meshes (labels are "
+                f"process-local); use device metrics."
+            )
+
         self.trees: List[Tree] = []  # host-side forest, one [K*T, heap] entry per round
         # incremental stacked-forest cache (amortized O(1) copies per tree;
         # re-stacking the whole forest per checkpoint interval was O(T^2))
@@ -297,6 +354,54 @@ class TpuEngine:
         self.iteration_offset = (
             init_booster.num_boosted_rounds() if init_booster is not None else 0
         )
+
+    # ------------------------------------------------------------------
+    def _global_row_layout(self, local_n: int):
+        """(global_n, local_pad, pad_to) for the row-sharded device layout.
+
+        Multi-host, row counts are allgathered so every process agrees on the
+        global padded extent; each process places exactly ``local_pad`` rows
+        (its ranks' rows + tail padding) via put_rows_global.
+        """
+        pc = jax.process_count()
+        if pc == 1:
+            pad_to = -(-max(local_n, self.n_devices) // self.n_devices) * self.n_devices
+            return local_n, pad_to, pad_to
+        from jax.experimental import multihost_utils
+
+        counts = np.asarray(
+            multihost_utils.process_allgather(np.int64(local_n))
+        ).ravel()
+        global_n = int(counts.sum())
+        if self.n_devices % pc:
+            raise ValueError(
+                f"{self.n_devices} mesh devices do not divide evenly over "
+                f"{pc} processes."
+            )
+        per_proc_devices = self.n_devices // pc
+        block = -(-max(global_n, self.n_devices) // self.n_devices)
+        # every process must fit its rows in its devices' blocks
+        block = max(block, int(-(-counts.max() // per_proc_devices)))
+        pad_to = block * self.n_devices
+        local_pad = block * per_proc_devices
+        return global_n, local_pad, pad_to
+
+    def _fetch_rows(self, arr, valid, n_real: int) -> np.ndarray:
+        """Device row-sharded array -> host array of the real data rows.
+
+        Single-host: plain transfer + tail-padding slice. Multi-host: the
+        array spans non-addressable devices, so it is allgathered first and
+        per-process tail padding dropped via the valid mask.
+        """
+        if getattr(arr, "is_fully_addressable", True):
+            return np.asarray(arr)[:n_real]
+        from jax.experimental import multihost_utils
+
+        full = np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+        mask = np.asarray(
+            multihost_utils.process_allgather(valid, tiled=True)
+        ).astype(bool)
+        return full[mask]
 
     # ------------------------------------------------------------------
     def _sketch_and_bin(self, x_dev, valid, weight_dev):
@@ -331,6 +436,11 @@ class TpuEngine:
         pad_to = self.pad_to if pad_to is None else pad_to
         if qid is None:
             raise ValueError(f"objective {self.objective.name!r} requires qid")
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "query-group layouts (ranking objectives / ndcg / map) are "
+                "not yet supported on multi-host meshes."
+            )
         block = pad_to // self.n_devices
         per_dev = []
         for d in range(self.n_devices):
@@ -370,25 +480,30 @@ class TpuEngine:
             self.evals.append(es)
             return
         x, label, weight, base_margin, qid, lo, hi = _concat_shards(eval_shards)
+        local_rows = x.shape[0]
+        n_global, local_pad, pad_to = self._global_row_layout(local_rows)
         es = _EvalSet(
             name,
-            x.shape[0],
+            n_global,
             None if qid is None else build_group_rows(qid)[1],
             False,
         )
-        pad_to = -(-max(x.shape[0], self.n_devices) // self.n_devices) * self.n_devices
 
         from xgboost_ray_tpu.distributed import put_rows_global
 
         def put_rows(arr, dtype, fill=0):
             arr = np.asarray(arr, dtype=dtype)
-            if arr.shape[0] < pad_to:
-                pad_width = [(0, pad_to - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+            if arr.shape[0] < local_pad:
+                pad_width = [(0, local_pad - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
                 arr = np.pad(arr, pad_width, constant_values=fill)
             return put_rows_global(arr, self._row_sharding)
 
         x_dev = put_rows(x, np.float32, fill=np.nan)
         es.bins = self._bin_with_cuts(x_dev)
+        if qid is not None:
+            es.group_rows_dev = self._build_sharded_groups(
+                qid, n_rows=x.shape[0], pad_to=pad_to
+            )
         es.valid = put_rows(np.ones(x.shape[0], bool), bool, fill=False)
         es.label = put_rows(label, np.float32)
         es.weight = put_rows(
@@ -502,54 +617,82 @@ class TpuEngine:
             forest = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
             return new_margins, tuple(new_eval_margins), forest
 
-        def metric_contribs(new_margins, new_eval_margins, label, w_eff, eval_data):
+        def metric_contribs(new_margins, new_eval_margins, label, w_eff,
+                            train_group_rows, eval_data):
             """Post-update psum'd (num, den) pairs per eval set x metric."""
             contribs = []
             ei = 0
             for es in self.evals:
                 if es.is_train:
                     m, lab, w = new_margins, label, w_eff
+                    gr = train_group_rows
                 else:
-                    # dart passes 6-tuples (extra static-margin slot); take the
-                    # shared (label, weight, valid) prefix positions only.
-                    elab, ew, evalid = eval_data[ei][1:4]
+                    ed = eval_data[ei]
                     m, lab, w = (
                         new_eval_margins[ei],
-                        elab,
-                        ew * evalid.astype(jnp.float32),
+                        ed.label,
+                        ed.weight * ed.valid.astype(jnp.float32),
                     )
+                    gr = ed.group_rows
                     ei += 1
                 set_contribs = []
                 for name in dev_metrics:
-                    num, den = elementwise_contrib(name, m, lab, w)
-                    set_contribs.append((psum(num), psum(den)))
+                    set_contribs.append(
+                        device_metric_contrib(name, m, lab, w, gr, psum)
+                    )
                 contribs.append(tuple(set_contribs))
             return tuple(contribs)
 
         return tree_round, metric_contribs
+
+    def _eval_arrs(self) -> tuple:
+        """Non-train eval sets as _EvalArrs (scalar placeholders for absent
+        members so the pytree structure is static across programs)."""
+        out = []
+        for es in self.evals:
+            if es.is_train:
+                continue
+            out.append(_EvalArrs(
+                es.bins, es.label, es.weight, es.valid, es.margins,
+                es.group_rows_dev
+                if es.group_rows_dev is not None
+                else jnp.zeros((), jnp.int32),
+                es.margins_static
+                if es.margins_static is not None
+                else jnp.zeros((), jnp.float32),
+            ))
+        return tuple(out)
+
+    def _eval_arr_specs(self) -> tuple:
+        specs = []
+        for es in self.evals:
+            if es.is_train:
+                continue
+            specs.append(_EvalArrs(
+                P("actors"), P("actors"), P("actors"), P("actors"), P("actors"),
+                P("actors") if es.group_rows_dev is not None else P(),
+                P("actors") if es.margins_static is not None else P(),
+            ))
+        return tuple(specs)
 
     def _make_step(self, custom: bool):
         tree_round, metric_contribs = self._round_closures()
 
         def step(bins, valid, label, weight, margins, group_rows, gh_in, rng,
                  bounds, eval_data):
-            eval_bins = tuple(d[0] for d in eval_data)
-            eval_margins = tuple(d[4] for d in eval_data)
+            eval_bins = tuple(d.bins for d in eval_data)
+            eval_margins = tuple(d.margins for d in eval_data)
             new_margins, new_eval_margins, forest = tree_round(
                 bins, valid, label, weight, margins, group_rows,
                 gh_in if custom else None, rng, bounds, eval_bins, eval_margins,
             )
             contribs = metric_contribs(
                 new_margins, new_eval_margins, label,
-                weight * valid.astype(jnp.float32), eval_data,
+                weight * valid.astype(jnp.float32), group_rows, eval_data,
             )
             return new_margins, new_eval_margins, forest, contribs
 
-        eval_specs = tuple(
-            (P("actors"), P("actors"), P("actors"), P("actors"), P("actors"))
-            for e in self.evals
-            if not e.is_train
-        )
+        eval_specs = self._eval_arr_specs()
         mapped = shard_map(
             step,
             mesh=self.mesh,
@@ -591,8 +734,8 @@ class TpuEngine:
 
         def run(bins, valid, label, weight, margins, group_rows, iterations,
                 bounds, eval_data):
-            eval_bins = tuple(d[0] for d in eval_data)
-            eval_margins0 = tuple(d[4] for d in eval_data)
+            eval_bins = tuple(d.bins for d in eval_data)
+            eval_margins0 = tuple(d.margins for d in eval_data)
 
             def scan_body(carry, iteration):
                 margins_c, eval_margins_c = carry
@@ -603,7 +746,7 @@ class TpuEngine:
                 )
                 contribs = metric_contribs(
                     new_margins, new_eval_margins, label,
-                    weight * valid.astype(jnp.float32), eval_data,
+                    weight * valid.astype(jnp.float32), group_rows, eval_data,
                 )
                 return (new_margins, new_eval_margins), (forest, contribs)
 
@@ -612,11 +755,7 @@ class TpuEngine:
             )
             return margins_out, eval_margins_out, forests, contribs
 
-        eval_specs = tuple(
-            (P("actors"), P("actors"), P("actors"), P("actors"), P("actors"))
-            for e in self.evals
-            if not e.is_train
-        )
+        eval_specs = self._eval_arr_specs()
         mapped = shard_map(
             run,
             mesh=self.mesh,
@@ -659,11 +798,7 @@ class TpuEngine:
             self.iteration_offset + iteration0,
             self.iteration_offset + iteration0 + n_rounds,
         )
-        eval_data = tuple(
-            (es.bins, es.label, es.weight, es.valid, es.margins)
-            for es in self.evals
-            if not es.is_train
-        )
+        eval_data = self._eval_arrs()
         group_rows = (
             self.group_rows if self.group_rows is not None else jnp.zeros((), jnp.int32)
         )
@@ -723,13 +858,14 @@ class TpuEngine:
         rng = jax.random.fold_in(
             jax.random.PRNGKey(self.params.seed), self.iteration_offset + iteration
         )
-        eval_data = tuple(
-            (es.bins, es.label, es.weight, es.valid, es.margins)
-            for es in self.evals
-            if not es.is_train
-        )
+        eval_data = self._eval_arrs()
         group_rows = self.group_rows if self.group_rows is not None else jnp.zeros((), jnp.int32)
         if custom:
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "custom objectives are not supported on multi-host meshes "
+                    "(gradients are computed host-side from gathered margins)."
+                )
             g, h = gh_custom
             gh_in = (
                 self._put_rows(np.asarray(g, np.float32).reshape(self.n_rows, -1), np.float32),
@@ -794,10 +930,14 @@ class TpuEngine:
         return results
 
     def get_margins(self, es: Optional[_EvalSet] = None) -> np.ndarray:
-        """Gather (unpadded) margins for the train set or an eval set."""
+        """Gather (unpadded) margins for the train set or an eval set.
+
+        Works on multi-host meshes: non-addressable sharded margins are
+        allgathered before the padding rows are dropped.
+        """
         if es is None or es.is_train:
-            return np.asarray(self.margins)[: self.n_rows]
-        return np.asarray(es.margins)[: es.n_rows]
+            return self._fetch_rows(self.margins, self.valid, self.n_rows)
+        return self._fetch_rows(es.margins, es.valid, es.n_rows)
 
     def _stacked_forest(self) -> Tree:
         """Stacked [T, heap] forest with incremental appends: only rounds added
@@ -839,6 +979,7 @@ class TpuEngine:
             feature_names=self.feature_names,
             tree_weights=tree_weights,
         )
+        booster._has_node_stats = self._init_has_stats
         return booster
 
 
@@ -903,10 +1044,7 @@ class TpuEngine:
         def dart_step(bins, valid, label, weight, static_margins, group_rows,
                       bounds, forest, w_eff, w_post, new_w, slot, rng, eval_data):
             m_eff = forest_margin(forest, bins, static_margins, w_eff)
-            eval_bins = tuple(d[0] for d in eval_data)
-            # dart needs no incremental eval margins; dummy zeros of the right
-            # shape keep tree_round's interface
-            eval_margins = tuple(d[4] for d in eval_data)
+            eval_bins = tuple(d.bins for d in eval_data)
             new_margins, _, round_forest = tree_round(
                 bins, valid, label, weight, m_eff, group_rows, None, rng,
                 bounds, (), (),
@@ -928,20 +1066,15 @@ class TpuEngine:
             m_full = forest_margin(forest, bins, static_margins, w_full)
             new_eval_margins = []
             for e, d in enumerate(eval_data):
-                m_e = forest_margin(forest, eval_bins[e], d[5], w_full)
+                m_e = forest_margin(forest, eval_bins[e], d.margins_static, w_full)
                 new_eval_margins.append(m_e)
             contribs = metric_contribs(
                 m_full, new_eval_margins, label,
-                weight * valid.astype(jnp.float32), eval_data,
+                weight * valid.astype(jnp.float32), group_rows, eval_data,
             )
             return m_full, tuple(new_eval_margins), forest, round_forest, contribs
 
-        eval_specs = tuple(
-            (P("actors"), P("actors"), P("actors"), P("actors"), P("actors"),
-             P("actors"))
-            for e in self.evals
-            if not e.is_train
-        )
+        eval_specs = self._eval_arr_specs()
         mapped = shard_map(
             dart_step,
             mesh=self.mesh,
@@ -1022,11 +1155,7 @@ class TpuEngine:
         rng = jax.random.fold_in(
             jax.random.PRNGKey(params.seed), self.iteration_offset + iteration
         )
-        eval_data = tuple(
-            (es.bins, es.label, es.weight, es.valid, es.margins, es.margins_static)
-            for es in self.evals
-            if not es.is_train
-        )
+        eval_data = self._eval_arrs()
         group_rows = (
             self.group_rows if self.group_rows is not None else jnp.zeros((), jnp.int32)
         )

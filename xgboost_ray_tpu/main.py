@@ -455,7 +455,12 @@ def _train(
             f"({alive_actors} total actors, {strategy} placement)."
         )
 
-    # 2) locality / FIXED shard assignment (mirror main.py:1161-1165)
+    # 2) locality / FIXED shard assignment (mirror main.py:1161-1165);
+    # fail fast when a distributed matrix has fewer files/partitions than
+    # actors (mirror matrix.py:900-901), covering FIXED mode too
+    num_alive = sum(1 for a in state.actors if a is not None)
+    for dm in [dtrain] + [e[0] for e in evals]:
+        dm.assert_enough_shards_for_actors(num_alive)
     dtrain.assign_shards_to_actors(state.actors)
     for deval, _ in evals:
         deval.assign_shards_to_actors(state.actors)
@@ -482,21 +487,21 @@ def _train(
 
     # 4) build the mesh engine over the alive actors' shards
     alive = [a for a in state.actors if a is not None]
-    parsed = parse_params(params)
     # RayDeviceQuantileDMatrix(max_bin=...) governs the binning of its data
     # (reference matrix.py:977-1033 honors it); an explicit conflicting
-    # params['max_bin'] wins, with a warning.
+    # params['max_bin'] wins, with a warning. Injected before parse_params so
+    # validation has a single source of truth.
+    eff_params = dict(params or {})
     dm_max_bin = getattr(dtrain, "max_bin", None)
     if dm_max_bin:
-        if "max_bin" in (params or {}) and int(params["max_bin"]) != int(dm_max_bin):
+        if "max_bin" in eff_params and int(eff_params["max_bin"]) != int(dm_max_bin):
             logger.warning(
                 "params['max_bin']=%s overrides %s(max_bin=%s).",
-                params["max_bin"], type(dtrain).__name__, dm_max_bin,
+                eff_params["max_bin"], type(dtrain).__name__, dm_max_bin,
             )
         else:
-            if not 1 < int(dm_max_bin) <= 1024:
-                raise ValueError("max_bin must be in (1, 1024]")
-            parsed.max_bin = int(dm_max_bin)
+            eff_params["max_bin"] = int(dm_max_bin)
+    parsed = parse_params(eff_params)
     train_shards = [a.get_shard(dtrain) for a in alive]
     evals_in = []
     for deval, name in evals:
@@ -520,6 +525,18 @@ def _train(
 
     for actor in alive:
         actor._distributed_callbacks.before_train(actor)
+
+    if (obj is not None or feval is not None):
+        import jax as _jax
+
+        if _jax.process_count() > 1:
+            # get_margins gathers globally but labels stay process-local; a
+            # custom obj/feval would silently mix global preds with local
+            # labels — refuse up front with a clear message
+            raise NotImplementedError(
+                "custom objectives / eval functions are not supported on "
+                "multi-host meshes."
+            )
 
     session_mod.init_session(rank=0, queue=state.queue)
     proxy = _EngineBoosterProxy(engine)

@@ -418,7 +418,10 @@ class RayDMatrix:
     @staticmethod
     def _can_load_distributed(data: Data) -> bool:
         if isinstance(data, str):
-            return data.endswith((".csv", ".csv.gz", ".parquet")) or os.path.isdir(data)
+            # a single CSV cannot be row-split across workers; a single
+            # parquet can (row groups), directories/globs expand to files
+            # (reference semantics: matrix.py:1036-1060)
+            return data.endswith(".parquet") or os.path.isdir(data)
         if isinstance(data, (list, tuple)) and data and isinstance(data[0], str):
             return True
         if isinstance(data, (list, tuple)) and data:
@@ -426,6 +429,22 @@ class RayDMatrix:
         if hasattr(data, "__partitioned__"):
             return True
         return False
+
+    def assert_enough_shards_for_actors(self, num_actors: int) -> None:
+        """Distributed mode: fail fast when files/partitions < actors
+        (``xgboost_ray/matrix.py:900-901`` / ``:576-592``)."""
+        if not isinstance(self.loader, _DistributedRayDMatrixLoader):
+            return
+        data = self.loader._expand()
+        source = self.loader.get_data_source()
+        n_shards = source.get_n(data)
+        if num_actors > n_shards:
+            raise RuntimeError(
+                f"Trying to shard data for {num_actors} actors, but it only "
+                f"has {n_shards} files/partitions. Use fewer actors, "
+                f"re-partition, or pass `distributed=False` for centralized "
+                f"row sharding."
+            )
 
     # -- loading -----------------------------------------------------------
 

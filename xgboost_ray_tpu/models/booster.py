@@ -305,6 +305,7 @@ class RayXGBoostBooster:
             "best_iteration": self.best_iteration,
             "best_score": self.best_score,
             "attributes": self._attributes,
+            "has_node_stats": self._has_node_stats,
             "arrays_npz_b64": base64.b64encode(buf.getvalue()).decode("ascii"),
         }
 
@@ -315,7 +316,7 @@ class RayXGBoostBooster:
             # stats fields default to zeros for models saved before they
             # existed; such models cannot produce contributions (see
             # _has_node_stats guard) but predict/resume normally
-            has_stats = "base_weight" in z
+            has_stats = bool(d.get("has_node_stats", "base_weight" in z))
             forest = Tree(
                 **{
                     name: (z[name] if name in z else np.zeros_like(z["value"]))

@@ -78,6 +78,159 @@ _ELEMENTWISE: Dict[str, Callable] = {
 }
 
 
+# --- device-side sort-based metrics -----------------------------------------
+#
+# These keep auc/aucpr/ndcg/map on the batched (lax.scan) fast path instead of
+# forcing per-round host stepping + full margin gathers (the reference gets
+# this for free from xgboost's native allreduce-based metrics).
+#
+# * auc/aucpr: scores are bucketed into AUC_BINS sigmoid-spaced bins (sigmoid
+#   is monotone, so ranks are preserved); the per-shard (pos, neg) weight
+#   histograms are psum-merged and the area computed from the merged CDF with
+#   midrank (trapezoid) tie handling inside the bin. Distributed xgboost is
+#   itself approximate here (it averages per-worker AUCs); 4096 bins is
+#   tighter than that.
+# * ndcg/map: computed per query group on the padded [NG, G] group layout the
+#   ranking gradients already use (groups never straddle shards), reduced to
+#   psum-able (sum over groups, group count).
+
+AUC_BINS = 4096
+
+
+def auc_hist(margin, label, weight):
+    """Per-shard (pos, neg) weight histogram over sigmoid-score bins. [2, B]."""
+    score = margin[:, 0] if margin.shape[1] == 1 else margin[:, 1]
+    p = jax.nn.sigmoid(score)
+    b = jnp.clip((p * AUC_BINS).astype(jnp.int32), 0, AUC_BINS - 1)
+    pos = weight * (label > 0.5)
+    neg = weight * (label <= 0.5)
+    hp = jnp.zeros((AUC_BINS,), jnp.float32).at[b].add(pos)
+    hn = jnp.zeros((AUC_BINS,), jnp.float32).at[b].add(neg)
+    return jnp.stack([hp, hn])
+
+
+def auc_from_hist(h):
+    """ROC AUC from a merged [2, B] histogram (midrank ties within bins)."""
+    pos, neg = h[0], h[1]
+    cneg_before = jnp.cumsum(neg) - neg
+    num = jnp.sum(pos * (cneg_before + 0.5 * neg))
+    pos_tot = jnp.sum(pos)
+    neg_tot = jnp.sum(neg)
+    denom = pos_tot * neg_tot
+    return jnp.where(denom > 0, num / jnp.maximum(denom, 1e-12), 0.5)
+
+
+def aucpr_from_hist(h):
+    """PR AUC from a merged [2, B] histogram (step integration, high-to-low)."""
+    pos = h[0][::-1]  # descending score order
+    neg = h[1][::-1]
+    tp = jnp.cumsum(pos)
+    fp = jnp.cumsum(neg)
+    pos_tot = jnp.maximum(tp[-1], 1e-12)
+    precision = tp / jnp.maximum(tp + fp, 1e-12)
+    recall = tp / pos_tot
+    d_recall = jnp.diff(recall, prepend=0.0)
+    return jnp.where(h[0].sum() > 0, jnp.sum(precision * d_recall), 0.0)
+
+
+def rank_metric_contrib(kind, margin, label, group_rows, k, group_chunk: int = 0):
+    """Per-shard (sum of per-group ndcg@k or map@k, non-empty group count).
+
+    margin [N, K], label [N], group_rows [NG, G] (row indices local to the
+    shard, sentinel >= N for padding). Chunked over groups to bound the
+    [chunk, G] sort working set.
+    """
+    n = label.shape[0]
+    ng, gsz = group_rows.shape
+    kk = gsz if k is None else max(1, min(int(k), gsz))
+    if group_chunk:
+        chunk = group_chunk
+    else:
+        chunk = int(np.clip(4_000_000 // max(gsz, 1), 1, 4096))
+    chunk = min(chunk, max(ng, 1))  # never pad past the real group count
+    s_ext = jnp.concatenate([margin[:, 0], jnp.zeros((1,), margin.dtype)])
+    y_ext = jnp.concatenate([label, jnp.zeros((1,), label.dtype)])
+    valid = group_rows < n
+    rows = jnp.minimum(group_rows, n)
+
+    n_chunks = -(-ng // chunk)
+    pad = n_chunks * chunk - ng
+    rows_p = jnp.pad(rows, ((0, pad), (0, 0)), constant_values=n)
+    valid_p = jnp.pad(valid, ((0, pad), (0, 0)), constant_values=False)
+    rows_c = rows_p.reshape(n_chunks, chunk, gsz)
+    valid_c = valid_p.reshape(n_chunks, chunk, gsz)
+    disc = jnp.where(
+        jnp.arange(gsz) < kk,
+        1.0 / jnp.log2(2.0 + jnp.arange(gsz, dtype=jnp.float32)),
+        0.0,
+    )
+    topk_mask = (jnp.arange(gsz) < kk).astype(jnp.float32)
+
+    def chunk_step(acc, args):
+        r, v = args  # [C, G]
+        s = jnp.where(v, s_ext[r], -jnp.inf)
+        y = jnp.where(v, y_ext[r], 0.0)
+        order = jnp.argsort(s, axis=1, descending=True, stable=True)
+        ys = jnp.take_along_axis(y, order, axis=1)
+        if kind == "ndcg":
+            dcg = jnp.sum((jnp.exp2(ys) - 1.0) * disc[None, :], axis=1)
+            y_ideal = jnp.sort(y, axis=1, descending=True)
+            idcg = jnp.sum((jnp.exp2(y_ideal) - 1.0) * disc[None, :], axis=1)
+            val = jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-12), 1.0)
+        else:  # map
+            rel = (ys > 0).astype(jnp.float32) * topk_mask[None, :]
+            prec = jnp.cumsum(rel, axis=1) / jnp.arange(1, gsz + 1, dtype=jnp.float32)
+            ap_num = jnp.sum(prec * rel, axis=1)
+            ap_den = jnp.sum(rel, axis=1)
+            val = jnp.where(ap_den > 0, ap_num / jnp.maximum(ap_den, 1e-12), 0.0)
+        nonempty = jnp.any(v, axis=1)
+        num, den = acc
+        num = num + jnp.sum(jnp.where(nonempty, val, 0.0))
+        den = den + jnp.sum(nonempty.astype(jnp.float32))
+        return (num, den), None
+
+    (num, den), _ = jax.lax.scan(
+        chunk_step, (jnp.float32(0.0), jnp.float32(0.0)), (rows_c, valid_c)
+    )
+    return num, den
+
+
+def is_device_metric(name: str, has_groups: bool) -> bool:
+    """True if the metric can be computed inside the sharded round step
+    (keeping the lax.scan batched path available)."""
+    base, _ = parse_metric_name(name)
+    if base in _ELEMENTWISE:
+        return True
+    if base in ("auc", "aucpr"):
+        return True
+    if base in ("ndcg", "map"):
+        return has_groups
+    return False
+
+
+def device_metric_contrib(name, margin, label, weight, group_rows, psum):
+    """Device-side psum-merged (num, den) for any device metric.
+
+    The caller divides num/den on host (rmse additionally sqrts), so every
+    metric is reduced to two replicated scalars.
+    """
+    base, arg = parse_metric_name(name)
+    if base in _ELEMENTWISE:
+        if base == "error" and arg is not None:
+            num, den = _error(margin, label, weight, arg)
+        else:
+            num, den = _ELEMENTWISE[base](margin, label, weight)
+        return psum(num), psum(den)
+    if base in ("auc", "aucpr"):
+        h = psum(auc_hist(margin, label, weight))
+        val = auc_from_hist(h) if base == "auc" else aucpr_from_hist(h)
+        return val, jnp.float32(1.0)
+    if base in ("ndcg", "map"):
+        num, den = rank_metric_contrib(base, margin, label, group_rows, arg)
+        return psum(num), psum(den)
+    raise ValueError(f"not a device metric: {name!r}")
+
+
 # --- sort-based metrics (host/global) ---------------------------------------
 
 
@@ -104,6 +257,25 @@ def _auc_np(score: np.ndarray, label: np.ndarray, weight: np.ndarray) -> float:
     # weighted Mann-Whitney U
     auc = (sum_pos_ranks - pos_w * pos_w / 2.0) / (pos_w * neg_w)
     return float(auc)
+
+
+def _aucpr_np(score: np.ndarray, label: np.ndarray, weight: np.ndarray) -> float:
+    """Weighted PR AUC (step integration over descending unique scores)."""
+    order = np.argsort(-score, kind="stable")
+    y, w = (label[order] > 0.5).astype(np.float64), weight[order].astype(np.float64)
+    tp = np.cumsum(w * y)
+    fp = np.cumsum(w * (1.0 - y))
+    pos_tot = tp[-1] if tp.size else 0.0
+    if pos_tot <= 0:
+        return 0.0
+    # evaluate at the last index of each tied-score run
+    s = score[order]
+    last = np.r_[s[1:] != s[:-1], True]
+    tp, fp = tp[last], fp[last]
+    precision = tp / np.maximum(tp + fp, 1e-12)
+    recall = tp / pos_tot
+    d_recall = np.diff(np.r_[0.0, recall])
+    return float(np.sum(precision * d_recall))
 
 
 def _dcg_at(labels: np.ndarray, k: int) -> float:
@@ -212,9 +384,10 @@ def compute_metric(
         num, den = float(num), float(den)
         val = num / max(den, 1e-12)
         return float(np.sqrt(val)) if base == "rmse" else val
-    if base == "auc":
+    if base in ("auc", "aucpr"):
         score = margin[:, 0] if margin.shape[1] == 1 else margin[:, 1]
-        return _auc_np(score.astype(np.float64), label, weight.astype(np.float64))
+        fn = _auc_np if base == "auc" else _aucpr_np
+        return fn(score.astype(np.float64), label, weight.astype(np.float64))
     if base in ("ndcg", "map"):
         if group_ptr is None:
             group_ptr = np.array([0, label.shape[0]], dtype=np.int64)

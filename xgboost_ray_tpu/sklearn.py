@@ -118,6 +118,10 @@ class RayXGBMixin:
             val = getattr(self, name, None)
             if val is not None:
                 params[name] = val
+        for name in getattr(self, "_extra_xgb_params", ()):
+            val = getattr(self, name, None)
+            if val is not None:
+                params[name] = val
         if getattr(self, "eval_metric", None) is not None:
             params["eval_metric"] = self.eval_metric
         if getattr(self, "random_state", None) is not None:
@@ -348,6 +352,10 @@ class _RayXGBEstimator(BaseEstimator, RayXGBMixin):
         self.max_bin = max_bin
         self.eval_metric = eval_metric
         self.early_stopping_rounds = early_stopping_rounds
+        # arbitrary xgboost params (dart knobs, constraints, ...) ride along
+        # and are forwarded by get_xgb_params — the training-params parser is
+        # the single place that accepts/rejects them (no silent drops)
+        self._extra_xgb_params = list(kwargs)
         for key, value in kwargs.items():
             setattr(self, key, value)
 
@@ -414,13 +422,17 @@ class _RayXGBEstimator(BaseEstimator, RayXGBMixin):
         )
 
 
-class RayXGBRegressor(_RayXGBEstimator, RegressorMixin):
-    """Distributed XGBoost-style regressor (mirror ``sklearn.py:602-644``)."""
+class RayXGBRegressor(RegressorMixin, _RayXGBEstimator):
+    """Distributed XGBoost-style regressor (mirror ``sklearn.py:602-644``).
+
+    Mixin-first base order so sklearn's tag system (``__sklearn_tags__``)
+    reports estimator_type="regressor" — meta-estimators (Stacking*, CV
+    selectors) validate on it."""
 
     _default_objective = "reg:squarederror"
 
 
-class RayXGBClassifier(_RayXGBEstimator, ClassifierMixin):
+class RayXGBClassifier(ClassifierMixin, _RayXGBEstimator):
     """Distributed XGBoost-style classifier (mirror ``sklearn.py:451-600``)."""
 
     _default_objective = "binary:logistic"
