@@ -1,0 +1,52 @@
+"""Train from a Dask DataFrame (parity with ``examples/simple_dask.py``).
+
+Gated: prints a notice and exits cleanly when dask is not installed (it is
+not part of the TPU image), exactly like the reference example does.
+"""
+
+import argparse
+
+import numpy as np
+import pandas as pd
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+from xgboost_ray_tpu.data_sources.dask import _dask_installed
+
+
+def main(num_actors: int):
+    if not _dask_installed():
+        print("Dask is not installed. Install with `pip install dask` to run "
+              "this example; the Dask data source activates automatically.")
+        return
+
+    import dask.dataframe as dd
+
+    x = np.repeat(range(8), 16).reshape((32, 4))
+    y = np.tile(np.repeat(range(2), 4), 4)
+    bits_to_flip = np.random.choice(32, size=6, replace=False)
+    y[bits_to_flip] = 1 - y[bits_to_flip]
+
+    data = pd.DataFrame(x, columns=[f"f{i}" for i in range(4)])
+    data["label"] = y
+    dask_df = dd.from_pandas(data, npartitions=4)
+
+    train_set = RayDMatrix(dask_df, "label")
+    evals_result = {}
+    bst = train(
+        {"objective": "binary:logistic", "eval_metric": ["logloss", "error"]},
+        train_set,
+        evals_result=evals_result,
+        evals=[(train_set, "train")],
+        verbose_eval=False,
+        num_boost_round=10,
+        ray_params=RayParams(num_actors=num_actors),
+    )
+    bst.save_model("simple_dask.json")
+    print(f"Final training error: {evals_result['train']['error'][-1]:.4f}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-actors", type=int, default=2)
+    args = parser.parse_args()
+    main(args.num_actors)

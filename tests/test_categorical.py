@@ -1,0 +1,213 @@
+"""Categorical-feature support (``enable_categorical``, one-vs-rest splits).
+
+Reference surface: ``xgboost_ray/sklearn.py:404-407`` passes
+``enable_categorical`` through to xgboost. Here categorical bins ARE the
+category codes and the split search evaluates one-vs-rest partitions
+(xgboost's one-hot categorical splits), routed by code equality.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+from xgboost_ray_tpu.sklearn import RayXGBClassifier
+
+_RP = RayParams(num_actors=2)
+
+
+def _nonordinal_fixture(n=600, seed=0):
+    """y depends on category membership {1, 3} — hostile to ordinal
+    thresholds, trivial for one-vs-rest splits."""
+    rng = np.random.RandomState(seed)
+    cat = rng.randint(0, 5, n).astype(np.float32)
+    noise = rng.randn(n).astype(np.float32)
+    y = np.isin(cat, [1, 3]).astype(np.float32)
+    x = np.stack([cat, noise], axis=1)
+    return x, y
+
+
+def test_categorical_beats_numeric_coding_at_fixed_depth():
+    x, y = _nonordinal_fixture()
+    params = {"objective": "binary:logistic", "eval_metric": ["error"],
+              "max_depth": 2, "eta": 1.0}
+    res_cat, res_num = {}, {}
+    # one round: a single depth-2 tree. One-vs-rest splits isolate {1} and
+    # {3} exactly; ordinal thresholds on the same codes cannot.
+    train(params, RayDMatrix(x, y, feature_types=["c", "q"]), 1,
+          evals=[(RayDMatrix(x, y, feature_types=["c", "q"]), "t")],
+          evals_result=res_cat, ray_params=_RP)
+    train(params, RayDMatrix(x, y), 1,
+          evals=[(RayDMatrix(x, y), "t")], evals_result=res_num,
+          ray_params=_RP)
+    assert res_cat["t"]["error"][-1] == 0.0
+    assert res_num["t"]["error"][-1] > 0.1
+
+
+def test_categorical_predict_matches_training_margins():
+    """Raw-x equality routing must agree with the binned training walk."""
+    x, y = _nonordinal_fixture(seed=1)
+    er = {}
+    bst = train({"objective": "binary:logistic", "eval_metric": ["logloss"],
+                 "max_depth": 3},
+                RayDMatrix(x, y, feature_types=["c", "q"]), 5,
+                evals=[(RayDMatrix(x, y, feature_types=["c", "q"]), "t")],
+                evals_result=er, ray_params=_RP)
+    from xgboost_ray_tpu.ops.metrics import compute_metric
+
+    margin = bst.predict(x, output_margin=True)
+    ll = compute_metric("logloss", margin, y)
+    assert abs(ll - er["t"]["logloss"][-1]) < 1e-5
+    # pred_leaf and contribs run through the same categorical routing
+    leaves = bst.predict(x, pred_leaf=True)
+    assert leaves.shape == (x.shape[0], 5)
+    contribs = bst.predict(x, pred_contribs=True, approx_contribs=True)
+    np.testing.assert_allclose(contribs.sum(1), margin, atol=1e-4)
+
+
+def test_pandas_category_dtype_auto_encoding():
+    rng = np.random.RandomState(2)
+    color = rng.choice(["red", "green", "blue", "teal"], size=400)
+    z = rng.randn(400).astype(np.float32)
+    y = ((color == "green") | (color == "teal")).astype(np.float32)
+    df = pd.DataFrame({"color": pd.Categorical(color), "z": z})
+    dm = RayDMatrix(df, y, enable_categorical=True)
+    dm.get_data(0, 2)  # triggers loading, which resolves the type map
+    assert dm.resolved_feature_types == ["c", "q"]
+    er = {}
+    bst = train({"objective": "binary:logistic", "eval_metric": ["error"],
+                 "max_depth": 2, "eta": 1.0},
+                dm, 4, evals=[(dm, "t")], evals_result=er, ray_params=_RP)
+    assert er["t"]["error"][-1] == 0.0
+    # model predicts on the encoded representation
+    codes = pd.Categorical(color).codes.astype(np.float32)
+    pred = bst.predict(np.stack([codes, z], 1))
+    assert ((pred > 0.5) == y).mean() == 1.0
+
+
+def test_object_column_without_flag_raises():
+    df = pd.DataFrame({"s": ["a", "b", "a", "c"], "v": [1.0, 2.0, 3.0, 4.0]})
+    y = np.array([0, 1, 0, 1], np.float32)
+    dm = RayDMatrix(df, y)
+    with pytest.raises(ValueError, match="enable_categorical"):
+        dm.get_data(0, 1)
+
+
+def test_category_codes_out_of_range_raise():
+    x = np.stack([np.arange(100, dtype=np.float32) * 10,  # codes up to 990
+                  np.random.RandomState(3).randn(100).astype(np.float32)], 1)
+    y = (np.arange(100) % 2).astype(np.float32)
+    with pytest.raises(ValueError, match="max_bin"):
+        train({"objective": "binary:logistic", "max_bin": 64},
+              RayDMatrix(x, y, feature_types=["c", "q"]), 2, ray_params=_RP)
+
+
+def test_categorical_missing_values_follow_learned_default():
+    x, y = _nonordinal_fixture(seed=4)
+    x = x.copy()
+    x[::7, 0] = np.nan
+    bst = train({"objective": "binary:logistic", "max_depth": 3},
+                RayDMatrix(x, y, feature_types=["c", "q"]), 5, ray_params=_RP)
+    pred = bst.predict(x)
+    mask = ~np.isnan(x[:, 0])
+    assert ((pred[mask] > 0.5) == y[mask]).mean() > 0.95
+
+
+def test_categorical_save_load_roundtrip(tmp_path):
+    x, y = _nonordinal_fixture(seed=5)
+    bst = train({"objective": "binary:logistic", "max_depth": 3},
+                RayDMatrix(x, y, feature_types=["c", "q"]), 4, ray_params=_RP)
+    p = str(tmp_path / "m.json")
+    bst.save_model(p)
+    from xgboost_ray_tpu.models.booster import Booster
+
+    loaded = Booster.load_model(p)
+    assert loaded.cat_features == (0,)
+    np.testing.assert_allclose(loaded.predict(x), bst.predict(x), atol=1e-6)
+
+
+def test_sklearn_enable_categorical_passthrough():
+    rng = np.random.RandomState(6)
+    color = rng.choice(["a", "b", "c", "d"], size=300)
+    df = pd.DataFrame({
+        "cat": pd.Categorical(color),
+        "num": rng.randn(300).astype(np.float32),
+    })
+    y = np.isin(color, ["b", "d"]).astype(np.float32)
+    clf = RayXGBClassifier(n_estimators=4, max_depth=2, learning_rate=1.0,
+                           enable_categorical=True, ray_params=_RP)
+    clf.fit(df, y)
+    assert (clf.predict(df) == y).mean() == 1.0
+
+
+def test_category_mapping_survives_different_frames():
+    """A predict/eval frame whose category SET differs from training must be
+    encoded with the TRAINING mapping — not its own — or equality splits
+    route values down wrong branches."""
+    rng = np.random.RandomState(7)
+    color = rng.choice(["a", "b", "c"], size=600)
+    z = rng.randn(600).astype(np.float32)
+    y = (color == "c").astype(np.float32)
+    df = pd.DataFrame({"color": pd.Categorical(color), "z": z})
+    bst = train({"objective": "binary:logistic", "max_depth": 2, "eta": 1.0},
+                RayDMatrix(df, y, enable_categorical=True), 3, ray_params=_RP)
+    assert bst.categories == {0: ("a", "b", "c")}
+
+    # booster.predict on a frame containing ONLY 'c' (its own codes would
+    # call it 0 == 'a'); the stored mapping must route it as 'c'
+    only_c = pd.DataFrame({
+        "color": pd.Categorical(["c"] * 10),
+        "z": np.zeros(10, np.float32),
+    })
+    pred = bst.predict(only_c)
+    assert (pred > 0.5).all()
+
+    # unseen category -> NaN -> learned default direction, no crash
+    unseen = pd.DataFrame({
+        "color": pd.Categorical(["zzz"] * 5, categories=["zzz"]),
+        "z": np.zeros(5, np.float32),
+    })
+    assert bst.predict(unseen).shape == (5,)
+
+    # distributed predict() path translates shard codes too
+    from xgboost_ray_tpu import predict as ray_predict
+
+    pred2 = ray_predict(bst, RayDMatrix(only_c, enable_categorical=True),
+                        ray_params=_RP)
+    np.testing.assert_allclose(pred2, pred, atol=1e-6)
+
+    # mapping survives save/load
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "m.json")
+        bst.save_model(p)
+        from xgboost_ray_tpu.models.booster import Booster
+        loaded = Booster.load_model(p)
+        assert loaded.categories == {0: ("a", "b", "c")}
+        np.testing.assert_allclose(loaded.predict(only_c), pred, atol=1e-6)
+
+
+def test_eval_set_with_different_category_subset():
+    """Eval frames holding a category subset must evaluate correctly (codes
+    re-mapped onto the training mapping before binning)."""
+    rng = np.random.RandomState(8)
+    color = rng.choice(["a", "b", "c", "d"], size=800)
+    z = rng.randn(800).astype(np.float32)
+    y = np.isin(color, ["b", "d"]).astype(np.float32)
+    df = pd.DataFrame({"color": pd.Categorical(color), "z": z})
+
+    # eval set: only rows with colors {b, d} -> its own codes would be {0,1}
+    mask = np.isin(color, ["b", "d"])
+    df_eval = pd.DataFrame({
+        "color": pd.Categorical(color[mask]),
+        "z": z[mask],
+    })
+    er = {}
+    train({"objective": "binary:logistic", "eval_metric": ["error"],
+           "max_depth": 2, "eta": 1.0},
+          RayDMatrix(df, y, enable_categorical=True), 3,
+          evals=[(RayDMatrix(df_eval, y[mask], enable_categorical=True), "v")],
+          evals_result=er, ray_params=_RP)
+    # all eval rows are positive-class categories: a correctly-mapped eval
+    # reaches zero error; a code-drifted one would misroute half of them
+    assert er["v"]["error"][-1] == 0.0

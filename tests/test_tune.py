@@ -166,3 +166,44 @@ def test_placement_strategy_selection(monkeypatch, tmp_path):
     assert _get_placement_strategy(in_tune_session=True) == "PACK"
     monkeypatch.setenv("RXGB_USE_SPREAD_STRATEGY", "0")
     assert _get_placement_strategy(in_tune_session=False) == "PACK"
+
+
+def test_tuner_concurrent_trials(tmp_path, xy):
+    """max_concurrent_trials partitions the mesh into disjoint device slices
+    and runs trials in parallel threads; results match the sequential path."""
+    import jax
+
+    from xgboost_ray_tpu.tuner import Tuner, grid_search
+
+    x, y = xy
+
+    seen_devices = []
+
+    def trainable(config):
+        from xgboost_ray_tpu import tune as tune_mod
+
+        sess = tune_mod.get_session()
+        seen_devices.append(tuple(sess.devices))
+        evals_result = {}
+        train(
+            {"objective": "binary:logistic", "eval_metric": ["logloss"],
+             "eta": config["eta"]},
+            RayDMatrix(x, y), 3,
+            evals=[(RayDMatrix(x, y), "train")], evals_result=evals_result,
+            ray_params=RayParams(num_actors=2),
+        )
+
+    tuner = Tuner(
+        trainable, {"eta": grid_search([0.1, 0.3, 0.5, 0.7])},
+        metric="train-logloss", mode="min",
+        experiment_dir=str(tmp_path), max_concurrent_trials=2,
+    )
+    result = tuner.fit()
+    assert len(result.trials) == 4
+    assert all(t.error is None for t in result.trials)
+    assert result.get_best_trial() is not None
+    # two disjoint slices of the 8-device mesh were used
+    assert len(set(seen_devices)) == 2
+    a, b = sorted(set(seen_devices), key=lambda ds: ds[0].id)
+    assert not (set(a) & set(b))
+    assert len(a) == len(jax.devices()) // 2

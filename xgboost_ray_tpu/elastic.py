@@ -16,12 +16,34 @@ reference's orchestrated-timeline tests — behave the same.
 """
 
 import logging
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from xgboost_ray_tpu.exceptions import RayActorError, RayXGBoostActorAvailable
 
 logger = logging.getLogger(__name__)
+
+# how long _maybe_schedule_new_actors waits synchronously for a rescheduled
+# rank's data load before letting it continue in the background (the
+# reference stages loading in background actor tasks, elastic.py:63-87 —
+# a slow shard must not stall the surviving workers' training loop)
+_LOAD_FAST_PATH_S = 1.0
+
+
+class PendingActor:
+    """A rescheduled rank staged through (possibly background) data loading."""
+
+    def __init__(self, actor, created_at: float):
+        self.actor = actor
+        self.created_at = created_at
+        self.ready_at: Optional[float] = None
+        self.error: Optional[BaseException] = None
+        self.thread: Optional[threading.Thread] = None
+
+    @property
+    def ready(self) -> bool:
+        return self.ready_at is not None
 
 
 def _maybe_schedule_new_actors(
@@ -49,6 +71,7 @@ def _maybe_schedule_new_actors(
     dead_ranks = set(training_state.elastic_dead_ranks) | set(
         training_state.failed_actor_ranks
     )
+    started: List[Tuple[int, PendingActor]] = []
     for rank in sorted(dead_ranks):
         if rank in training_state.pending_actors:
             continue
@@ -59,16 +82,35 @@ def _maybe_schedule_new_actors(
             training_state.stop_event,
             ray_params.distributed_callbacks,
         )
-        try:
-            for matrix in load_data:
-                actor.load_data(matrix)
-        except Exception as exc:  # noqa: BLE001 - stay elastic on load failure
+        pending = PendingActor(actor, now)
+
+        def _load(pending=pending, actor=actor):
+            try:
+                for matrix in load_data:
+                    actor.load_data(matrix)
+                pending.ready_at = time.time()
+            except BaseException as exc:  # noqa: BLE001 - surfaced by updater
+                pending.error = exc
+
+        pending.thread = threading.Thread(
+            target=_load, name=f"elastic-load-rank-{rank}", daemon=True
+        )
+        pending.thread.start()
+        started.append((rank, pending))
+
+    # fast path: tiny/central loads finish within one SHARED deadline; slow
+    # distributed loads continue in the background without stalling the round
+    # loop (no per-rank serial join — N dead ranks still cost <= 1s total)
+    deadline = time.time() + _LOAD_FAST_PATH_S
+    for rank, pending in started:
+        pending.thread.join(max(0.0, deadline - time.time()))
+        if pending.error is not None:
             logger.warning(
                 f"[RayXGBoost] Could not load data for rescheduled rank "
-                f"{rank}: {exc}"
+                f"{rank}: {pending.error}"
             )
             continue
-        training_state.pending_actors[rank] = (actor, now)
+        training_state.pending_actors[rank] = pending
         scheduled = True
         logger.debug(f"[RayXGBoost] Re-scheduled worker with rank {rank}.")
     return scheduled
@@ -76,10 +118,23 @@ def _maybe_schedule_new_actors(
 
 def _update_scheduled_actor_states(training_state):
     """Promote ready pending workers; after the grace period force a restart
-    from checkpoint by raising RayXGBoostActorAvailable (elastic.py:98-142)."""
+    from checkpoint by raising RayXGBoostActorAvailable (elastic.py:98-142).
+
+    Workers whose background data load failed are dropped (and re-tried on
+    the next resource check); the grace clock only arms once at least one
+    pending worker has FINISHED loading."""
     from xgboost_ray_tpu.main import ENV
 
     if not training_state.pending_actors:
+        return
+    for rank, pending in list(training_state.pending_actors.items()):
+        if pending.error is not None:
+            logger.warning(
+                f"[RayXGBoost] Background data load failed for rescheduled "
+                f"rank {rank}: {pending.error}"
+            )
+            del training_state.pending_actors[rank]
+    if not any(p.ready for p in training_state.pending_actors.values()):
         return
     now = time.time()
     if training_state.restart_training_at is None:

@@ -122,6 +122,8 @@ class TpuEngine:
         feature_names: Optional[List[str]] = None,
         total_rounds: Optional[int] = None,
         feature_weights: Optional[Any] = None,
+        feature_types: Optional[List[str]] = None,
+        categories: Optional[Dict[int, tuple]] = None,
     ):
         self.params = params
         self.feature_names = feature_names
@@ -161,6 +163,13 @@ class TpuEngine:
         self.base_score = float(base_score)
         self.base_margin0 = float(self.objective.base_score_to_margin(self.base_score))
 
+        # categorical features: bins are category codes, splits one-vs-rest
+        from xgboost_ray_tpu.params import cat_feature_indices
+
+        self.feature_types = feature_types
+        self.categories = categories
+        self._cat_features: tuple = cat_feature_indices(feature_types)
+
         self.cfg = GrowConfig(
             max_depth=params.max_depth,
             max_bin=params.max_bin,
@@ -175,6 +184,7 @@ class TpuEngine:
             hist_impl=resolve_hist_impl(params.hist_impl),
             hist_chunk=params.hist_chunk,
             sibling_subtract=params.sibling_subtract,
+            cat_features=self._cat_features,
         )
 
         # metrics (device/host split happens after eval sets exist — ndcg/map
@@ -191,6 +201,22 @@ class TpuEngine:
             )
         self.n_rows = x.shape[0]
         self.n_features = x.shape[1]
+
+        if any(i >= self.n_features for i in self._cat_features):
+            raise ValueError("feature_types has more entries than features.")
+        for fi in self._cat_features:
+            col = x[:, fi]
+            vals = col[~np.isnan(col)]
+            if vals.size and (
+                (vals < 0).any()
+                or (vals != np.round(vals)).any()
+                or vals.max() > params.max_bin - 2
+            ):
+                raise ValueError(
+                    f"categorical feature {fi} must hold integer codes in "
+                    f"[0, {params.max_bin - 2}] (max_bin={params.max_bin}); "
+                    f"raise max_bin or re-encode the column."
+                )
 
         # feature_weights bias the colsample_* draws (Gumbel-top-k weighted
         # sampling without replacement; xgboost set_info(feature_weights=...))
@@ -406,6 +432,7 @@ class TpuEngine:
     # ------------------------------------------------------------------
     def _sketch_and_bin(self, x_dev, valid, weight_dev):
         max_bin = self.params.max_bin
+        cat_features = self._cat_features
 
         def fn(x, v, w):
             mn, mx = binning.feature_min_max(x, v)
@@ -414,6 +441,14 @@ class TpuEngine:
             hist = binning.sketch_histogram(x, v, mn, mx, weight=w)
             hist = jax.lax.psum(hist, "actors")
             cuts = binning.cuts_from_sketch(mn, mx, hist, max_bin)
+            if cat_features:
+                # categorical columns: cut k sits at k + 0.5, so the bin index
+                # IS the category code and one-vs-rest split search applies
+                from xgboost_ray_tpu.ops.grow import cat_mask_const
+
+                cat_mask = cat_mask_const(cat_features, x.shape[1])
+                code_cuts = jnp.arange(max_bin - 1, dtype=cuts.dtype) + 0.5
+                cuts = jnp.where(cat_mask[:, None], code_cuts[None, :], cuts)
             bins = binning.bin_matrix(x, cuts, max_bin)
             return bins, cuts
 
@@ -609,7 +644,8 @@ class TpuEngine:
                     new_margins = new_margins.at[:, k].add(row_value / t_par)
                     for e in range(n_evals_dev):
                         upd = predict_tree_binned(
-                            tree, eval_bins[e], cfg.max_depth, missing_bin
+                            tree, eval_bins[e], cfg.max_depth, missing_bin,
+                            cat_features=cfg.cat_features,
                         )
                         new_eval_margins[e] = (
                             new_eval_margins[e].at[:, k].add(upd / t_par)
@@ -977,9 +1013,11 @@ class TpuEngine:
             self.params,
             self.base_score,
             feature_names=self.feature_names,
+            feature_types=self.feature_types,
             tree_weights=tree_weights,
         )
         booster._has_node_stats = self._init_has_stats
+        booster.categories = self.categories
         return booster
 
 
@@ -1033,7 +1071,10 @@ class TpuEngine:
 
         def forest_margin(forest, bins_local, static, weights):
             leaf = jax.vmap(
-                lambda tr: predict_tree_binned(tr, bins_local, cfg.max_depth, missing_bin)
+                lambda tr: predict_tree_binned(
+                    tr, bins_local, cfg.max_depth, missing_bin,
+                    cat_features=cfg.cat_features,
+                )
             )(forest)  # [t_cap, S]
             contrib = jnp.einsum(
                 "ts,tk->sk", leaf * weights[:, None], cls_onehot,

@@ -41,7 +41,12 @@ from xgboost_ray_tpu.exceptions import (
     RayXGBoostTrainingError,
     RayXGBoostTrainingStopped,
 )
-from xgboost_ray_tpu.matrix import RayDMatrix, RayShardingMode, combine_data
+from xgboost_ray_tpu.matrix import (
+    RayDMatrix,
+    RayShardingMode,
+    combine_data,
+    translate_shard_categories,
+)
 from xgboost_ray_tpu.models.booster import RayXGBoostBooster
 from xgboost_ray_tpu.params import parse_params
 from xgboost_ray_tpu import session as session_mod
@@ -260,7 +265,7 @@ class _TrainingState:
     elastic_dead_ranks: set = dataclasses.field(default_factory=set)
 
     # elastic scheduling (mirror of elastic.py state)
-    pending_actors: Optional[Dict[int, Tuple[RayXGBoostActor, float]]] = None
+    pending_actors: Optional[Dict[int, Any]] = None  # rank -> elastic.PendingActor
     restart_training_at: Optional[float] = None
     last_resource_check_at: float = 0.0
 
@@ -503,22 +508,39 @@ def _train(
             eff_params["max_bin"] = int(dm_max_bin)
     parsed = parse_params(eff_params)
     train_shards = [a.get_shard(dtrain) for a in alive]
+    train_cats = dtrain.resolved_categories
     evals_in = []
     for deval, name in evals:
         if deval is dtrain:
             evals_in.append((train_shards, name))
         else:
-            evals_in.append(([a.get_shard(deval) for a in alive], name))
+            eshards = [a.get_shard(deval) for a in alive]
+            ecats = deval.resolved_categories
+            if train_cats and ecats != train_cats:
+                # align auto-encoded category codes with the training mapping
+                eshards = [
+                    translate_shard_categories(s, ecats, train_cats)
+                    for s in eshards
+                ]
+            evals_in.append((eshards, name))
     init_booster = _deserialize_booster(state.checkpoint.value)
+    # a concurrent tune trial may own a slice of the device mesh
+    from xgboost_ray_tpu import tune as _tune_mod
+
+    _sess = _tune_mod.get_session()
+    trial_devices = getattr(_sess, "devices", None) if _sess else None
     engine = TpuEngine(
         train_shards,
         parsed,
         num_actors=len(alive),
         evals=evals_in,
+        devices=trial_devices,
         init_booster=init_booster,
         feature_names=dtrain.resolved_feature_names,
         total_rounds=boost_rounds_left,
         feature_weights=dtrain.feature_weights,
+        feature_types=dtrain.resolved_feature_types,
+        categories=train_cats,
     )
     total_n = sum(a.local_n(dtrain) for a in alive)
     state.additional_results["total_n"] = total_n
@@ -592,20 +614,24 @@ def _train(
             chunk_started = time.time()
             chunk_results = engine.step_many(completed, n)
             round_times.extend([(time.time() - chunk_started) / n] * n)
-            for round_metrics in chunk_results:
+            for ri, round_metrics in enumerate(chunk_results):
                 for set_name, metrics in round_metrics.items():
                     for metric_name, value in metrics.items():
                         evals_result.setdefault(set_name, {}).setdefault(
                             metric_name, []
                         ).append(value)
+                # same per-round interval semantics as the per-round path
+                i = completed + ri
+                if verbose_eval and (
+                    verbose_eval is True or (i % max(int(verbose_eval), 1) == 0)
+                ):
+                    flat = "\t".join(
+                        f"{sn}-{mn}:{ms[mn]:.5f}"
+                        for sn, ms in round_metrics.items()
+                        for mn in ms
+                    )
+                    print(f"[{i}]\t{flat}")
             completed += n
-            if verbose_eval and evals_result:
-                flat = "\t".join(
-                    f"{sn}-{mn}:{v[-1]:.5f}"
-                    for sn, ms in evals_result.items()
-                    for mn, v in ms.items()
-                )
-                print(f"[{completed - 1}]\t{flat}")
             if checkpoint_frequency:
                 booster = engine.get_booster()
                 iteration = engine.iteration_offset + completed - 1
@@ -1023,8 +1049,10 @@ def _rewire_actors(state: _TrainingState):
 
 
 def _promote_pending_actors(state: _TrainingState):
-    for rank, (actor, _ready_at) in list((state.pending_actors or {}).items()):
-        state.actors[rank] = actor
+    for rank, pending in list((state.pending_actors or {}).items()):
+        if not pending.ready:
+            continue  # still loading in the background; promote next time
+        state.actors[rank] = pending.actor
         state.failed_actor_ranks.discard(rank)
         state.elastic_dead_ranks.discard(rank)
         del state.pending_actors[rank]
@@ -1054,9 +1082,15 @@ def _predict(
 
     predict_kwargs = dict(kwargs)
     predict_kwargs.setdefault("validate_features", False)
+    model_cats = getattr(model, "categories", None)
     results = []
     for actor in actors:
         shard = actor.get_shard(data)
+        if model_cats and data.resolved_categories != model_cats:
+            # align this frame's auto-encoded codes with the model's mapping
+            shard = translate_shard_categories(
+                shard, data.resolved_categories, model_cats
+            )
         if shard.get("base_margin") is not None and "base_margin" not in predict_kwargs:
             pred = model.predict(
                 shard["data"], base_margin=shard["base_margin"], **predict_kwargs

@@ -105,6 +105,47 @@ def ensure_sorted_by_qid(df: pd.DataFrame, qid) -> Tuple[pd.DataFrame, Any]:
     return df.iloc[order], qid_sorted
 
 
+def translate_category_codes(
+    col: np.ndarray, from_cats: Sequence[Any], to_cats: Sequence[Any]
+) -> np.ndarray:
+    """Re-map category codes encoded against ``from_cats`` onto ``to_cats``.
+
+    Categories absent from ``to_cats`` become NaN (missing) — the same
+    behavior xgboost shows for unseen categories at predict time.
+    """
+    mapping = np.full(len(from_cats), np.nan, np.float32)
+    to_index = {v: i for i, v in enumerate(to_cats)}
+    for i, v in enumerate(from_cats):
+        if v in to_index:
+            mapping[i] = to_index[v]
+    out = np.full(col.shape, np.nan, np.float32)
+    valid = ~np.isnan(col)
+    out[valid] = mapping[col[valid].astype(np.int64)]
+    return out
+
+
+def translate_shard_categories(
+    shard: Dict[str, Optional[np.ndarray]],
+    from_cats: Optional[Dict[int, Sequence[Any]]],
+    to_cats: Optional[Dict[int, Sequence[Any]]],
+) -> Dict[str, Optional[np.ndarray]]:
+    """Align an auto-encoded shard's category codes with a reference mapping
+    (the training matrix's): frames with different category sets would
+    otherwise assign different codes to the same value and be routed down
+    wrong branches."""
+    if not to_cats or from_cats == to_cats:
+        return shard
+    data = np.array(shard["data"], copy=True)
+    for col, cats in (from_cats or {}).items():
+        target = to_cats.get(col)
+        if target is None or tuple(cats) == tuple(target):
+            continue
+        data[:, col] = translate_category_codes(data[:, col], cats, target)
+    out = dict(shard)
+    out["data"] = data
+    return out
+
+
 class _RayDMatrixLoader:
     """Shared loader logic: source resolution, dataframe splitting."""
 
@@ -123,6 +164,7 @@ class _RayDMatrixLoader:
         qid: Optional[Data] = None,
         filetype: Optional[RayFileType] = None,
         ignore: Optional[List[str]] = None,
+        enable_categorical: bool = False,
         **kwargs,
     ):
         self.data = data
@@ -138,10 +180,14 @@ class _RayDMatrixLoader:
         self.qid = qid
         self.filetype = filetype
         self.ignore = ignore
+        self.enable_categorical = enable_categorical
         self.kwargs = kwargs
         self.data_source: Optional[type] = None
         self.actor_shards: Optional[Dict[int, List[Any]]] = None
         self._resolved_feature_names: Optional[List[str]] = None
+        self._resolved_feature_types: Optional[List[str]] = None
+        # col index -> category values, recorded when columns auto-encode
+        self._resolved_categories: Optional[Dict[int, tuple]] = None
 
     def get_data_source(self) -> type:
         if self.data_source is not None:
@@ -200,6 +246,49 @@ class _RayDMatrixLoader:
                 lu = None if lu is None else np.asarray(lu)[order]
 
         self._resolved_feature_names = self.feature_names or [str(c) for c in x.columns]
+
+        # categorical columns -> integer codes ('c' in the feature-type map).
+        # Encoding a column requires the global category set, so auto-encoding
+        # is a central-loading feature; distributed shards must arrive
+        # pre-encoded (pass feature_types=['c', ...] with numeric codes).
+        cat_cols = [
+            c
+            for c in x.columns
+            if isinstance(x[c].dtype, pd.CategoricalDtype)
+            or not pd.api.types.is_numeric_dtype(x[c].dtype)
+        ]
+        ftypes = list(self.feature_types) if self.feature_types else None
+        if cat_cols:
+            if not self.enable_categorical:
+                raise ValueError(
+                    f"DataFrame has categorical/object columns {cat_cols}; "
+                    f"pass enable_categorical=True (or encode them "
+                    f"numerically) — mirroring xgboost.DMatrix semantics."
+                )
+            if isinstance(self, _DistributedRayDMatrixLoader):
+                raise ValueError(
+                    "categorical columns cannot be auto-encoded under "
+                    "distributed loading (per-shard category sets would "
+                    "disagree); encode to integer codes and pass "
+                    "feature_types, or use central loading."
+                )
+            if ftypes is None:
+                ftypes = [
+                    "c" if c in cat_cols else "q" for c in x.columns
+                ]
+            x = x.copy()
+            categories: Dict[int, tuple] = {}
+            col_pos = {c: i for i, c in enumerate(x.columns)}
+            for c in cat_cols:
+                as_cat = x[c].astype("category")
+                categories[col_pos[c]] = tuple(as_cat.cat.categories.tolist())
+                codes = as_cat.cat.codes.astype(np.float32)
+                x[c] = codes.where(codes >= 0, np.nan)  # -1 == missing
+            self._resolved_categories = categories
+        elif self.enable_categorical and ftypes is None:
+            ftypes = ["q"] * len(x.columns)
+        self._resolved_feature_types = ftypes
+
         feats = x.to_numpy(dtype=np.float32, copy=False)
         if self.missing is not None and not np.isnan(self.missing):
             feats = np.where(feats == np.float32(self.missing), np.nan, feats)
@@ -348,10 +437,6 @@ class RayDMatrix:
             )
         if qid is not None and weight is not None:
             raise NotImplementedError("per-group weight is not implemented.")
-        if enable_categorical:
-            raise NotImplementedError(
-                "categorical features are not supported by tpu_hist yet."
-            )
         kwargs.pop("group", None)
 
         self._uid = uuid.uuid4().int
@@ -385,6 +470,7 @@ class RayDMatrix:
             qid=qid,
             filetype=filetype,
             ignore=ignore,
+            enable_categorical=bool(enable_categorical),
             **kwargs,
         )
 
@@ -503,6 +589,20 @@ class RayDMatrix:
     @property
     def resolved_feature_names(self) -> Optional[List[str]]:
         return self.feature_names or self.loader._resolved_feature_names
+
+    @property
+    def resolved_feature_types(self) -> Optional[List[str]]:
+        """Per-feature type map ('c' categorical / 'q' numeric), from the
+        user's feature_types or detected category-dtype columns."""
+        if self.feature_types:
+            return list(self.feature_types)
+        return self.loader._resolved_feature_types
+
+    @property
+    def resolved_categories(self) -> Optional[Dict[int, tuple]]:
+        """col index -> category values for auto-encoded columns (used to
+        align eval/predict frames with the training encoding)."""
+        return self.loader._resolved_categories
 
     @property
     def has_label(self) -> bool:

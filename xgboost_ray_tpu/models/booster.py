@@ -69,6 +69,9 @@ class RayXGBoostBooster:
         )
         self.feature_names = feature_names
         self.feature_types = feature_types
+        # col index -> category values for auto-encoded categorical columns;
+        # used to encode predict-time DataFrames with the TRAINING mapping
+        self.categories: Optional[Dict[int, tuple]] = None
         self.best_iteration: Optional[int] = None
         self.best_score: Optional[float] = None
         self._attributes: Dict[str, str] = {}
@@ -85,6 +88,13 @@ class RayXGBoostBooster:
     @property
     def num_outputs(self) -> int:
         return max(self.params.num_class, 1)
+
+    @property
+    def cat_features(self) -> tuple:
+        """Indices of categorical features ('c' in feature_types)."""
+        from xgboost_ray_tpu.params import cat_feature_indices
+
+        return cat_feature_indices(self.feature_types)
 
     @property
     def max_depth(self) -> int:
@@ -122,6 +132,30 @@ class RayXGBoostBooster:
                 cols = [c for c in self.feature_names if c in data.columns]
                 if len(cols) == len(self.feature_names):
                     data = data[self.feature_names]
+            non_numeric = [
+                c
+                for c in data.columns
+                if not pd.api.types.is_numeric_dtype(data[c].dtype)
+            ]
+            if non_numeric:
+                # category/string columns -> codes using the TRAINING
+                # category mapping (a frame's own category set can differ,
+                # which would silently re-route equality splits); unseen
+                # categories become NaN like xgboost
+                data = data.copy()
+                col_pos = {c: i for i, c in enumerate(data.columns)}
+                for c in non_numeric:
+                    cats = (self.categories or {}).get(col_pos[c])
+                    if cats is not None:
+                        codes = pd.Categorical(
+                            data[c], categories=list(cats)
+                        ).codes.astype(np.float32)
+                        codes = pd.Series(codes, index=data.index)
+                    else:
+                        codes = data[c].astype("category").cat.codes.astype(
+                            np.float32
+                        )
+                    data[c] = codes.where(codes >= 0, np.nan)
             data = data.to_numpy()
         x = np.asarray(data, dtype=np.float32)
         if x.ndim == 1:
@@ -144,6 +178,7 @@ class RayXGBoostBooster:
             tree_weights=None if self.tree_weights is None else self.tree_weights[sl],
         )
         out._has_node_stats = self._has_node_stats
+        out.categories = self.categories
         return out
 
     def base_score_margin_np(self) -> float:
@@ -182,6 +217,7 @@ class RayXGBoostBooster:
                 tree_weights=(
                     None if self.tree_weights is None else jnp.asarray(self.tree_weights)
                 ),
+                cat_features=self.cat_features,
             )
             out[lo:hi] = np.asarray(margin)
         return out
@@ -218,6 +254,7 @@ class RayXGBoostBooster:
                         if self.tree_weights is None
                         else jnp.asarray(self.tree_weights)
                     ),
+                    cat_features=self.cat_features,
                 )
             )
         out[:, :, -1] += m0
@@ -265,7 +302,10 @@ class RayXGBoostBooster:
         if pred_leaf:
             forest_dev = Tree(*[jnp.asarray(f) for f in self.forest])
             return np.asarray(
-                predict_ops.predict_leaf_index(forest_dev, jnp.asarray(x), self.max_depth)
+                predict_ops.predict_leaf_index(
+                    forest_dev, jnp.asarray(x), self.max_depth,
+                    cat_features=self.cat_features,
+                )
             )
         booster = self
         if iteration_range is not None and iteration_range != (0, 0):
@@ -306,6 +346,11 @@ class RayXGBoostBooster:
             "best_score": self.best_score,
             "attributes": self._attributes,
             "has_node_stats": self._has_node_stats,
+            "categories": (
+                None
+                if self.categories is None
+                else {str(k): list(v) for k, v in self.categories.items()}
+            ),
             "arrays_npz_b64": base64.b64encode(buf.getvalue()).decode("ascii"),
         }
 
@@ -339,6 +384,9 @@ class RayXGBoostBooster:
         out.best_score = d.get("best_score")
         out._attributes = dict(d.get("attributes") or {})
         out._has_node_stats = has_stats
+        cats = d.get("categories")
+        if cats is not None:
+            out.categories = {int(k): tuple(v) for k, v in cats.items()}
         return out
 
     def save_model(self, fname: str) -> None:

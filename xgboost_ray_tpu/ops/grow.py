@@ -41,6 +41,18 @@ SALT_BYLEVEL = 0x51D3
 SALT_BYNODE = 0x51D4
 
 
+def cat_mask_const(cat_features: tuple, num_features: int):
+    """[F] bool compile-time constant marking categorical features (None when
+    there are none) — single source for every walk/build/sketch site."""
+    if not cat_features:
+        return None
+    return (
+        jnp.zeros((num_features,), bool)
+        .at[jnp.asarray(cat_features, jnp.int32)]
+        .set(True)
+    )
+
+
 def sample_feature_mask(
     key: jnp.ndarray,
     n_features: int,
@@ -84,6 +96,10 @@ class GrowConfig:
     # halves the built/allreduced histogram tensor at every level >= 1, and
     # halves the one-hot matmul FLOPs for the onehot path.
     sibling_subtract: bool = True
+    # indices of categorical features (bins are category codes; splits are
+    # one-vs-rest partitions routed by equality). Static tuple so it can ride
+    # inside this hashable jit-static config.
+    cat_features: tuple = ()
 
     @property
     def heap_size(self) -> int:
@@ -139,6 +155,8 @@ def build_tree(
     nbt = cfg.max_bin + 1
     lr = cfg.split.learning_rate
     missing_bin = cfg.max_bin
+
+    cat_mask = cat_mask_const(cfg.cat_features, num_features)
 
     tree = empty_tree(cfg.heap_size)
     pos = jnp.zeros((n,), jnp.int32)
@@ -220,7 +238,8 @@ def build_tree(
             )
             fmask = nmask if fmask is None else (nmask & fmask[None, :])
 
-        sp = find_splits(hist, node_gh, cfg.split, feature_mask=fmask)
+        sp = find_splits(hist, node_gh, cfg.split, feature_mask=fmask,
+                         cat_mask=cat_mask)
         valid_split = sp.valid & active
         node_value = lr * leaf_weight(node_gh[:, 0], node_gh[:, 1], cfg.split)
         is_new_leaf = active & ~valid_split
@@ -248,9 +267,13 @@ def build_tree(
 
         f_of_row = fsafe[pos]
         b = jnp.take_along_axis(bins.astype(jnp.int32), f_of_row[:, None], axis=1)[:, 0]
-        go_right = jnp.where(
-            b == missing_bin, ~sp.default_left[pos], b > sp.split_bin[pos]
-        )
+        present_right = b > sp.split_bin[pos]
+        if cat_mask is not None:
+            # categorical routing: the candidate category goes left
+            present_right = jnp.where(
+                cat_mask[f_of_row], b != sp.split_bin[pos], present_right
+            )
+        go_right = jnp.where(b == missing_bin, ~sp.default_left[pos], present_right)
         effective_right = jnp.where(done, False, go_right)
         pos = pos * 2 + effective_right.astype(jnp.int32)
         active = jnp.repeat(valid_split, 2)
@@ -276,7 +299,8 @@ def build_tree(
 
 
 def predict_tree_binned(
-    tree: Tree, bins: jnp.ndarray, max_depth: int, missing_bin: int
+    tree: Tree, bins: jnp.ndarray, max_depth: int, missing_bin: int,
+    cat_features: tuple = (),
 ) -> jnp.ndarray:
     """Walk one tree over pre-binned rows; returns leaf value per row [N].
 
@@ -286,11 +310,17 @@ def predict_tree_binned(
     n, num_features = bins.shape
     idx = jnp.zeros((n,), jnp.int32)
     b32 = bins.astype(jnp.int32)
+    cat_mask = cat_mask_const(cat_features, num_features)
     for _ in range(max_depth):
         f = jnp.clip(tree.feature[idx], 0, num_features - 1)
         bv = jnp.take_along_axis(b32, f[:, None], axis=1)[:, 0]
+        present_right = bv > tree.split_bin[idx]
+        if cat_mask is not None:
+            present_right = jnp.where(
+                cat_mask[f], bv != tree.split_bin[idx], present_right
+            )
         go_right = jnp.where(
-            bv == missing_bin, ~tree.default_left[idx], bv > tree.split_bin[idx]
+            bv == missing_bin, ~tree.default_left[idx], present_right
         )
         nxt = 2 * idx + 1 + go_right.astype(jnp.int32)
         idx = jnp.where(tree.is_leaf[idx], idx, nxt)

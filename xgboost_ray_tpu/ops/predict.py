@@ -17,24 +17,38 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from xgboost_ray_tpu.ops.grow import Tree
+from xgboost_ray_tpu.ops.grow import Tree, cat_mask_const as _cat_mask_const
 
 
-def _walk_one_tree(tree: Tree, x: jnp.ndarray, max_depth: int) -> jnp.ndarray:
+def _step_right(tree, idx, xv, f, cat_mask):
+    """Routing rule shared by every raw-x walk: numeric = threshold compare,
+    categorical = code equality (candidate category goes left), missing =
+    learned default."""
+    present_right = xv >= tree.threshold[idx]
+    if cat_mask is not None:
+        code = jnp.round(xv).astype(jnp.int32)
+        present_right = jnp.where(
+            cat_mask[f], code != tree.split_bin[idx], present_right
+        )
+    return jnp.where(jnp.isnan(xv), ~tree.default_left[idx], present_right)
+
+
+def _walk_one_tree(
+    tree: Tree, x: jnp.ndarray, max_depth: int, cat_mask=None
+) -> jnp.ndarray:
     """x: [N, F] raw (may contain NaN). Returns leaf values [N]."""
     n, num_features = x.shape
     idx = jnp.zeros((n,), jnp.int32)
     for _ in range(max_depth):
         f = jnp.clip(tree.feature[idx], 0, num_features - 1)
         xv = jnp.take_along_axis(x, f[:, None], axis=1)[:, 0]
-        # rule: go left iff x < threshold; missing follows learned default
-        go_right = jnp.where(jnp.isnan(xv), ~tree.default_left[idx], xv >= tree.threshold[idx])
+        go_right = _step_right(tree, idx, xv, f, cat_mask)
         nxt = 2 * idx + 1 + go_right.astype(jnp.int32)
         idx = jnp.where(tree.is_leaf[idx], idx, nxt)
     return tree.value[idx]
 
 
-@functools.partial(jax.jit, static_argnames=("max_depth", "num_outputs", "num_parallel_tree", "ntree_limit"))
+@functools.partial(jax.jit, static_argnames=("max_depth", "num_outputs", "num_parallel_tree", "ntree_limit", "cat_features"))
 def predict_margin(
     forest: Tree,  # stacked trees: each field [T, heap]
     x: jnp.ndarray,  # [N, F] float32 raw features
@@ -44,10 +58,12 @@ def predict_margin(
     num_parallel_tree: int = 1,
     ntree_limit: int = 0,
     tree_weights: Optional[jnp.ndarray] = None,  # [T] per-tree scale (DART)
+    cat_features: tuple = (),
 ) -> jnp.ndarray:
     """Sum leaf values of all trees into per-class margins. Returns [N, K]."""
     t = forest.feature.shape[0]
-    leaf = jax.vmap(lambda tr: _walk_one_tree(tr, x, max_depth))(forest)  # [T, N]
+    cat_mask = _cat_mask_const(cat_features, x.shape[1])
+    leaf = jax.vmap(lambda tr: _walk_one_tree(tr, x, max_depth, cat_mask))(forest)  # [T, N]
     if tree_weights is not None:
         leaf = leaf * tree_weights[:, None]
     if ntree_limit:
@@ -62,7 +78,7 @@ def predict_margin(
     return base_margin + (leaf.T @ onehot) / num_parallel_tree
 
 
-@functools.partial(jax.jit, static_argnames=("max_depth", "num_outputs", "num_parallel_tree", "ntree_limit"))
+@functools.partial(jax.jit, static_argnames=("max_depth", "num_outputs", "num_parallel_tree", "ntree_limit", "cat_features"))
 def predict_contribs(
     forest: Tree,  # stacked trees: each field [T, heap]
     x: jnp.ndarray,  # [N, F] float32 raw features
@@ -71,6 +87,7 @@ def predict_contribs(
     num_parallel_tree: int = 1,
     ntree_limit: int = 0,
     tree_weights: Optional[jnp.ndarray] = None,
+    cat_features: tuple = (),
 ) -> jnp.ndarray:
     """Per-feature prediction contributions (xgboost ``pred_contribs`` with
     ``approx_contribs=True`` — Saabas path attribution; reference surface:
@@ -89,6 +106,7 @@ def predict_contribs(
     """
     n, num_features = x.shape
     t = forest.feature.shape[0]
+    cat_mask = _cat_mask_const(cat_features, num_features)
 
     scale = jnp.ones((t,), jnp.float32)
     if tree_weights is not None:
@@ -108,9 +126,7 @@ def predict_contribs(
             stepped = ~tree.is_leaf[idx] & (tree.feature[idx] >= 0)
             f = jnp.clip(tree.feature[idx], 0, num_features - 1)
             xv = jnp.take_along_axis(x, f[:, None], axis=1)[:, 0]
-            go_right = jnp.where(
-                jnp.isnan(xv), ~tree.default_left[idx], xv >= tree.threshold[idx]
-            )
+            go_right = _step_right(tree, idx, xv, f, cat_mask)
             nxt = jnp.where(stepped, 2 * idx + 1 + go_right.astype(jnp.int32), idx)
             delta = jnp.where(
                 stepped, tree.base_weight[nxt] - tree.base_weight[idx], 0.0
@@ -131,19 +147,18 @@ def predict_contribs(
 
 
 def predict_leaf_index(
-    forest: Tree, x: jnp.ndarray, max_depth: int
+    forest: Tree, x: jnp.ndarray, max_depth: int, cat_features: tuple = ()
 ) -> jnp.ndarray:
     """Per-tree leaf heap index for each row (xgboost pred_leaf analog). [N, T]."""
     n, num_features = x.shape
+    cat_mask = _cat_mask_const(cat_features, num_features)
 
     def walk(tree):
         idx = jnp.zeros((n,), jnp.int32)
         for _ in range(max_depth):
             f = jnp.clip(tree.feature[idx], 0, num_features - 1)
             xv = jnp.take_along_axis(x, f[:, None], axis=1)[:, 0]
-            go_right = jnp.where(
-                jnp.isnan(xv), ~tree.default_left[idx], xv >= tree.threshold[idx]
-            )
+            go_right = _step_right(tree, idx, xv, f, cat_mask)
             nxt = 2 * idx + 1 + go_right.astype(jnp.int32)
             idx = jnp.where(tree.is_leaf[idx], idx, nxt)
         return idx

@@ -66,7 +66,13 @@ def find_splits(
     node_gh: jnp.ndarray,  # [n_nodes, 2] parent totals (includes missing)
     p: SplitParams,
     feature_mask: jnp.ndarray = None,  # [F] bool; False = column sampled out
+    cat_mask: jnp.ndarray = None,  # [F] bool; True = categorical feature
 ) -> LevelSplits:
+    """For numeric features, candidate s means "bins <= s go left" (prefix
+    scan). For categorical features (``cat_mask``), candidate s means the
+    one-vs-rest partition "category s goes left" — bins ARE category codes,
+    so the left child stats are a single histogram slot (xgboost's one-hot
+    categorical splits behind ``enable_categorical``)."""
     n_nodes, num_features, nbt, _ = hist.shape
     n_bins = nbt - 1
     g = hist[..., 0]  # [n, F, nbt]
@@ -75,6 +81,11 @@ def find_splits(
     # cumulative over present bins; candidate s in 0..n_bins-2 (split after bin s)
     gl = jnp.cumsum(g[..., :n_bins], axis=-1)[..., : n_bins - 1]  # [n, F, B-1]
     hl = jnp.cumsum(h[..., :n_bins], axis=-1)[..., : n_bins - 1]
+    if cat_mask is not None:
+        # one-vs-rest: left child = the single candidate category's slot
+        cm = cat_mask[None, :, None]
+        gl = jnp.where(cm, g[..., : n_bins - 1], gl)
+        hl = jnp.where(cm, h[..., : n_bins - 1], hl)
     gp = node_gh[:, 0][:, None, None]
     hp = node_gh[:, 1][:, None, None]
     parent_score = score(node_gh[:, 0], node_gh[:, 1], p)[:, None, None]

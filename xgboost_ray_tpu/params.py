@@ -85,6 +85,15 @@ class TrainParams:
     sibling_subtract: bool = True
 
 
+def cat_feature_indices(feature_types: Optional[Sequence[Any]]) -> tuple:
+    """Indices marked categorical ('c') in an xgboost feature_types list."""
+    return tuple(
+        i
+        for i, t in enumerate(feature_types or [])
+        if str(t).lower() in ("c", "categorical")
+    )
+
+
 def parse_params(params: Optional[Dict[str, Any]]) -> TrainParams:
     params = dict(params or {})
     out = TrainParams()

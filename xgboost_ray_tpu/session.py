@@ -29,21 +29,25 @@ class RayXGBoostSession:
         self._queue = queue
 
 
-_session: Optional[RayXGBoostSession] = None
+# thread-local so concurrent tune trials (Tuner max_concurrent_trials > 1,
+# one training per thread) do not cross-wire each other's driver queues
+import threading as _threading
+
+_session_tls = _threading.local()
 
 
 def init_session(rank: int = 0, queue: Optional[Any] = None):
-    global _session
-    _session = RayXGBoostSession(rank, queue)
+    _session_tls.value = RayXGBoostSession(rank, queue)
 
 
 def get_session() -> RayXGBoostSession:
-    if _session is None:
+    session = getattr(_session_tls, "value", None)
+    if session is None:
         raise ValueError(
             "`get_session()` was called outside an initialized session. "
             "Only call this from within xgboost_ray_tpu training callbacks."
         )
-    return _session
+    return session
 
 
 def set_session_queue(queue: Any):

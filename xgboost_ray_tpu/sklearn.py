@@ -119,6 +119,8 @@ class RayXGBMixin:
             if val is not None:
                 params[name] = val
         for name in getattr(self, "_extra_xgb_params", ()):
+            if name in ("enable_categorical", "feature_types"):
+                continue  # DMatrix-construction args, not training params
             val = getattr(self, name, None)
             if val is not None:
                 params[name] = val
@@ -150,6 +152,12 @@ class RayXGBMixin:
         missing = getattr(self, "missing", None)
         if missing is not None and not (isinstance(missing, float) and np.isnan(missing)):
             dm_params.setdefault("missing", missing)
+        # estimator-level categorical knobs are DMatrix construction args
+        # (reference sklearn.py:404-407 passes enable_categorical through)
+        if getattr(self, "enable_categorical", False):
+            dm_params.setdefault("enable_categorical", True)
+        if getattr(self, "feature_types", None) is not None:
+            dm_params.setdefault("feature_types", self.feature_types)
         train_dmatrix = RayDMatrix(
             X, label=y, weight=sample_weight, base_margin=base_margin,
             qid=qid, feature_weights=feature_weights, **dm_params,
@@ -257,6 +265,10 @@ class RayXGBMixin:
             data = X
         else:
             dm_params = dict(ray_dmatrix_params or {})
+            if getattr(self, "enable_categorical", False):
+                dm_params.setdefault("enable_categorical", True)
+            if getattr(self, "feature_types", None) is not None:
+                dm_params.setdefault("feature_types", self.feature_types)
             data = RayDMatrix(X, base_margin=base_margin, **dm_params)
         return ray_predict(
             booster, data, ray_params=self._get_ray_params(ray_params),

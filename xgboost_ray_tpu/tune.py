@@ -34,17 +34,27 @@ except Exception:
 
 
 # --- standalone tuning session ---------------------------------------------
+#
+# Thread-local so the Tuner can run trials concurrently (task parallelism
+# across trials, SURVEY §2.3): each trial thread owns its session and,
+# optionally, its own slice of the device mesh.
 
-_session: Optional["TuneSession"] = None
+import threading as _threading
+
+_session_tls = _threading.local()
 
 
 class TuneSession:
     """Trial-side context collecting reported results and checkpoints."""
 
-    def __init__(self, trial_dir: Optional[str] = None):
+    def __init__(self, trial_dir: Optional[str] = None, devices=None):
         self.trial_dir = trial_dir or tempfile.mkdtemp(prefix="rxgb_trial_")
         self.results: List[Dict[str, Any]] = []
         self.last_checkpoint_path: Optional[str] = None
+        # device subset this trial trains on (None = all local devices);
+        # the driver hands it to TpuEngine so concurrent trials map onto
+        # disjoint mesh slices
+        self.devices = list(devices) if devices is not None else None
 
     def report(self, metrics: Dict[str, Any], checkpoint_path: Optional[str] = None):
         self.results.append(dict(metrics))
@@ -52,24 +62,22 @@ class TuneSession:
             self.last_checkpoint_path = checkpoint_path
 
 
-def init_session(trial_dir: Optional[str] = None) -> TuneSession:
-    global _session
-    _session = TuneSession(trial_dir)
-    return _session
+def init_session(trial_dir: Optional[str] = None, devices=None) -> TuneSession:
+    _session_tls.value = TuneSession(trial_dir, devices=devices)
+    return _session_tls.value
 
 
 def shutdown_session():
-    global _session
-    _session = None
+    _session_tls.value = None
 
 
 def get_session() -> Optional[TuneSession]:
-    return _session
+    return getattr(_session_tls, "value", None)
 
 
 def is_session_enabled() -> bool:
     """Are we inside a tuning trial? (mirror of ``tune.py:61-64``)."""
-    if _session is not None:
+    if get_session() is not None:
         return True
     if RAY_TUNE_INSTALLED:  # pragma: no cover
         try:

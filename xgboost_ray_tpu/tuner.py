@@ -149,6 +149,7 @@ class Tuner:
         seed: int = 0,
         experiment_dir: Optional[str] = None,
         raise_on_failed_trial: bool = False,
+        max_concurrent_trials: int = 1,
     ):
         if mode not in ("min", "max"):
             raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
@@ -160,33 +161,66 @@ class Tuner:
         self.seed = seed
         self.experiment_dir = experiment_dir or tempfile.mkdtemp(prefix="rxgb_exp_")
         self.raise_on_failed_trial = raise_on_failed_trial
+        self.max_concurrent_trials = max(1, int(max_concurrent_trials))
+
+    def _run_trial(self, i: int, config: Dict[str, Any], devices=None) -> Trial:
+        trial_id = f"trial_{i:05d}"
+        trial_dir = os.path.join(self.experiment_dir, trial_id)
+        os.makedirs(trial_dir, exist_ok=True)
+        trial = Trial(trial_id=trial_id, config=config, trial_dir=trial_dir)
+        session = tune_mod.init_session(trial_dir, devices=devices)
+        try:
+            self.trainable(config)
+            trial.results = session.results
+            trial.last_result = session.results[-1] if session.results else None
+            trial.checkpoint_path = session.last_checkpoint_path
+        except Exception as exc:  # noqa: BLE001 - trial isolation
+            trial.error = f"{type(exc).__name__}: {exc}"
+            logger.warning(f"[Tuner] {trial_id} failed: {trial.error}")
+            if self.raise_on_failed_trial:
+                tune_mod.shutdown_session()
+                raise
+        finally:
+            tune_mod.shutdown_session()
+        if trial.last_result and self.metric and self.metric in trial.last_result:
+            logger.info(
+                f"[Tuner] {trial_id} {self.metric}="
+                f"{trial.last_result[self.metric]:.5f} config={config}"
+            )
+        return trial
 
     def fit(self) -> ExperimentResult:
+        """Run all trials. With ``max_concurrent_trials > 1``, trials run in
+        a thread pool and the local device mesh is partitioned into disjoint
+        contiguous slices, one per concurrent slot — the single-host analog of
+        trials-on-separate-TPU-slices task parallelism (SURVEY §2.3; the
+        reference gets this from Ray Tune's scheduler, ``tune.py:107-126``)."""
         configs = _expand_space(self.param_space, self.num_samples, self.seed)
-        trials: List[Trial] = []
-        for i, config in enumerate(configs):
-            trial_id = f"trial_{i:05d}"
-            trial_dir = os.path.join(self.experiment_dir, trial_id)
-            os.makedirs(trial_dir, exist_ok=True)
-            trial = Trial(trial_id=trial_id, config=config, trial_dir=trial_dir)
-            session = tune_mod.init_session(trial_dir)
+        if self.max_concurrent_trials == 1:
+            trials = [self._run_trial(i, c) for i, c in enumerate(configs)]
+            return ExperimentResult(trials=trials, metric=self.metric, mode=self.mode)
+
+        import queue as queue_mod
+        from concurrent.futures import ThreadPoolExecutor
+
+        import jax
+
+        devs = jax.devices()
+        n_slots = min(self.max_concurrent_trials, max(1, len(devs)))
+        per = max(1, len(devs) // n_slots)
+        slot_devices = [devs[j * per : (j + 1) * per] for j in range(n_slots)]
+        slots: "queue_mod.Queue" = queue_mod.Queue()
+        for s in slot_devices:
+            slots.put(s)
+
+        def run(i_config):
+            i, config = i_config
+            devices = slots.get()
             try:
-                self.trainable(config)
-                trial.results = session.results
-                trial.last_result = session.results[-1] if session.results else None
-                trial.checkpoint_path = session.last_checkpoint_path
-            except Exception as exc:  # noqa: BLE001 - trial isolation
-                trial.error = f"{type(exc).__name__}: {exc}"
-                logger.warning(f"[Tuner] {trial_id} failed: {trial.error}")
-                if self.raise_on_failed_trial:
-                    tune_mod.shutdown_session()
-                    raise
+                return self._run_trial(i, config, devices=devices)
             finally:
-                tune_mod.shutdown_session()
-            trials.append(trial)
-            if trial.last_result and self.metric and self.metric in trial.last_result:
-                logger.info(
-                    f"[Tuner] {trial_id} {self.metric}="
-                    f"{trial.last_result[self.metric]:.5f} config={config}"
-                )
+                slots.put(devices)
+
+        with ThreadPoolExecutor(max_workers=n_slots) as pool:
+            trials = list(pool.map(run, enumerate(configs)))
         return ExperimentResult(trials=trials, metric=self.metric, mode=self.mode)
