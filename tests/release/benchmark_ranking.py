@@ -1,0 +1,93 @@
+"""MSLR-WEB30K-protocol ranking benchmark (BASELINE.md target 4).
+
+The real MSLR dataset is not downloadable in this zero-egress image, so the
+data is MSLR-shaped: ``--groups`` queries of ~``--group-size`` docs (uneven,
+truncated-normal sizes) x ``--features`` features with graded relevance 0-4
+correlated to a few informative columns. Wall-clock is shape-bound
+(per-group pairwise lambdas + per-level histograms), so timings are
+protocol-comparable with the reference's RayXGBRanker runs.
+
+Reports per-round wall clock and final NDCG@10 in the reference's res.csv
+format (``benchmark_cpu_gpu.py:178-197``).
+
+Usage:
+    python benchmark_ranking.py 8 100                 # workers, rounds
+    python benchmark_ranking.py 2 10 --smoke-test
+"""
+
+import argparse
+import csv
+import os
+import time
+
+import numpy as np
+
+
+def make_mslr_like(n_groups: int, group_size: int, n_features: int, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    sizes = np.clip(
+        rng.normal(group_size, group_size / 4, n_groups).astype(int), 4, None
+    )
+    n = int(sizes.sum())
+    qid = np.repeat(np.arange(n_groups), sizes)
+    x = rng.randn(n, n_features).astype(np.float32)
+    score = 1.2 * x[:, 0] - 0.8 * x[:, 1] + 0.5 * x[:, 2] + rng.randn(n) * 0.7
+    rel = np.clip(np.digitize(score, [-1.5, -0.3, 0.7, 1.8]), 0, 4)
+    return x, rel.astype(np.float32), qid
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("num_workers", type=int, nargs="?", default=8)
+    parser.add_argument("num_rounds", type=int, nargs="?", default=100)
+    parser.add_argument("--groups", type=int, default=30_000)
+    parser.add_argument("--group-size", type=int, default=120)
+    parser.add_argument("--features", type=int, default=136)
+    parser.add_argument("--smoke-test", action="store_true")
+    args = parser.parse_args()
+
+    if args.smoke_test:
+        args.groups = min(args.groups, 500)
+        args.group_size = min(args.group_size, 20)
+        args.features = min(args.features, 16)
+
+    from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+    t0 = time.time()
+    x, rel, qid = make_mslr_like(args.groups, args.group_size, args.features)
+    print(f"data: {x.shape[0]} docs / {args.groups} queries "
+          f"({time.time() - t0:.1f}s)")
+
+    dtrain = RayDMatrix(x, rel, qid=qid)
+    evals_result = {}
+    train_start = time.time()
+    bst = train(
+        {"objective": "rank:ndcg", "eval_metric": ["ndcg@10"],
+         "max_depth": 8, "eta": 0.1},
+        dtrain,
+        num_boost_round=args.num_rounds,
+        evals=[(dtrain, "train")],
+        evals_result=evals_result,
+        verbose_eval=False,
+        ray_params=RayParams(num_actors=args.num_workers,
+                             checkpoint_frequency=0),
+    )
+    train_time = time.time() - train_start
+    ndcg10 = evals_result["train"]["ndcg@10"][-1]
+    assert bst.num_boosted_rounds() == args.num_rounds
+
+    print(f"TRAIN TIME TAKEN: {train_time:.2f} seconds "
+          f"({train_time / args.num_rounds * 1e3:.0f} ms/round)")
+    print(f"Final NDCG@10: {ndcg10:.4f}")
+
+    out = os.path.join(os.path.dirname(__file__), "res_ranking.csv")
+    with open(out, "at") as fp:
+        writer = csv.writer(fp)
+        writer.writerow([
+            time.time(), args.num_workers, args.num_rounds, args.groups,
+            args.group_size, args.features, train_time, ndcg10,
+        ])
+
+
+if __name__ == "__main__":
+    main()

@@ -294,6 +294,62 @@ print("PALLAS_OK")
     assert "PALLAS_OK" in result.stdout, result.stderr[-2000:]
 
 
+def test_hist_pallas_presorted_interpret_matches_scatter():
+    """The presorted variant (fed from update_partition_order's maintained
+    row order, no internal argsort) must match hist_scatter bit-for-bit in
+    interpret mode. Subprocess for the same platform-registration reason."""
+    import subprocess
+    import sys
+
+    code = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np, jax.numpy as jnp
+from xgboost_ray_tpu.ops.histogram import hist_scatter
+from xgboost_ray_tpu.ops.hist_pallas import PALLAS_AVAILABLE, hist_pallas_presorted
+assert PALLAS_AVAILABLE
+rng = np.random.RandomState(12)
+n, f, nb, n_nodes = 300, 4, 8, 4
+bins = rng.randint(0, nb + 1, size=(n, f)).astype(np.uint8)
+gh = rng.randn(n, 2).astype(np.float32)
+pos = rng.randint(0, n_nodes, size=n).astype(np.int32)
+order = np.argsort(pos, kind="stable").astype(np.int32)
+counts = np.bincount(pos, minlength=n_nodes)
+ref = np.asarray(hist_scatter(jnp.asarray(bins), jnp.asarray(gh),
+                              jnp.asarray(pos), n_nodes, nb + 1))
+out = np.asarray(hist_pallas_presorted(
+    jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(order),
+    jnp.asarray(counts), n_nodes, nb + 1, block=64, interpret=True))
+np.testing.assert_allclose(out, ref, atol=1e-4)
+print("PALLAS_PRESORTED_OK")
+"""
+    result = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert "PALLAS_PRESORTED_OK" in result.stdout, result.stderr[-2000:]
+
+
+def test_pallas_impl_builds_tree_on_cpu_fallback():
+    """hist_impl='pallas' must train on CPU via the identical-layout XLA
+    fallback (the kernel itself only lowers on accelerators)."""
+    rng = np.random.RandomState(14)
+    x = rng.randn(500, 4).astype(np.float32)
+    g = rng.randn(500).astype(np.float32)
+    h = np.ones(500, np.float32)
+    cuts = binning.sketch_cuts_np(x, max_bin=16)
+    bins = binning.bin_matrix_np(x, cuts, max_bin=16)
+    gh = jnp.asarray(np.stack([g, h], 1))
+    outs = {}
+    for impl in ("scatter", "pallas"):
+        cfg = GrowConfig(max_depth=4, max_bin=16,
+                         split=SplitParams(learning_rate=1.0), hist_impl=impl)
+        tree, rv = build_tree(jnp.asarray(bins), gh, jnp.asarray(cuts), cfg)
+        outs[impl] = (np.asarray(tree.feature), np.asarray(rv))
+    np.testing.assert_array_equal(outs["pallas"][0], outs["scatter"][0])
+    np.testing.assert_allclose(outs["pallas"][1], outs["scatter"][1], atol=1e-4)
+
+
 def test_build_tree_impls_produce_identical_trees():
     """scatter / partition (incremental ordering) / mixed must grow the exact
     same tree — the partition path's O(N) order maintenance is pure layout."""

@@ -516,6 +516,14 @@ def _train(
         else:
             eshards = [a.get_shard(deval) for a in alive]
             ecats = deval.resolved_categories
+            if ecats and not train_cats:
+                raise ValueError(
+                    f"eval set {name!r} auto-encoded categorical columns, but "
+                    f"the training matrix was built from integer codes — the "
+                    f"mappings cannot be aligned. Encode the eval set with "
+                    f"the same codes, or train from a DataFrame with "
+                    f"enable_categorical=True."
+                )
             if train_cats and ecats != train_cats:
                 # align auto-encoded category codes with the training mapping
                 eshards = [
@@ -1083,6 +1091,12 @@ def _predict(
     predict_kwargs = dict(kwargs)
     predict_kwargs.setdefault("validate_features", False)
     model_cats = getattr(model, "categories", None)
+    if data.resolved_categories and not model_cats and model.cat_features:
+        raise ValueError(
+            "the prediction data auto-encoded categorical columns, but the "
+            "model was trained on integer codes — the mappings cannot be "
+            "aligned. Encode the data with the training codes instead."
+        )
     results = []
     for actor in actors:
         shard = actor.get_shard(data)

@@ -133,7 +133,9 @@ def translate_shard_categories(
     (the training matrix's): frames with different category sets would
     otherwise assign different codes to the same value and be routed down
     wrong branches."""
-    if not to_cats or from_cats == to_cats:
+    if not from_cats or not to_cats or from_cats == to_cats:
+        # nothing auto-encoded on the source side -> codes are already in the
+        # caller's mapping; avoid a pointless full copy
         return shard
     data = np.array(shard["data"], copy=True)
     for col, cats in (from_cats or {}).items():

@@ -151,6 +151,13 @@ class RayXGBoostBooster:
                             data[c], categories=list(cats)
                         ).codes.astype(np.float32)
                         codes = pd.Series(codes, index=data.index)
+                    elif col_pos[c] in self.cat_features:
+                        raise ValueError(
+                            f"column {c!r} is categorical in the model but no "
+                            f"category mapping was recorded (the model was "
+                            f"trained on integer codes); pass codes encoded "
+                            f"the same way as training."
+                        )
                     else:
                         codes = data[c].astype("category").cat.codes.astype(
                             np.float32

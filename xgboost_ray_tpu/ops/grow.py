@@ -166,7 +166,7 @@ def build_tree(
 
     # partition-based impls keep rows sorted by node across levels with an
     # O(N) stable segment split (no per-level argsort)
-    track_order = cfg.hist_impl in ("partition", "mixed")
+    track_order = cfg.hist_impl in ("partition", "mixed", "pallas")
     order = counts = None
     if track_order:
         order = jnp.arange(n, dtype=jnp.int32)
@@ -179,6 +179,21 @@ def build_tree(
 
         def _build(gh_b, pos_b, order_b, counts_b, nn):
             """One histogram build over nn node slots with the configured impl."""
+            if cfg.hist_impl == "pallas":
+                use_pallas = False
+                try:
+                    from xgboost_ray_tpu.ops import hist_pallas as hp
+
+                    # the kernel is TPU-only (pltpu grid spec); other backends
+                    # fall back to the identical-layout XLA einsum formulation
+                    use_pallas = hp.PALLAS_AVAILABLE and jax.default_backend() == "tpu"
+                except Exception:
+                    pass
+                if use_pallas:
+                    return hp.hist_pallas_presorted(
+                        bins, gh_b, order_b, counts_b, nn, nbt
+                    )
+                return hist_partition_presorted(bins, gh_b, order_b, counts_b, nn, nbt)
             if track_order and (cfg.hist_impl == "partition" or nn > 4):
                 return hist_partition_presorted(bins, gh_b, order_b, counts_b, nn, nbt)
             if cfg.hist_impl == "mixed":

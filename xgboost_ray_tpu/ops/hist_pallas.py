@@ -87,6 +87,31 @@ def hist_pallas_blocks(
     )(node_of_block, out_init, bp, ghp)
 
 
+def hist_pallas_presorted(
+    bins: jnp.ndarray,
+    gh: jnp.ndarray,
+    order: jnp.ndarray,  # [N] rows sorted stably by node (maintained O(N))
+    counts: jnp.ndarray,  # [n_nodes] rows per node
+    n_nodes: int,
+    n_bins_total: int,
+    block: int = 256,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas block kernel fed from the incrementally-maintained row order
+    (``histogram.update_partition_order``) — skips ``hist_pallas``'s internal
+    argsort, the same presorted trick ``hist_partition_presorted`` uses.
+    """
+    from xgboost_ray_tpu.ops.histogram import presorted_block_layout
+
+    bp, ghp, node_of_block = presorted_block_layout(
+        bins, gh, order, counts, n_nodes, block
+    )
+    hist = hist_pallas_blocks(
+        bp, ghp, node_of_block, n_nodes, n_bins_total, interpret=interpret
+    )
+    return hist[:n_nodes]
+
+
 def hist_pallas(
     bins: jnp.ndarray,
     gh: jnp.ndarray,

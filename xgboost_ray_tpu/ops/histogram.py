@@ -136,18 +136,20 @@ def update_partition_order(
     return new_order, new_counts
 
 
-def hist_partition_presorted(
+def presorted_block_layout(
     bins: jnp.ndarray,
     gh: jnp.ndarray,
     order: jnp.ndarray,  # [N] rows sorted stably by node
     counts: jnp.ndarray,  # [n_nodes]
     n_nodes: int,
-    n_bins_total: int,
-    block: int = 256,
-    block_chunk: int = 512,
-) -> jnp.ndarray:
-    """hist_partition with the sort/bincount already maintained by the caller
-    (see ``update_partition_order``)."""
+    block: int,
+):
+    """Scatter presorted rows into node-uniform padded blocks.
+
+    Returns (bp [n_blocks, block, F], ghp [n_blocks, block, 2],
+    node_of_block [n_blocks]); padding slots carry zero gh. Shared by the XLA
+    blocked-einsum path and the Pallas kernel so the layout math has one
+    home."""
     n, num_features = bins.shape
     b32 = bins.astype(jnp.int32)
     seg_start = jnp.concatenate(
@@ -169,11 +171,30 @@ def hist_partition_presorted(
         jnp.searchsorted(padded_cum, jnp.arange(n_blocks) * block, side="right"),
         0,
         n_nodes,
-    )
+    ).astype(jnp.int32)
     bins_ext = jnp.concatenate([b32, jnp.zeros((1, num_features), jnp.int32)])
     gh_ext = jnp.concatenate([gh, jnp.zeros((1, 2), gh.dtype)])
     bp = bins_ext[row_of_slot].reshape(n_blocks, block, num_features)
     ghp = gh_ext[row_of_slot].reshape(n_blocks, block, 2)
+    return bp, ghp, node_of_block
+
+
+def hist_partition_presorted(
+    bins: jnp.ndarray,
+    gh: jnp.ndarray,
+    order: jnp.ndarray,  # [N] rows sorted stably by node
+    counts: jnp.ndarray,  # [n_nodes]
+    n_nodes: int,
+    n_bins_total: int,
+    block: int = 256,
+    block_chunk: int = 512,
+) -> jnp.ndarray:
+    """hist_partition with the sort/bincount already maintained by the caller
+    (see ``update_partition_order``)."""
+    num_features = bins.shape[1]
+    bp, ghp, node_of_block = presorted_block_layout(
+        bins, gh, order, counts, n_nodes, block
+    )
     return _blocked_hist(
         bp, ghp, node_of_block, n_nodes, n_bins_total, num_features, block_chunk
     )
