@@ -429,3 +429,74 @@ def test_update_partition_order_maintains_sorted_invariant():
         for node in np.unique(pos):
             rows = o[pos[o] == node]
             assert np.all(np.diff(rows) > 0) or len(rows) <= 1
+
+
+def test_new_objectives_train_and_improve():
+    """binary:hinge / reg:squaredlogerror / reg:pseudohubererror train
+    end-to-end and their default metrics improve."""
+    from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+    rng = np.random.RandomState(30)
+    x = rng.randn(400, 4).astype(np.float32)
+    yb = (x[:, 0] > 0).astype(np.float32)
+    ypos = np.exp(x[:, 0] * 0.5 + 0.1 * rng.randn(400)).astype(np.float32)
+    yreg = (2.0 * x[:, 0] + rng.randn(400) * 0.3).astype(np.float32)
+    cases = [
+        ("binary:hinge", yb, "error"),
+        ("reg:squaredlogerror", ypos, "rmsle"),
+        ("reg:pseudohubererror", yreg, "mphe"),
+    ]
+    for objective, y, metric in cases:
+        er = {}
+        bst = train({"objective": objective, "eval_metric": [metric]},
+                    RayDMatrix(x, y), 10,
+                    evals=[(RayDMatrix(x, y), "t")], evals_result=er,
+                    ray_params=RayParams(num_actors=2))
+        trace = er["t"][metric]
+        assert trace[-1] <= trace[0], (objective, er)
+        assert trace[-1] < 0.5, (objective, er)
+        assert bst.num_boosted_rounds() == 10
+
+
+def test_hinge_predicts_hard_labels():
+    from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+    rng = np.random.RandomState(31)
+    x = rng.randn(300, 3).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    bst = train({"objective": "binary:hinge"}, RayDMatrix(x, y), 8,
+                ray_params=RayParams(num_actors=2))
+    pred = bst.predict(x)
+    assert set(np.unique(pred)) <= {0.0, 1.0}
+    assert (pred == y).mean() > 0.9
+
+
+def test_mape_rmsle_metrics_values():
+    from xgboost_ray_tpu.ops.metrics import compute_metric
+
+    pred = np.array([1.0, 2.0, 4.0], np.float32)
+    y = np.array([1.0, 1.0, 2.0], np.float32)
+    mape = compute_metric("mape", pred, y)
+    assert abs(mape - np.mean([0.0, 1.0, 1.0])) < 1e-6
+    rmsle = compute_metric("rmsle", pred, y)
+    expect = np.sqrt(np.mean((np.log1p(pred) - np.log1p(y)) ** 2))
+    assert abs(rmsle - expect) < 1e-6
+
+
+def test_huber_slope_changes_model_and_sle_validates():
+    from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+    rng = np.random.RandomState(32)
+    x = rng.randn(300, 3).astype(np.float32)
+    y = (2 * x[:, 0] + rng.randn(300)).astype(np.float32)
+    preds = {}
+    for slope in (1.0, 5.0):
+        bst = train({"objective": "reg:pseudohubererror", "huber_slope": slope},
+                    RayDMatrix(x, y), 5, ray_params=RayParams(num_actors=2))
+        preds[slope] = bst.predict(x)
+    assert not np.allclose(preds[1.0], preds[5.0])
+
+    with pytest.raises(ValueError, match="labels > -1"):
+        train({"objective": "reg:squaredlogerror"},
+              RayDMatrix(x, np.full(300, -2.0, np.float32)), 2,
+              ray_params=RayParams(num_actors=2))

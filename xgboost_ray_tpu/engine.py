@@ -148,6 +148,7 @@ class TpuEngine:
                 tweedie_variance_power=params.tweedie_variance_power,
                 aft_loss_distribution=params.aft_loss_distribution,
                 aft_loss_distribution_scale=params.aft_loss_distribution_scale,
+                huber_slope=params.huber_slope,
             )
         )
         self.is_ranking = isinstance(self.objective, RankingObjective)
@@ -240,6 +241,16 @@ class TpuEngine:
         self.group_ptr = (
             None if qid is None else build_group_rows(qid)[1]
         )
+        if (
+            getattr(self.objective, "name", "") == "reg:squaredlogerror"
+            and label is not None
+            and (np.asarray(label) <= -1).any()
+        ):
+            # xgboost rejects these at data load; clamping would silently
+            # train on corrupted targets
+            raise ValueError(
+                "reg:squaredlogerror requires all labels > -1."
+            )
 
         # Multi-host: `shards` holds only THIS process's ranks (in the order of
         # this process's devices within jax.devices()); row counts are
@@ -674,7 +685,10 @@ class TpuEngine:
                 set_contribs = []
                 for name in dev_metrics:
                     set_contribs.append(
-                        device_metric_contrib(name, m, lab, w, gr, psum)
+                        device_metric_contrib(
+                            name, m, lab, w, gr, psum,
+                            huber_slope=params.huber_slope,
+                        )
                     )
                 contribs.append(tuple(set_contribs))
             return tuple(contribs)
@@ -871,7 +885,7 @@ class TpuEngine:
                     den = float(contribs_np[si][mi][1][r])
                     val = num / max(den, 1e-12)
                     base, _ = parse_metric_name(name)
-                    row[name] = float(np.sqrt(val)) if base == "rmse" else val
+                    row[name] = float(np.sqrt(val)) if base in ("rmse", "rmsle") else val
                 round_res[es.name] = row
             results.append(round_res)
         return results
@@ -939,7 +953,7 @@ class TpuEngine:
                 num, den = float(num), float(den)
                 val = num / max(den, 1e-12)
                 base, _ = parse_metric_name(name)
-                row[name] = float(np.sqrt(val)) if base == "rmse" else val
+                row[name] = float(np.sqrt(val)) if base in ("rmse", "rmsle") else val
             if self._host_metrics:
                 margin = self.get_margins(es)
                 for name in self._host_metrics:
@@ -1240,7 +1254,7 @@ class TpuEngine:
                 num, den = float(num), float(den)
                 val = num / max(den, 1e-12)
                 base, _ = parse_metric_name(name)
-                row[name] = float(np.sqrt(val)) if base == "rmse" else val
+                row[name] = float(np.sqrt(val)) if base in ("rmse", "rmsle") else val
             if self._host_metrics:
                 margin = self.get_margins(es)
                 for name in self._host_metrics:

@@ -59,6 +59,24 @@ def _mlogloss(margin, label, weight):
     return jnp.sum(weight * ll), jnp.sum(weight)
 
 
+def _rmsle(margin, label, weight):
+    # labels must be > -1 (validated by the engine for the SLE objective;
+    # standalone use propagates NaN rather than silently clamping)
+    d = jnp.log1p(jnp.maximum(margin[:, 0], -1.0 + 1e-6)) - jnp.log1p(label)
+    return jnp.sum(weight * d * d), jnp.sum(weight)
+
+
+def _mphe(margin, label, weight, slope=1.0):
+    r = margin[:, 0] - label
+    loss = slope * slope * (jnp.sqrt(1.0 + (r / slope) ** 2) - 1.0)
+    return jnp.sum(weight * loss), jnp.sum(weight)
+
+
+def _mape(margin, label, weight):
+    ape = jnp.abs((margin[:, 0] - label) / jnp.maximum(jnp.abs(label), 1e-10))
+    return jnp.sum(weight * ape), jnp.sum(weight)
+
+
 def _poisson_nloglik(margin, label, weight):
     m = jnp.clip(margin[:, 0], -30.0, 30.0)
     mu = jnp.exp(m)
@@ -75,6 +93,9 @@ _ELEMENTWISE: Dict[str, Callable] = {
     "merror": _merror,
     "mlogloss": _mlogloss,
     "poisson-nloglik": _poisson_nloglik,
+    "rmsle": _rmsle,
+    "mphe": _mphe,
+    "mape": _mape,
 }
 
 
@@ -208,7 +229,8 @@ def is_device_metric(name: str, has_groups: bool) -> bool:
     return False
 
 
-def device_metric_contrib(name, margin, label, weight, group_rows, psum):
+def device_metric_contrib(name, margin, label, weight, group_rows, psum,
+                          huber_slope: float = 1.0):
     """Device-side psum-merged (num, den) for any device metric.
 
     The caller divides num/den on host (rmse additionally sqrts), so every
@@ -218,6 +240,8 @@ def device_metric_contrib(name, margin, label, weight, group_rows, psum):
     if base in _ELEMENTWISE:
         if base == "error" and arg is not None:
             num, den = _error(margin, label, weight, arg)
+        elif base == "mphe":
+            num, den = _mphe(margin, label, weight, slope=huber_slope)
         else:
             num, den = _ELEMENTWISE[base](margin, label, weight)
         return psum(num), psum(den)
@@ -383,7 +407,7 @@ def compute_metric(
             )
         num, den = float(num), float(den)
         val = num / max(den, 1e-12)
-        return float(np.sqrt(val)) if base == "rmse" else val
+        return float(np.sqrt(val)) if base in ("rmse", "rmsle") else val
     if base in ("auc", "aucpr"):
         score = margin[:, 0] if margin.shape[1] == 1 else margin[:, 1]
         fn = _auc_np if base == "auc" else _aucpr_np

@@ -171,6 +171,69 @@ def _make_tweedie(rho: float) -> Objective:
     )
 
 
+def _make_hinge() -> Objective:
+    # binary:hinge: loss max(0, 1 - ym) with y in {-1, +1}; g = -y on the
+    # margin-violating side, h = 1 (xgboost's constant-hessian convention);
+    # predictions are hard 0/1 labels
+    def gh(margin, label, weight):
+        y = jnp.where(label > 0.5, 1.0, -1.0)
+        violating = y * margin[:, 0] < 1.0
+        g = jnp.where(violating, -y, 0.0) * weight
+        h = weight
+        return g[:, None], h[:, None]
+
+    return Objective(
+        name="binary:hinge",
+        grad_hess=gh,
+        transform=lambda m: (m[:, 0] > 0).astype(jnp.float32),
+        default_metric="error",
+        # hinge has no link function: base_score IS the initial margin
+        # (xgboost identity ProbToMargin for hinge)
+        base_score_to_margin=lambda s: float(s),
+        default_base_score=0.5,
+        output_kind="class",
+    )
+
+
+def _make_squaredlogerror() -> Objective:
+    # loss 0.5*(log1p(p) - log1p(y))^2; predictions clamp to > -1 (xgboost
+    # convention); labels must be > -1 — validated host-side by the engine,
+    # not silently clamped
+    def gh(margin, label, weight):
+        p = jnp.maximum(margin[:, 0], -1.0 + 1e-6)
+        d = jnp.log1p(p) - jnp.log1p(label)
+        g = d / (p + 1.0) * weight
+        h = jnp.maximum((1.0 - d) / (p + 1.0) ** 2, 1e-6) * weight
+        return g[:, None], h[:, None]
+
+    return Objective(
+        name="reg:squaredlogerror",
+        grad_hess=gh,
+        transform=lambda m: m[:, 0],
+        default_metric="rmsle",
+        default_base_score=0.5,
+    )
+
+
+def _make_pseudohuber(slope: float) -> Objective:
+    # loss d^2*(sqrt(1+(r/d)^2)-1): quadratic near 0, linear in the tails
+    def gh(margin, label, weight):
+        r = margin[:, 0] - label
+        scale = 1.0 + (r / slope) ** 2
+        sqrt_scale = jnp.sqrt(scale)
+        g = r / sqrt_scale * weight
+        h = jnp.maximum(1.0 / (scale * sqrt_scale), 1e-16) * weight
+        return g[:, None], h[:, None]
+
+    return Objective(
+        name="reg:pseudohubererror",
+        grad_hess=gh,
+        transform=lambda m: m[:, 0],
+        default_metric="mphe",
+        default_base_score=0.5,
+    )
+
+
 RANKING_OBJECTIVES = ("rank:pairwise", "rank:ndcg", "rank:map")
 SURVIVAL_OBJECTIVES = ("survival:aft",)
 
@@ -182,6 +245,7 @@ def get_objective(
     tweedie_variance_power: float = 1.5,
     aft_loss_distribution: str = "normal",
     aft_loss_distribution_scale: float = 1.0,
+    huber_slope: float = 1.0,
 ) -> Objective:
     """Resolve an xgboost objective string to an Objective bundle.
 
@@ -200,6 +264,12 @@ def get_objective(
         if num_class < 2:
             raise ValueError(f"{name} requires num_class >= 2, got {num_class}")
         return _make_softmax(num_class, prob_output=(name == "multi:softprob"))
+    if name == "binary:hinge":
+        return _make_hinge()
+    if name == "reg:squaredlogerror":
+        return _make_squaredlogerror()
+    if name == "reg:pseudohubererror":
+        return _make_pseudohuber(slope=huber_slope)
     if name == "count:poisson":
         return _make_poisson()
     if name == "reg:gamma":

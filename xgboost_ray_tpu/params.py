@@ -77,6 +77,8 @@ class TrainParams:
     aft_loss_distribution_scale: float = 1.0
     # reg:tweedie
     tweedie_variance_power: float = 1.5
+    # reg:pseudohubererror
+    huber_slope: float = 1.0
     # tpu_hist internals
     hist_impl: str = "auto"  # auto | scatter | onehot | partition | mixed | pallas
     hist_chunk: int = 8192
