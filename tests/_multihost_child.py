@@ -104,6 +104,27 @@ def main() -> int:
     np.testing.assert_allclose(
         bst.predict(x, output_margin=True), exp["margins"], atol=1e-4
     )
+
+    # --- ranking: group layouts + device ndcg over the 2-host mesh ----------
+    xr, yr, qid = exp["xr"], exp["yr"], exp["qid"]
+    qn = xr.shape[0]
+    rshards = []
+    for rank in my_ranks:
+        idx = _get_sharding_indices(RayShardingMode.BATCH, rank, num_actors, qn)
+        rshards.append({
+            "data": xr[idx], "label": yr[idx], "weight": None,
+            "base_margin": None, "label_lower_bound": None,
+            "label_upper_bound": None, "qid": qid[idx],
+        })
+    rparams = parse_params({"objective": "rank:pairwise",
+                            "eval_metric": ["ndcg@4"], "max_depth": 3})
+    reng = TpuEngine(rshards, rparams, num_actors=num_actors,
+                     evals=[(rshards, "train")])
+    rresults = [reng.step(i) for i in range(int(exp["rounds"]))]
+    np.testing.assert_allclose(
+        [r["train"]["ndcg@4"] for r in rresults], exp["rank_ndcg"], atol=1e-5
+    )
+
     print(f"CHILD{pid} OK", flush=True)
     return 0
 

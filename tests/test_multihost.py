@@ -54,12 +54,38 @@ def test_two_process_training_matches_single_process(tmp_path):
                     evals=[(shards, "train")])
     results = [eng.step(i) for i in range(rounds)]
     bst = eng.get_booster()
+
+    # ranking expectations: sorted qid + BATCH sharding gives contiguous
+    # groups that may fragment at shard boundaries (the per-shard group
+    # convention handles fragments); what matters is the 8-block layout is
+    # byte-identical between the single-process and 2-process runs
+    rng = np.random.RandomState(3)
+    qn = 640
+    qid = np.sort(rng.randint(0, 40, size=qn)).astype(np.int64)
+    xr = rng.randn(qn, 5).astype(np.float32)
+    yr = rng.randint(0, 4, size=qn).astype(np.float32)
+    rshards = []
+    for rank in range(num_actors):
+        idx = _get_sharding_indices(RayShardingMode.BATCH, rank, num_actors, qn)
+        rshards.append({
+            "data": xr[idx], "label": yr[idx], "weight": None,
+            "base_margin": None, "label_lower_bound": None,
+            "label_upper_bound": None, "qid": qid[idx],
+        })
+    rparams = parse_params({"objective": "rank:pairwise",
+                            "eval_metric": ["ndcg@4"], "max_depth": 3})
+    reng = TpuEngine(rshards, rparams, num_actors=num_actors,
+                     evals=[(rshards, "train")])
+    rresults = [reng.step(i) for i in range(rounds)]
+    rank_ndcg = [r["train"]["ndcg@4"] for r in rresults]
+
     expected = str(tmp_path / "expected.npz")
     np.savez(
         expected, x=x, y=y, rounds=rounds,
         logloss=[r["train"]["logloss"] for r in results],
         auc=[r["train"]["auc"] for r in results],
         margins=bst.predict(x, output_margin=True),
+        xr=xr, yr=yr, qid=qid, rank_ndcg=rank_ndcg,
     )
 
     port = _free_port()

@@ -7,6 +7,13 @@ Run on real hardware to pin ``resolve_hist_impl``'s accelerator default:
 
 Prints per-(impl, n_nodes) timings plus a full build_tree comparison; the
 winning impl per fan-out regime is what `mixed` should select.
+
+Timing methodology (matters on the axon TPU tunnel): ``block_until_ready``
+does not reliably block there, and every host read costs a ~90 ms relay
+round trip. Each kernel is therefore repeated R times inside one jitted
+``lax.scan`` (inputs perturbed per iteration so XLA cannot CSE the body)
+and synced with a single scalar host read; the relay overhead is measured
+separately and subtracted.
 """
 
 import argparse
@@ -15,13 +22,57 @@ import time
 import numpy as np
 
 
+def _measure_overhead(jax, jnp):
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.float32(0.0)
+    float(f(x))
+    t0 = time.time()
+    for _ in range(3):
+        v = float(f(x))
+    return (time.time() - t0) / 3
+
+
+def _time_scanned(jax, jnp, make_body, operands, repeats, overhead,
+                  slow_cutoff=2.0):
+    """Time make_body(i, *operands): single-call probe first, scan-repeat
+    refinement when the single call is fast enough to be overhead-dominated.
+    Operands are jit arguments (not closed-over constants) so the traced
+    program matches production shapes."""
+    single = jax.jit(lambda i, *ops: make_body(i, *ops))
+    float(single(jnp.int32(0), *operands))  # compile
+    t0 = time.time()
+    v = float(single(jnp.int32(1), *operands))
+    t1 = max(0.0, time.time() - t0 - overhead)
+    assert np.isfinite(v)
+    if t1 > slow_cutoff:
+        return t1  # slow enough that the relay overhead is noise
+
+    def prog(seed, *ops):
+        def body(carry, i):
+            out = make_body(i, *ops)
+            return carry + out, None
+
+        total, _ = jax.lax.scan(
+            body, jnp.float32(0.0), jnp.arange(repeats, dtype=jnp.int32)
+        )
+        return total + seed
+
+    fn = jax.jit(prog)
+    float(fn(jnp.float32(0.0), *operands))  # compile
+    t0 = time.time()
+    v = float(fn(jnp.float32(1.0), *operands))
+    dt = time.time() - t0
+    assert np.isfinite(v)
+    return max(0.0, dt - overhead) / repeats
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--rows", type=int, default=1_000_000)
     parser.add_argument("--features", type=int, default=28)
     parser.add_argument("--max-bin", type=int, default=256)
     parser.add_argument("--depth", type=int, default=6)
-    parser.add_argument("--repeats", type=int, default=3)
+    parser.add_argument("--repeats", type=int, default=8)
     parser.add_argument("--impls", nargs="+",
                         default=["scatter", "onehot", "partition"])
     args = parser.parse_args()
@@ -35,7 +86,11 @@ def main():
     from xgboost_ray_tpu.ops.split import SplitParams
 
     print(f"backend={jax.default_backend()} rows={args.rows} "
-          f"features={args.features} bins={args.max_bin}")
+          f"features={args.features} bins={args.max_bin}", flush=True)
+
+    overhead = _measure_overhead(jax, jnp)
+    print(f"  host-read overhead {overhead * 1e3:.1f} ms (subtracted)",
+          flush=True)
 
     rng = np.random.RandomState(0)
     nbt = args.max_bin + 1
@@ -49,18 +104,19 @@ def main():
             rng.randint(0, n_nodes, size=args.rows).astype(np.int32))
         for impl in args.impls:
             try:
-                fn = jax.jit(
-                    lambda b, g, p, impl=impl, nn=n_nodes: build_histogram(
-                        b, g, p, nn, nbt, impl=impl))
-                fn(bins, gh, pos).block_until_ready()  # compile
-                t0 = time.time()
-                for _ in range(args.repeats):
-                    fn(bins, gh, pos).block_until_ready()
-                dt = (time.time() - t0) / args.repeats
-                print(f"  hist n_nodes={n_nodes:3d} {impl:10s} {dt * 1e3:9.2f} ms")
+                def body(i, b, g0, p, impl=impl, nn=n_nodes):
+                    # perturb gh by the iteration index so XLA cannot CSE
+                    g = g0 + (i.astype(jnp.float32) * 1e-12)
+                    h = build_histogram(b, g, p, nn, nbt, impl=impl)
+                    return h.sum()
+
+                dt = _time_scanned(jax, jnp, body, (bins, gh, pos),
+                                   args.repeats, overhead)
+                print(f"  hist n_nodes={n_nodes:3d} {impl:10s} "
+                      f"{dt * 1e3:9.2f} ms", flush=True)
             except Exception as exc:  # noqa: BLE001
                 print(f"  hist n_nodes={n_nodes:3d} {impl:10s} FAILED: "
-                      f"{str(exc)[:80]}")
+                      f"{str(exc)[:120]}", flush=True)
 
     # full tree builds (includes partition-order maintenance, split search)
     x = rng.randn(args.rows, args.features).astype(np.float32)
@@ -69,15 +125,19 @@ def main():
         try:
             cfg = GrowConfig(max_depth=args.depth, max_bin=args.max_bin,
                              split=SplitParams(), hist_impl=impl)
-            fn = jax.jit(lambda b, g: build_tree(b, g, cuts, cfg)[1])
-            fn(bins, gh).block_until_ready()
-            t0 = time.time()
-            for _ in range(args.repeats):
-                fn(bins, gh).block_until_ready()
-            dt = (time.time() - t0) / args.repeats
-            print(f"  tree depth={args.depth} {impl:10s} {dt * 1e3:9.2f} ms")
+
+            def body(i, b, g0, c, cfg=cfg):
+                g = g0 + (i.astype(jnp.float32) * 1e-12)
+                tree = build_tree(b, g, c, cfg)[0]
+                return tree.value.sum()
+
+            dt = _time_scanned(jax, jnp, body, (bins, gh, cuts),
+                               max(2, args.repeats // 2), overhead)
+            print(f"  tree depth={args.depth} {impl:10s} {dt * 1e3:9.2f} ms",
+                  flush=True)
         except Exception as exc:  # noqa: BLE001
-            print(f"  tree depth={args.depth} {impl:10s} FAILED: {str(exc)[:80]}")
+            print(f"  tree depth={args.depth} {impl:10s} FAILED: "
+                  f"{str(exc)[:120]}", flush=True)
 
 
 if __name__ == "__main__":

@@ -477,43 +477,56 @@ class TpuEngine:
         return jax.jit(lambda x, c: binning.bin_matrix(x, c, max_bin))(x_dev, self.cuts)
 
     def _build_sharded_groups(self, qid, n_rows=None, pad_to=None):
-        """Per-device-block padded group gather maps, stacked + sharded."""
-        n_rows = self.n_rows if n_rows is None else n_rows
+        """Per-device-block padded group gather maps, stacked + sharded.
+
+        Multi-host: ``qid`` holds only this process's rows, so each process
+        builds the gather maps for its own devices' blocks; the padded
+        (n_groups, group_size) extents are allgathered so every process
+        materializes the same global array shape, then the per-process slabs
+        are assembled without cross-host copies via ``put_rows_global``.
+        """
+        n_rows = self._local_rows if n_rows is None else n_rows
         pad_to = self.pad_to if pad_to is None else pad_to
         if qid is None:
             raise ValueError(f"objective {self.objective.name!r} requires qid")
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "query-group layouts (ranking objectives / ndcg / map) are "
-                "not yet supported on multi-host meshes."
-            )
+        pc = jax.process_count()
         block = pad_to // self.n_devices
+        local_devices = self.n_devices // pc
         per_dev = []
-        for d in range(self.n_devices):
+        for d in range(local_devices):
             lo, hi = d * block, min((d + 1) * block, n_rows)
             if hi <= lo:
-                per_dev.append((np.zeros((1, 1), np.int32) + block, None))
+                per_dev.append(None)
                 continue
             rows, _ = build_group_rows(qid[lo:hi])
-            per_dev.append((rows, None))
-        ng = max(r.shape[0] for r, _ in per_dev)
-        gsz = max(r.shape[1] for r, _ in per_dev)
-        stacked = np.full((self.n_devices, ng, gsz), block, np.int32)
-        for d, (rows, _) in enumerate(per_dev):
-            if rows is not None:
-                stacked[d, : rows.shape[0], : rows.shape[1]] = np.where(
-                    rows >= 2 ** 30, block, rows
+            per_dev.append(rows)
+        ng = max([r.shape[0] for r in per_dev if r is not None] or [1])
+        gsz = max([r.shape[1] for r in per_dev if r is not None] or [1])
+        if pc > 1:
+            from jax.experimental import multihost_utils
+
+            dims = np.asarray(
+                multihost_utils.process_allgather(
+                    np.array([ng, gsz], np.int64)
                 )
-        # sentinel inside build_group_rows is local n (== hi-lo); remap to block
-        for d, (rows, _) in enumerate(per_dev):
+            ).reshape(-1, 2)
+            ng, gsz = int(dims[:, 0].max()), int(dims[:, 1].max())
+        stacked = np.full((local_devices, ng, gsz), block, np.int32)
+        for d, rows in enumerate(per_dev):
+            if rows is None:
+                continue
             lo = d * block
             hi = min(lo + block, n_rows)
-            local_n = hi - lo
-            sub = stacked[d]
-            sub[sub == local_n] = block
-            stacked[d] = sub
-        flat = stacked.reshape(self.n_devices * ng, gsz)
-        return jax.device_put(flat, self._row_sharding)
+            # sentinel inside build_group_rows is the local segment length
+            # (== hi-lo); remap it to `block`, the padded gather slot every
+            # shard treats as invalid
+            r = np.where(rows == hi - lo, block, rows)
+            stacked[d, : rows.shape[0], : rows.shape[1]] = r
+        flat = stacked.reshape(local_devices * ng, gsz)
+
+        from xgboost_ray_tpu.distributed import put_rows_global
+
+        return put_rows_global(flat, self._row_sharding)
 
     def _add_eval_set(self, eval_shards, name, x_id, shards_obj, eval_obj, init_booster):
         is_train = eval_obj is shards_obj
