@@ -1,0 +1,357 @@
+"""Execute the gated data sources (modin/dask/ray.data/petastorm) end-to-end.
+
+These libraries are not installed in this image, so each test installs a
+minimal fake module that satisfies exactly the import surface the source
+touches (the same technique the reference uses to simulate multi-node
+clusters without hardware — ``xgboost_ray/tests/conftest.py:36-71``). The
+fakes exercise the REAL source code paths: type detection, FIXED sharding
+auto-selection, locality assignment via ``get_actor_shards``, per-rank
+partition loading, and a short distributed training run.
+
+Reference behaviors mirrored:
+- ``xgboost_ray/data_sources/modin.py:114-135`` (unwrap + locality assign)
+- ``xgboost_ray/data_sources/dask.py:101-161`` (delayed partitions)
+- ``xgboost_ray/data_sources/ray_dataset.py:87-103`` (split per actor)
+- ``xgboost_ray/data_sources/petastorm.py:45-85`` (make_batch_reader URLs)
+"""
+
+import sys
+import types
+from collections import namedtuple
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+from xgboost_ray_tpu.matrix import RayShardingMode
+
+
+def _split_df(df: pd.DataFrame, n: int):
+    return [
+        df.iloc[idx].reset_index(drop=True)
+        for idx in np.array_split(np.arange(len(df)), n)
+    ]
+
+
+def _make_frame(n=400, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.standard_normal((n, 4)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float32)
+    df = pd.DataFrame(x, columns=[f"f{i}" for i in range(4)])
+    df["label"] = y
+    return df
+
+
+def _train_assert_learns(dmatrix, num_actors=2):
+    res = {}
+    bst = train(
+        {"objective": "binary:logistic", "eval_metric": ["error"],
+         "max_depth": 4, "eta": 0.5},
+        dmatrix,
+        num_boost_round=8,
+        evals=[(dmatrix, "train")],
+        evals_result=res,
+        ray_params=RayParams(num_actors=num_actors, checkpoint_frequency=0),
+    )
+    assert res["train"]["error"][-1] < 0.2
+    return bst
+
+
+@pytest.fixture
+def fake_modules():
+    """Install fake modules; restore sys.modules afterwards."""
+    installed = []
+
+    def install(name, module):
+        assert name not in sys.modules, f"{name} unexpectedly importable"
+        sys.modules[name] = module
+        installed.append(name)
+
+    yield install
+    for name in installed:
+        sys.modules.pop(name, None)
+
+
+# ---------------------------------------------------------------- modin ----
+
+
+class _FakeModinFrame:
+    """Duck-typed stand-in for modin.pandas.DataFrame."""
+
+    def __init__(self, df: pd.DataFrame, npartitions: int = 4):
+        self._df = df
+        self._npartitions = npartitions
+
+    def _to_pandas(self) -> pd.DataFrame:
+        return self._df
+
+    def __len__(self):
+        return len(self._df)
+
+    def partitions(self):
+        return _split_df(self._df, self._npartitions)
+
+
+class _FakeModinSeries:
+    def __init__(self, series: pd.Series):
+        self._series = series
+
+    def _to_pandas(self) -> pd.Series:
+        return self._series
+
+
+def _install_fake_modin(install):
+    modin = types.ModuleType("modin")
+    modin_pandas = types.ModuleType("modin.pandas")
+    modin_pandas.DataFrame = _FakeModinFrame
+    modin_pandas.Series = _FakeModinSeries
+    modin_dist = types.ModuleType("modin.distributed")
+    modin_dist_df = types.ModuleType("modin.distributed.dataframe")
+    modin_dist_pd = types.ModuleType("modin.distributed.dataframe.pandas")
+
+    def unwrap_partitions(data, axis=0):
+        assert axis == 0
+        return data.partitions()
+
+    modin_dist_pd.unwrap_partitions = unwrap_partitions
+    modin.pandas = modin_pandas
+    modin.distributed = modin_dist
+    modin_dist.dataframe = modin_dist_df
+    modin_dist_df.pandas = modin_dist_pd
+    install("modin", modin)
+    install("modin.pandas", modin_pandas)
+    install("modin.distributed", modin_dist)
+    install("modin.distributed.dataframe", modin_dist_df)
+    install("modin.distributed.dataframe.pandas", modin_dist_pd)
+
+
+def test_modin_source_detected_and_fixed_sharding(fake_modules):
+    _install_fake_modin(fake_modules)
+    from xgboost_ray_tpu.data_sources import Modin
+
+    mdf = _FakeModinFrame(_make_frame())
+    assert Modin.is_data_type(mdf)
+
+    dm = RayDMatrix(mdf, label="label", lazy=True)
+    assert dm.distributed, "modin frames must auto-select distributed loading"
+    assert dm.sharding == RayShardingMode.FIXED
+
+
+def test_modin_end_to_end_train(fake_modules):
+    _install_fake_modin(fake_modules)
+    df = _make_frame()
+    dm = RayDMatrix(_FakeModinFrame(df, npartitions=4), label="label")
+    _train_assert_learns(dm)
+    # every row reached exactly one shard
+    dm.load_data(2)
+    n0 = dm.get_data(0, 2)["data"].shape[0]
+    n1 = dm.get_data(1, 2)["data"].shape[0]
+    assert n0 + n1 == len(df)
+
+
+def test_modin_not_detected_without_module():
+    from xgboost_ray_tpu.data_sources import Modin
+
+    assert not Modin.is_data_type(_FakeModinFrame(_make_frame()))
+
+
+# ----------------------------------------------------------------- dask ----
+
+
+class _FakeDelayed:
+    def __init__(self, frame: pd.DataFrame):
+        self.frame = frame
+
+    def compute(self):
+        return self.frame
+
+
+class _FakeDaskFrame:
+    def __init__(self, df: pd.DataFrame, npartitions: int = 4):
+        self._df = df
+        self.npartitions = npartitions
+
+    def to_delayed(self):
+        return [_FakeDelayed(p) for p in _split_df(self._df, self.npartitions)]
+
+    def compute(self) -> pd.DataFrame:
+        return self._df
+
+
+class _FakeDaskSeries:
+    def __init__(self, series: pd.Series):
+        self._series = series
+
+    def compute(self) -> pd.Series:
+        return self._series
+
+
+def _install_fake_dask(install):
+    dask = types.ModuleType("dask")
+    dask_df = types.ModuleType("dask.dataframe")
+    dask_df.DataFrame = _FakeDaskFrame
+    dask_df.Series = _FakeDaskSeries
+
+    def compute(*items):
+        return tuple(i.compute() for i in items)
+
+    dask.compute = compute
+    dask.dataframe = dask_df
+    install("dask", dask)
+    install("dask.dataframe", dask_df)
+
+
+def test_dask_source_detected_and_fixed_sharding(fake_modules):
+    _install_fake_dask(fake_modules)
+    from xgboost_ray_tpu.data_sources import Dask
+
+    ddf = _FakeDaskFrame(_make_frame())
+    assert Dask.is_data_type(ddf)
+    assert Dask.get_n(ddf) == 4
+
+    dm = RayDMatrix(ddf, label="label", lazy=True)
+    assert dm.distributed
+    assert dm.sharding == RayShardingMode.FIXED
+
+
+def test_dask_end_to_end_train(fake_modules):
+    _install_fake_dask(fake_modules)
+    df = _make_frame()
+    dm = RayDMatrix(_FakeDaskFrame(df, npartitions=4), label="label")
+    _train_assert_learns(dm)
+    dm.load_data(2)
+    n0 = dm.get_data(0, 2)["data"].shape[0]
+    n1 = dm.get_data(1, 2)["data"].shape[0]
+    assert n0 + n1 == len(df)
+
+
+# ------------------------------------------------------------- ray.data ----
+
+
+class _FakeRayDataset:
+    def __init__(self, df: pd.DataFrame, n_blocks: int = 4):
+        self._df = df
+        self._n_blocks = n_blocks
+
+    def split(self, n, equal=False):
+        assert equal, "reference splits with equal=True (ray_dataset.py:98)"
+        return [_FakeRayDataset(p, 1) for p in _split_df(self._df, n)]
+
+    def to_pandas(self) -> pd.DataFrame:
+        return self._df
+
+    def num_blocks(self) -> int:
+        return self._n_blocks
+
+
+def _install_fake_ray(install):
+    ray = types.ModuleType("ray")
+    ray_data = types.ModuleType("ray.data")
+    ray_data.Dataset = _FakeRayDataset
+    ray.data = ray_data
+    install("ray", ray)
+    install("ray.data", ray_data)
+
+
+def test_ray_dataset_detected_and_fixed_sharding(fake_modules):
+    _install_fake_ray(fake_modules)
+    from xgboost_ray_tpu.data_sources import RayDataset
+
+    ds = _FakeRayDataset(_make_frame())
+    assert RayDataset.is_data_type(ds)
+
+    dm = RayDMatrix(ds, label="label", lazy=True)
+    assert dm.distributed
+    assert dm.sharding == RayShardingMode.FIXED
+
+
+def test_ray_dataset_end_to_end_train(fake_modules):
+    _install_fake_ray(fake_modules)
+    df = _make_frame()
+    dm = RayDMatrix(_FakeRayDataset(df), label="label")
+    _train_assert_learns(dm)
+    dm.load_data(2)
+    n0 = dm.get_data(0, 2)["data"].shape[0]
+    n1 = dm.get_data(1, 2)["data"].shape[0]
+    assert n0 + n1 == len(df)
+    # equal=True split: shards within one row of each other
+    assert abs(n0 - n1) <= 1
+
+
+# ------------------------------------------------------------ petastorm ----
+
+
+def _install_fake_petastorm(install):
+    petastorm = types.ModuleType("petastorm")
+
+    class _Reader:
+        """Yields namedtuple batches like petastorm's make_batch_reader."""
+
+        def __init__(self, url_or_urls):
+            urls = [url_or_urls] if isinstance(url_or_urls, str) else list(url_or_urls)
+            self._paths = [u[len("file://"):] for u in urls]
+            for u in urls:
+                assert u.startswith("file://"), u
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+        def __iter__(self):
+            for path in self._paths:
+                df = pd.read_parquet(path)
+                Batch = namedtuple("Batch", list(df.columns))
+                yield Batch(**{c: df[c].to_numpy() for c in df.columns})
+
+    petastorm.make_batch_reader = _Reader
+    install("petastorm", petastorm)
+
+
+@pytest.fixture
+def parquet_urls(tmp_path):
+    df = _make_frame()
+    urls = []
+    for i, part in enumerate(_split_df(df, 4)):
+        path = tmp_path / f"part_{i}.parquet"
+        part.to_parquet(path)
+        urls.append(f"file://{path}")
+    return urls, df
+
+
+def test_petastorm_detected(fake_modules, parquet_urls):
+    _install_fake_petastorm(fake_modules)
+    from xgboost_ray_tpu.data_sources import Petastorm, RayFileType
+
+    urls, _ = parquet_urls
+    assert Petastorm.is_data_type(urls)
+    assert Petastorm.is_data_type(urls[0])
+    assert Petastorm.get_filetype(urls) == RayFileType.PETASTORM
+    assert not Petastorm.is_data_type(["/plain/path.parquet"])
+
+
+def test_petastorm_end_to_end_train(fake_modules, parquet_urls):
+    _install_fake_petastorm(fake_modules)
+    urls, df = parquet_urls
+    dm = RayDMatrix(urls, label="label")
+    assert dm.distributed
+    assert dm.loader.get_data_source().__name__ == "Petastorm"
+    _train_assert_learns(dm)
+    dm.load_data(2)
+    n0 = dm.get_data(0, 2)["data"].shape[0]
+    n1 = dm.get_data(1, 2)["data"].shape[0]
+    assert n0 + n1 == len(df)
+
+
+def test_petastorm_single_url_load(fake_modules, parquet_urls):
+    _install_fake_petastorm(fake_modules)
+    from xgboost_ray_tpu.data_sources import Petastorm
+
+    urls, df = parquet_urls
+    out = Petastorm.load_data(urls[0])
+    pd.testing.assert_frame_equal(out, pd.read_parquet(urls[0][len("file://"):]))
+    # ignore drops columns
+    out2 = Petastorm.load_data(urls, ignore=["f3"])
+    assert "f3" not in out2.columns and len(out2) == len(df)

@@ -113,23 +113,242 @@ def test_contribs_save_load_roundtrip(tmp_path):
     )
 
 
-def test_exact_shap_request_warns():
-    """pred_contribs without approx_contribs=True (the xgboost exact-SHAP
-    contract) must warn that values are the Saabas approximation."""
+# ---------------------------------------------------- exact TreeSHAP ----
+
+
+def _brute_force_shap(bst, x: np.ndarray) -> np.ndarray:
+    """Oracle: Shapley values by full subset enumeration over all features.
+
+    Conditional expectation follows xgboost/TreeSHAP semantics: features in
+    the coalition route by value, features outside marginalize children by
+    cover. Returns [N, F+1] (bias = sum of tree expectations + base margin).
+    """
+    import itertools
+    import math
+
+    forest = bst.forest
+    nf = x.shape[1]
+    m0 = float(np.asarray(bst.base_score_margin_np()).ravel()[0])
+
+    def cond_exp(t, node, xrow, coalition):
+        if forest.is_leaf[t, node]:
+            return float(forest.value[t, node])
+        f = int(forest.feature[t, node])
+        left, right = 2 * node + 1, 2 * node + 2
+        if f in coalition:
+            xv = xrow[f]
+            if np.isnan(xv):
+                go_right = not forest.default_left[t, node]
+            else:
+                go_right = xv >= forest.threshold[t, node]
+            return cond_exp(t, right if go_right else left, xrow, coalition)
+        cl = float(forest.cover[t, left])
+        cr = float(forest.cover[t, right])
+        tot = cl + cr
+        if tot <= 0:
+            return float(forest.value[t, node])
+        return (
+            cl * cond_exp(t, left, xrow, coalition)
+            + cr * cond_exp(t, right, xrow, coalition)
+        ) / tot
+
+    n_trees = forest.feature.shape[0]
+    out = np.zeros((x.shape[0], nf + 1), np.float64)
+    feats = list(range(nf))
+    for r, xrow in enumerate(x):
+        for t in range(n_trees):
+            out[r, -1] += cond_exp(t, 0, xrow, frozenset())
+            for i in feats:
+                others = [f for f in feats if f != i]
+                for k in range(nf):
+                    w = math.factorial(k) * math.factorial(nf - k - 1) / math.factorial(nf)
+                    for s in itertools.combinations(others, k):
+                        sset = frozenset(s)
+                        out[r, i] += w * (
+                            cond_exp(t, 0, xrow, sset | {i})
+                            - cond_exp(t, 0, xrow, sset)
+                        )
+    out[:, -1] += m0
+    return out.astype(np.float32)
+
+
+def _exact_sum_check(bst, x, atol=1e-4):
+    contribs = bst.predict(x, pred_contribs=True)
+    margins = bst.predict(x, output_margin=True)
+    axis = contribs.ndim - 1
+    np.testing.assert_allclose(contribs.sum(axis=axis), margins, atol=atol)
+    return contribs
+
+
+def test_exact_shap_matches_brute_force():
     rng = np.random.RandomState(7)
-    x = rng.randn(50, 3).astype(np.float32)
-    y = (x[:, 0] > 0).astype(np.float32)
-    bst = train({"objective": "binary:logistic"}, RayDMatrix(x, y), 2,
-                ray_params=RayParams(num_actors=2))
-    with pytest.warns(UserWarning, match="Saabas"):
-        bst.predict(x, pred_contribs=True)
+    x = rng.randn(200, 4).astype(np.float32)
+    y = (x[:, 0] + 0.7 * x[:, 1] * x[:, 2] > 0).astype(np.float32)
+    bst = train({"objective": "binary:logistic", "max_depth": 3, "eta": 0.4},
+                RayDMatrix(x, y), 5, ray_params=RayParams(num_actors=2))
+    probe = x[:16]
+    exact = bst.predict(probe, pred_contribs=True)
+    oracle = _brute_force_shap(bst, probe)
+    np.testing.assert_allclose(exact, oracle, atol=2e-4)
+    # and it should genuinely differ from Saabas on interaction-heavy trees
+    saabas = bst.predict(probe, pred_contribs=True, approx_contribs=True)
+    assert np.abs(exact - saabas).max() > 1e-4
 
 
-def test_pred_interactions_still_raises():
-    rng = np.random.RandomState(6)
-    x = rng.randn(50, 3).astype(np.float32)
-    y = (x[:, 0] > 0).astype(np.float32)
-    bst = train({"objective": "binary:logistic"}, RayDMatrix(x, y), 2,
+def test_exact_shap_efficiency_with_missing_values():
+    rng = np.random.RandomState(8)
+    x = rng.randn(300, 6).astype(np.float32)
+    x[rng.rand(300, 6) < 0.15] = np.nan
+    y = (np.nan_to_num(x[:, 0]) + 0.5 * np.nan_to_num(x[:, 3]) > 0).astype(np.float32)
+    bst = train({"objective": "binary:logistic", "max_depth": 6},
+                RayDMatrix(x, y), 10, ray_params=RayParams(num_actors=2))
+    _exact_sum_check(bst, x)
+
+
+def test_exact_shap_stump_matches_oracle():
+    """Depth-1 trees: the single-player game has a closed-form Shapley value;
+    check against the brute-force oracle (Saabas differs here by design: its
+    root reference is the Newton weight, not the cover-weighted leaf mean)."""
+    rng = np.random.RandomState(9)
+    x = rng.randn(200, 3).astype(np.float32)
+    y = (x[:, 1] > 0).astype(np.float32)
+    bst = train({"objective": "binary:logistic", "max_depth": 1},
+                RayDMatrix(x, y), 6, ray_params=RayParams(num_actors=2))
+    probe = x[:8]
+    np.testing.assert_allclose(
+        bst.predict(probe, pred_contribs=True),
+        _brute_force_shap(bst, probe),
+        atol=2e-4,
+    )
+
+
+def test_exact_shap_symmetry():
+    """Two identically-distributed, identically-used features must receive
+    (statistically) symmetric attributions."""
+    rng = np.random.RandomState(10)
+    a = rng.randn(4000).astype(np.float32)
+    b = rng.randn(4000).astype(np.float32)
+    x = np.stack([a, b], axis=1)
+    y = ((a + b) > 0).astype(np.float32)
+    bst = train({"objective": "binary:logistic", "max_depth": 3},
+                RayDMatrix(x, y), 8, ray_params=RayParams(num_actors=2))
+    contribs = _exact_sum_check(bst, x)
+    mass = np.abs(contribs[:, :2]).sum(axis=0)
+    assert abs(mass[0] - mass[1]) / mass.max() < 0.2
+
+
+def test_exact_shap_multiclass_and_dart():
+    rng = np.random.RandomState(11)
+    x = rng.randn(240, 5).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32) + (x[:, 1] > 0).astype(np.int32)
+    bst = train({"objective": "multi:softprob", "num_class": 3, "max_depth": 3},
+                RayDMatrix(x, y.astype(np.float32)), 5,
                 ray_params=RayParams(num_actors=2))
-    with pytest.raises(NotImplementedError, match="pred_interactions"):
-        bst.predict(x, pred_interactions=True)
+    contribs = _exact_sum_check(bst, x)
+    assert contribs.shape == (240, 3, 6)
+
+    bst2 = train({"objective": "binary:logistic", "booster": "dart",
+                  "rate_drop": 0.2, "one_drop": 1, "max_depth": 3},
+                 RayDMatrix(x, (x[:, 0] > 0).astype(np.float32)), 6,
+                 ray_params=RayParams(num_actors=2))
+    _exact_sum_check(bst2, x)
+
+
+def _brute_force_interactions(bst, x: np.ndarray) -> np.ndarray:
+    """Oracle SHAP interaction values (off-diagonal feature block only):
+    Phi_ij = sum_{S subset of F\\{i,j}} |S|!(F-|S|-2)!/(2 (F-1)!) * delta_ij(S)
+    with delta_ij(S) = v(S+ij) - v(S+i) - v(S+j) + v(S)."""
+    import itertools
+    import math
+
+    forest = bst.forest
+    nf = x.shape[1]
+
+    def cond_exp(t, node, xrow, coalition):
+        if forest.is_leaf[t, node]:
+            return float(forest.value[t, node])
+        f = int(forest.feature[t, node])
+        left, right = 2 * node + 1, 2 * node + 2
+        if f in coalition:
+            go_right = (
+                (not forest.default_left[t, node])
+                if np.isnan(xrow[f])
+                else xrow[f] >= forest.threshold[t, node]
+            )
+            return cond_exp(t, right if go_right else left, xrow, coalition)
+        cl = float(forest.cover[t, left])
+        cr = float(forest.cover[t, right])
+        tot = cl + cr
+        if tot <= 0:
+            return float(forest.value[t, node])
+        return (
+            cl * cond_exp(t, left, xrow, coalition)
+            + cr * cond_exp(t, right, xrow, coalition)
+        ) / tot
+
+    n_trees = forest.feature.shape[0]
+    out = np.zeros((x.shape[0], nf, nf), np.float64)
+    feats = list(range(nf))
+    for r, xrow in enumerate(x):
+        for t in range(n_trees):
+            for i, j in itertools.combinations(feats, 2):
+                others = [f for f in feats if f not in (i, j)]
+                acc = 0.0
+                for k in range(nf - 1):
+                    w = (
+                        math.factorial(k) * math.factorial(nf - k - 2)
+                        / (2.0 * math.factorial(nf - 1))
+                    )
+                    for s in itertools.combinations(others, k):
+                        sset = frozenset(s)
+                        acc += w * (
+                            cond_exp(t, 0, xrow, sset | {i, j})
+                            - cond_exp(t, 0, xrow, sset | {i})
+                            - cond_exp(t, 0, xrow, sset | {j})
+                            + cond_exp(t, 0, xrow, sset)
+                        )
+                out[r, i, j] += acc
+                out[r, j, i] += acc
+    return out.astype(np.float32)
+
+
+def test_pred_interactions_identities():
+    rng = np.random.RandomState(12)
+    x = rng.randn(400, 4).astype(np.float32)
+    # pure interaction signal: XOR of signs has zero main effect
+    y = ((x[:, 0] > 0) ^ (x[:, 1] > 0)).astype(np.float32)
+    bst = train({"objective": "binary:logistic", "max_depth": 3, "eta": 0.4},
+                RayDMatrix(x, y), 6, ray_params=RayParams(num_actors=2))
+    inter = bst.predict(x[:32], pred_interactions=True)
+    assert inter.shape == (32, 5, 5)
+    contribs = bst.predict(x[:32], pred_contribs=True)
+    margins = bst.predict(x[:32], output_margin=True)
+    # each feature row sums to the plain contribution
+    np.testing.assert_allclose(inter.sum(axis=2), contribs, atol=2e-4)
+    # grand total equals the margin
+    np.testing.assert_allclose(inter.sum(axis=(1, 2)), margins, atol=5e-4)
+    # symmetry
+    np.testing.assert_allclose(inter, np.swapaxes(inter, 1, 2), atol=1e-5)
+    # the XOR pair dominates the off-diagonal mass
+    off = np.abs(inter[:, :4, :4]).sum(axis=0)
+    np.fill_diagonal(off, 0.0)
+    assert off[0, 1] >= off.max() - 1e-3
+    # off-diagonals match the brute-force interaction oracle
+    oracle = _brute_force_interactions(bst, x[:6])
+    got = inter[:6, :4, :4].copy()
+    for r in range(6):
+        np.fill_diagonal(got[r], 0.0)
+    np.testing.assert_allclose(got, oracle, atol=3e-4)
+
+
+def test_interactions_multiclass_shape():
+    rng = np.random.RandomState(13)
+    x = rng.randn(90, 3).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32) + (x[:, 1] > 0).astype(np.int32)
+    bst = train({"objective": "multi:softprob", "num_class": 3, "max_depth": 2},
+                RayDMatrix(x, y.astype(np.float32)), 4,
+                ray_params=RayParams(num_actors=2))
+    inter = bst.predict(x[:16], pred_interactions=True)
+    assert inter.shape == (16, 3, 4, 4)
+    contribs = bst.predict(x[:16], pred_contribs=True)
+    np.testing.assert_allclose(inter.sum(axis=3), contribs, atol=2e-4)

@@ -516,6 +516,11 @@ class RayDMatrix:
             return True  # partition list
         if hasattr(data, "__partitioned__"):
             return True
+        # distributed-frame sources (modin/dask/ray.data) own their partitions
+        # (reference matrix.py:1036-1060 checks the same frame types)
+        for source in data_sources:
+            if getattr(source, "supports_distributed_loading", False) and source.is_data_type(data, None):
+                return True
         return False
 
     def assert_enough_shards_for_actors(self, num_actors: int) -> None:

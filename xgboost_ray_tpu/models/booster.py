@@ -23,6 +23,8 @@ from xgboost_ray_tpu.ops import predict as predict_ops
 from xgboost_ray_tpu.params import TrainParams
 
 _PREDICT_CHUNK = 1 << 16
+# exact TreeSHAP materializes [2^depth, chunk, F] slot contributions: smaller
+_SHAP_CHUNK = 1 << 12
 
 
 def _forest_to_np(forest: Tree) -> Tree:
@@ -229,27 +231,39 @@ class RayXGBoostBooster:
             out[lo:hi] = np.asarray(margin)
         return out
 
-    def predict_contribs_np(
-        self, x: np.ndarray, ntree_limit: int = 0,
-        base_margin: Optional[np.ndarray] = None,
-    ) -> np.ndarray:
-        """Per-feature contributions [N, F+1] (binary/regression) or
-        [N, K, F+1] (multiclass), bias last; rows sum to the margin."""
+    def _assert_node_stats(self):
         if not self._has_node_stats:
             raise ValueError(
                 "This model was saved by a version without per-node statistics "
                 "(cover/base_weight); prediction contributions would be "
                 "all-zero. Re-train or re-save the model with this version."
             )
+
+    def predict_contribs_np(
+        self, x: np.ndarray, ntree_limit: int = 0,
+        base_margin: Optional[np.ndarray] = None,
+        approx: bool = False,
+    ) -> np.ndarray:
+        """Per-feature contributions [N, F+1] (binary/regression) or
+        [N, K, F+1] (multiclass), bias last; rows sum to the margin.
+        Exact TreeSHAP by default; ``approx=True`` selects the cheaper Saabas
+        path attribution (xgboost ``approx_contribs=True``)."""
+        self._assert_node_stats()
         n = x.shape[0]
         k = self.num_outputs
         m0 = self.base_score_margin_np()
         forest_dev = Tree(*[jnp.asarray(f) for f in self.forest])
+        kernel = (
+            predict_ops.predict_contribs
+            if approx
+            else predict_ops.predict_contribs_exact
+        )
+        chunk = _PREDICT_CHUNK if approx else _SHAP_CHUNK
         out = np.empty((n, k, self.num_features + 1), np.float32)
-        for lo in range(0, n, _PREDICT_CHUNK):
-            hi = min(lo + _PREDICT_CHUNK, n)
+        for lo in range(0, n, chunk):
+            hi = min(lo + chunk, n)
             out[lo:hi] = np.asarray(
-                predict_ops.predict_contribs(
+                kernel(
                     forest_dev,
                     jnp.asarray(x[lo:hi]),
                     max_depth=self.max_depth,
@@ -269,6 +283,43 @@ class RayXGBoostBooster:
             out[:, :, -1] += np.asarray(base_margin, np.float32).reshape(n, -1)
         return out[:, 0, :] if k == 1 else out
 
+    def predict_interactions_np(
+        self, x: np.ndarray, ntree_limit: int = 0,
+        base_margin: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """SHAP interaction values [N, F+1, F+1] (or [N, K, F+1, F+1]);
+        each feature row sums to that feature's plain contribution and the
+        grand total equals the margin (xgboost ``pred_interactions``)."""
+        self._assert_node_stats()
+        n = x.shape[0]
+        k = self.num_outputs
+        f1 = self.num_features + 1
+        m0 = self.base_score_margin_np()
+        forest_dev = Tree(*[jnp.asarray(f) for f in self.forest])
+        out = np.empty((n, k, f1, f1), np.float32)
+        for lo in range(0, n, _SHAP_CHUNK):
+            hi = min(lo + _SHAP_CHUNK, n)
+            out[lo:hi] = np.asarray(
+                predict_ops.predict_interactions(
+                    forest_dev,
+                    jnp.asarray(x[lo:hi]),
+                    max_depth=self.max_depth,
+                    num_outputs=k,
+                    num_parallel_tree=self.params.num_parallel_tree,
+                    ntree_limit=int(ntree_limit),
+                    tree_weights=(
+                        None
+                        if self.tree_weights is None
+                        else jnp.asarray(self.tree_weights)
+                    ),
+                    cat_features=self.cat_features,
+                )
+            )
+        out[:, :, -1, -1] += m0
+        if base_margin is not None:
+            out[:, :, -1, -1] += np.asarray(base_margin, np.float32).reshape(n, -1)
+        return out[:, 0] if k == 1 else out
+
     def predict(
         self,
         data,
@@ -283,28 +334,18 @@ class RayXGBoostBooster:
         approx_contribs: bool = False,
         **_ignored,
     ) -> np.ndarray:
-        if pred_contribs and not approx_contribs:
-            import warnings
-
-            warnings.warn(
-                "pred_contribs uses the Saabas path-attribution approximation "
-                "(xgboost's approx_contribs=True semantics); exact tree-SHAP "
-                "is not implemented. Pass approx_contribs=True to silence.",
-                UserWarning,
-                stacklevel=2,
-            )
-        if pred_interactions:
-            raise NotImplementedError(
-                "pred_interactions (SHAP interaction values) are not "
-                "implemented by the tpu_hist predictor yet."
-            )
         x = self._coerce_features(data)
-        if pred_contribs:
+        if pred_contribs or pred_interactions:
             booster = self
             if iteration_range is not None and iteration_range != (0, 0):
                 booster = self.slice_rounds(iteration_range[0], iteration_range[1])
+            if pred_interactions:
+                return booster.predict_interactions_np(
+                    x, ntree_limit=ntree_limit, base_margin=base_margin
+                )
             return booster.predict_contribs_np(
-                x, ntree_limit=ntree_limit, base_margin=base_margin
+                x, ntree_limit=ntree_limit, base_margin=base_margin,
+                approx=approx_contribs,
             )
         if pred_leaf:
             forest_dev = Tree(*[jnp.asarray(f) for f in self.forest])
