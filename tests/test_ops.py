@@ -500,3 +500,113 @@ def test_huber_slope_changes_model_and_sle_validates():
         train({"objective": "reg:squaredlogerror"},
               RayDMatrix(x, np.full(300, -2.0, np.float32)), 2,
               ray_params=RayParams(num_actors=2))
+
+
+def test_hist_missing_bucket_reconstruction():
+    """All impls build only the regular bins on the MXU and reconstruct the
+    missing bucket as node_total - sum(regular); verify against scatter."""
+    import numpy as np
+    import jax.numpy as jnp
+    from xgboost_ray_tpu.ops.histogram import (
+        hist_onehot, hist_partition, hist_scatter)
+
+    rng = np.random.RandomState(3)
+    n, f, nbt = 5000, 5, 17  # max_bin=16, bucket 16 == missing
+    bins = rng.randint(0, nbt, size=(n, f)).astype(np.int32)
+    gh = rng.randn(n, 2).astype(np.float32)
+    pos = rng.randint(0, 4, size=n).astype(np.int32)
+    ref = np.asarray(hist_scatter(jnp.asarray(bins), jnp.asarray(gh),
+                                  jnp.asarray(pos), 4, nbt))
+    assert np.abs(ref[:, :, nbt - 1, :]).max() > 0  # missing bucket populated
+    for impl in (hist_onehot, hist_partition):
+        got = np.asarray(impl(jnp.asarray(bins), jnp.asarray(gh),
+                              jnp.asarray(pos), 4, nbt))
+        np.testing.assert_allclose(got, ref, atol=2e-3)
+
+
+def test_hist_precision_param_accepted_and_fast_close():
+    """hist_precision plumbs through params; "fast" (bf16 one-hot + bf16 gh,
+    ~0.2% bin-sum rounding) must not change model QUALITY — individual
+    predictions may shift slightly where a split threshold moves by one bin."""
+    import numpy as np
+    from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+    rng = np.random.RandomState(4)
+    x = rng.randn(2000, 6).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    preds = {}
+    for prec in ("highest", "fast"):
+        bst = train({"objective": "binary:logistic", "max_depth": 4,
+                     "hist_precision": prec, "hist_impl": "onehot"},
+                    RayDMatrix(x, y), 5,
+                    ray_params=RayParams(num_actors=2))
+        preds[prec] = bst.predict(x)
+    # same hard labels, tiny mean probability shift
+    assert ((preds["fast"] > 0.5) == (preds["highest"] > 0.5)).mean() > 0.995
+    assert np.abs(preds["fast"] - preds["highest"]).mean() < 2e-3
+
+
+def test_select_small_child_rows_edges():
+    """Compaction helper: empty children, fully one-sided splits, sentinel
+    rows for unused capacity."""
+    import numpy as np
+    import jax.numpy as jnp
+    from xgboost_ray_tpu.ops.histogram import select_small_child_rows
+
+    # parent 0: all rows left (right child empty -> right is 'smaller');
+    # parent 1: 3 left / 5 right -> left smaller
+    pos = np.array([0] * 6 + [2] * 3 + [3] * 5, np.int32)
+    n = pos.shape[0]
+    order = np.argsort(pos, kind="stable").astype(np.int32)
+    counts = np.bincount(pos, minlength=4).astype(np.int32)
+    small_is_right = counts[1::2] <= counts[0::2]  # [True, False]
+    rows, pc, valid, counts_sel = map(np.asarray, select_small_child_rows(
+        jnp.asarray(order), jnp.asarray(counts), jnp.asarray(small_is_right)))
+    assert counts_sel.tolist() == [0, 3]
+    assert valid.sum() == 3
+    # the selected rows are exactly parent 1's left-child rows
+    assert set(rows[valid].tolist()) == set(np.where(pos == 2)[0].tolist())
+    assert (pc[valid] == 1).all()
+    # unused slots carry the sentinel row id n
+    assert (rows[~valid] == n).all()
+
+
+def test_sibling_compaction_overflow_falls_back():
+    """The smaller child is chosen from GLOBAL (allreduced) counts; on a
+    skewed shard its local rows can exceed the N//2 compaction buffer. Fake
+    the count allreduce so the 'global' choice is the locally-BIGGER child:
+    the lax.cond must fall back to the gh-zeroed full-row build and still
+    grow exactly the tree the direct (no-subtraction) build grows."""
+    import numpy as np
+    import jax.numpy as jnp
+    from xgboost_ray_tpu.ops import binning
+    from xgboost_ray_tpu.ops.grow import GrowConfig, build_tree
+    from xgboost_ray_tpu.ops.split import SplitParams
+
+    rng = np.random.RandomState(22)
+    x = rng.randn(1200, 5).astype(np.float32)
+    g = rng.randn(1200).astype(np.float32)
+    h = np.abs(rng.randn(1200)).astype(np.float32) + 0.5
+    cuts = binning.sketch_cuts_np(x, max_bin=32)
+    bins = binning.bin_matrix_np(x, cuts, max_bin=32)
+    gh = jnp.asarray(np.stack([g, h], 1))
+
+    def skew_allreduce(t):
+        # pretend a peer shard holds 3x this shard's rows with left/right
+        # swapped within every parent: the globally-smaller child becomes
+        # this shard's locally-bigger one
+        if t.ndim == 1 and t.shape[0] % 2 == 0:
+            swapped = t.reshape(-1, 2)[:, ::-1].reshape(-1)
+            return t + 3.0 * swapped
+        return t
+
+    outs = {}
+    for sib in (True, False):
+        cfg = GrowConfig(max_depth=5, max_bin=32,
+                         split=SplitParams(learning_rate=1.0),
+                         hist_impl="mixed", sibling_subtract=sib)
+        tree, rv = build_tree(jnp.asarray(bins), gh, jnp.asarray(cuts), cfg,
+                              allreduce=skew_allreduce)
+        outs[sib] = (np.asarray(tree.feature), np.asarray(rv))
+    np.testing.assert_array_equal(outs[True][0], outs[False][0])
+    np.testing.assert_allclose(outs[True][1], outs[False][1], atol=1e-3)

@@ -121,10 +121,12 @@ def main():
     # full tree builds (includes partition-order maintenance, split search)
     x = rng.randn(args.rows, args.features).astype(np.float32)
     cuts = jnp.asarray(binning.sketch_cuts_np(x[:100_000], args.max_bin))
-    for impl in args.impls + ["mixed"]:
+    for impl, prec in [(i, p) for i in args.impls + ["mixed"]
+                       for p in ("fast", "highest")]:
         try:
             cfg = GrowConfig(max_depth=args.depth, max_bin=args.max_bin,
-                             split=SplitParams(), hist_impl=impl)
+                             split=SplitParams(), hist_impl=impl,
+                             hist_precision=prec)
 
             def body(i, b, g0, c, cfg=cfg):
                 g = g0 + (i.astype(jnp.float32) * 1e-12)
@@ -133,10 +135,10 @@ def main():
 
             dt = _time_scanned(jax, jnp, body, (bins, gh, cuts),
                                max(2, args.repeats // 2), overhead)
-            print(f"  tree depth={args.depth} {impl:10s} {dt * 1e3:9.2f} ms",
-                  flush=True)
+            print(f"  tree depth={args.depth} {impl:10s} {prec:8s} "
+                  f"{dt * 1e3:9.2f} ms", flush=True)
         except Exception as exc:  # noqa: BLE001
-            print(f"  tree depth={args.depth} {impl:10s} FAILED: "
+            print(f"  tree depth={args.depth} {impl:10s} {prec:8s} FAILED: "
                   f"{str(exc)[:120]}", flush=True)
 
 

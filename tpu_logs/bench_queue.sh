@@ -1,0 +1,7 @@
+#!/bin/bash
+cd /root/repo
+export PYTHONPATH=/root/repo:${PYTHONPATH}
+L=/root/repo/tpu_logs
+echo "=== bench 1M/10r start $(date +%T) ===" >> $L/bench.log
+BENCH_ROWS=1000000 BENCH_ROUNDS=10 timeout 2400 python bench.py >> $L/bench.log 2>&1
+echo "=== exit=$? $(date +%T) ===" >> $L/bench.log

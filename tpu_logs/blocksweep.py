@@ -1,0 +1,55 @@
+"""Pallas block-size sweep at 1M rows, 16 nodes (presorted, bins_rows)."""
+import time
+import numpy as np, jax, jax.numpy as jnp
+assert jax.default_backend() == "tpu"
+from xgboost_ray_tpu.ops import hist_pallas as hp
+
+def overhead():
+    f = jax.jit(lambda x: x + 1.0); x = jnp.float32(0.0); float(f(x))
+    t0 = time.time()
+    for _ in range(3): float(f(x))
+    return (time.time() - t0) / 3
+
+OH = overhead()
+rng = np.random.RandomState(0)
+N, F, NBT, NODES = 1_000_000, 28, 257, 16
+bins = jnp.asarray(rng.randint(0, NBT, size=(N, F)).astype(np.int16))
+gh0 = jnp.asarray(rng.randn(N, 2).astype(np.float32))
+pos = rng.randint(0, NODES, size=N).astype(np.int32)
+order = jnp.asarray(np.argsort(pos, kind="stable").astype(np.int32))
+counts = jnp.asarray(np.bincount(pos, minlength=NODES).astype(np.int32))
+
+for block in (256, 512, 1024, 2048):
+    for prec in ("fast", "highest"):
+        def body(i, b, g, o, c, block=block, prec=prec):
+            g = g + i.astype(jnp.float32) * 1e-12
+            return hp.hist_pallas_presorted(b, g, o, c, NODES, NBT,
+                                            block=block, precision=prec).sum()
+        try:
+            fn = jax.jit(body)
+            float(fn(jnp.int32(0), bins, gh0, order, counts))
+            def prog(seed, b, g, o, c):
+                def sbody(carry, i): return carry + body(i, b, g, o, c), None
+                tot, _ = jax.lax.scan(sbody, jnp.float32(0.0), jnp.arange(8, dtype=jnp.int32))
+                return tot + seed
+            pfn = jax.jit(prog); float(pfn(jnp.float32(0.0), bins, gh0, order, counts))
+            t0 = time.time(); float(pfn(jnp.float32(1.0), bins, gh0, order, counts))
+            dt = max(0.0, time.time() - t0 - OH) / 8
+            print(f"block={block:5d} prec={prec:8s} {dt*1e3:8.2f} ms", flush=True)
+        except Exception as e:
+            print(f"block={block:5d} prec={prec:8s} FAILED {str(e)[:100]}", flush=True)
+# onehot after feature tiling
+from xgboost_ray_tpu.ops.histogram import hist_onehot
+pos1 = jnp.zeros((N,), jnp.int32)
+for prec in ("fast", "highest"):
+    def body(i, b, g, prec=prec):
+        g = g + i.astype(jnp.float32) * 1e-12
+        return hist_onehot(b, g, pos1, 1, NBT, precision=prec).sum()
+    fn = jax.jit(body); float(fn(jnp.int32(0), bins, gh0))
+    def prog(seed, b, g):
+        def sbody(carry, i): return carry + body(i, b, g), None
+        tot, _ = jax.lax.scan(sbody, jnp.float32(0.0), jnp.arange(8, dtype=jnp.int32))
+        return tot + seed
+    pfn = jax.jit(prog); float(pfn(jnp.float32(0.0), bins, gh0))
+    t0 = time.time(); float(pfn(jnp.float32(1.0), bins, gh0))
+    print(f"onehot_ftile 1node {prec:8s} {max(0.0,(time.time()-t0-OH))/8*1e3:8.2f} ms", flush=True)

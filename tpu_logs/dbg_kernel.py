@@ -1,0 +1,35 @@
+"""Localize the pallas kernel bug: layout x precision matrix on-chip."""
+import numpy as np, jax, jax.numpy as jnp
+assert jax.default_backend() == "tpu"
+from xgboost_ray_tpu.ops import hist_pallas as hp
+from xgboost_ray_tpu.ops.histogram import hist_scatter
+
+def case(n, f, nbt, n_nodes, seed, block=256):
+    rng = np.random.RandomState(seed)
+    bins = jnp.asarray(rng.randint(0, nbt, size=(n, f)).astype(np.int32))
+    gh = jnp.asarray(np.round(rng.randn(n, 2) * 4).astype(np.float32))  # small ints: bf16-exact
+    pos = jnp.asarray(rng.randint(0, n_nodes, size=n).astype(np.int32))
+    want = np.asarray(hist_scatter(bins, gh, pos, n_nodes, nbt))
+    for lay in ("bins_lanes", "bins_rows"):
+        for prec in ("highest", "fast"):
+            try:
+                got = np.asarray(hp.hist_pallas(bins, gh, pos, n_nodes, nbt,
+                                                block=block, precision=prec, layout=lay))
+                d = np.abs(got - want)
+                tag = f"n={n} f={f} nbt={nbt} nodes={n_nodes} {lay:10s} {prec:8s}"
+                print(f"{tag} maxdiff={d.max():.3e}", flush=True)
+                if d.max() > 1e-3 and n <= 2048:
+                    idx = np.unravel_index(np.argmax(d), d.shape)
+                    node, feat = idx[0], idx[1]
+                    print("   worst idx:", idx, flush=True)
+                    print("   want:", want[node, feat, :10, 0], flush=True)
+                    print("   got :", got[node, feat, :10, 0], flush=True)
+                    wrong = np.where(d[node, feat, :, 0] > 1e-3)[0]
+                    print("   wrong bins:", wrong[:25], flush=True)
+            except Exception as e:
+                print(f"{lay} {prec} EXC: {str(e)[:140]}", flush=True)
+
+case(512, 1, 9, 1, 0)
+case(2048, 3, 9, 4, 3)
+case(1024, 2, 257, 1, 4)
+case(200_000, 28, 257, 1, 5)

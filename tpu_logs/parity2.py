@@ -1,0 +1,19 @@
+"""On-TPU parity of the re-aligned pallas kernel, both precisions."""
+import numpy as np, jax, jax.numpy as jnp
+assert jax.default_backend() == "tpu"
+from xgboost_ray_tpu.ops.histogram import hist_scatter
+from xgboost_ray_tpu.ops import hist_pallas as hp
+
+rng = np.random.RandomState(0)
+rows, feats, nbt = 200_000, 28, 257
+bins = jnp.asarray(rng.randint(0, nbt, size=(rows, feats)).astype(np.int32))
+gh = jnp.asarray(rng.randn(rows, 2).astype(np.float32))
+for n_nodes in (1, 8, 16):
+    pos = jnp.asarray(rng.randint(0, n_nodes, size=rows).astype(np.int32))
+    hs = np.asarray(hist_scatter(bins, gh, pos, n_nodes, nbt))
+    scale = max(1e-9, float(np.abs(hs).max()))
+    for prec, tol in (("highest", 2e-5), ("fast", 5e-3)):
+        hp_out = np.asarray(hp.hist_pallas(bins, gh, pos, n_nodes, nbt, precision=prec))
+        rel = float(np.abs(hp_out - hs).max()) / scale
+        print(f"n_nodes={n_nodes} prec={prec:8s} rel={rel:.2e} "
+              f"{'PARITY_OK' if rel < tol else 'PARITY_FAIL'}", flush=True)

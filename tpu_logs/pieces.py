@@ -1,0 +1,56 @@
+"""Time each component of a level build at 1M rows on TPU."""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+assert jax.default_backend() == "tpu"
+from xgboost_ray_tpu.ops.histogram import (
+    hist_onehot, hist_partition_presorted, presorted_block_layout,
+    select_small_child_rows, update_partition_order, _blocked_hist)
+from xgboost_ray_tpu.ops import hist_pallas as hp
+
+def overhead():
+    f = jax.jit(lambda x: x + 1.0); x = jnp.float32(0.0); float(f(x))
+    t0 = time.time()
+    for _ in range(3): float(f(x))
+    return (time.time() - t0) / 3
+
+def timeit(name, fn, *ops, repeats=8):
+    jfn = jax.jit(lambda i, *a: fn(i, *a))
+    float(jfn(jnp.int32(0), *ops))
+    t0 = time.time(); v = float(jfn(jnp.int32(1), *ops)); t1 = max(0.0, time.time()-t0-OH)
+    if t1 > 2.0:
+        print(f"{name:28s} {t1*1e3:9.2f} ms", flush=True); return
+    def prog(seed, *a):
+        def body(c, i): return c + fn(i, *a), None
+        tot, _ = jax.lax.scan(body, jnp.float32(0.0), jnp.arange(repeats, dtype=jnp.int32))
+        return tot + seed
+    pfn = jax.jit(prog); float(pfn(jnp.float32(0.0), *ops))
+    t0 = time.time(); float(pfn(jnp.float32(1.0), *ops))
+    print(f"{name:28s} {max(0.0,(time.time()-t0-OH))/repeats*1e3:9.2f} ms", flush=True)
+
+OH = overhead()
+print(f"overhead {OH*1e3:.1f} ms", flush=True)
+rng = np.random.RandomState(0)
+N, F, NBT = 1_000_000, 28, 257
+bins = jnp.asarray(rng.randint(0, NBT, size=(N, F)).astype(np.int32))
+gh0 = jnp.asarray(rng.randn(N, 2).astype(np.float32))
+n_nodes = 16
+pos = jnp.asarray(rng.randint(0, n_nodes, size=N).astype(np.int32))
+order = jnp.asarray(np.argsort(np.asarray(pos), kind="stable").astype(np.int32))
+counts = jnp.asarray(np.bincount(np.asarray(pos), minlength=n_nodes).astype(np.int32))
+go_right = jnp.asarray((rng.rand(N) > 0.5))
+sir = jnp.asarray((rng.rand(n_nodes // 2) > 0.5))
+
+def p(i): return (i.astype(jnp.float32) * 1e-12)
+
+timeit("update_partition_order", lambda i, o, c, g: update_partition_order(o, c, g)[0].sum().astype(jnp.float32), order, counts, go_right)
+timeit("select_small_child", lambda i, o, c, s: select_small_child_rows(o, c, s)[0].sum().astype(jnp.float32), order, counts, sir)
+timeit("gather_bins_half", lambda i, b, r: b[r].sum().astype(jnp.float32), bins, jnp.arange(N // 2, dtype=jnp.int32))
+timeit("block_layout", lambda i, b, g, o, c: presorted_block_layout(b, g + p(i), o, c, n_nodes, 256)[1].sum(), bins, gh0, order, counts)
+timeit("hist_presorted_highest", lambda i, b, g, o, c: hist_partition_presorted(b, g + p(i), o, c, n_nodes, NBT, precision="highest").sum(), bins, gh0, order, counts)
+timeit("hist_presorted_fast", lambda i, b, g, o, c: hist_partition_presorted(b, g + p(i), o, c, n_nodes, NBT, precision="fast").sum(), bins, gh0, order, counts)
+timeit("pallas_presorted_highest", lambda i, b, g, o, c: hp.hist_pallas_presorted(b, g + p(i), o, c, n_nodes, NBT, precision="highest").sum(), bins, gh0, order, counts)
+timeit("pallas_presorted_fast", lambda i, b, g, o, c: hp.hist_pallas_presorted(b, g + p(i), o, c, n_nodes, NBT, precision="fast").sum(), bins, gh0, order, counts)
+timeit("onehot_1node_highest", lambda i, b, g: hist_onehot(b, g + p(i), jnp.zeros((N,), jnp.int32), 1, NBT, precision="highest").sum(), bins, gh0)
+timeit("onehot_1node_fast", lambda i, b, g: hist_onehot(b, g + p(i), jnp.zeros((N,), jnp.int32), 1, NBT, precision="fast").sum(), bins, gh0)
+timeit("pallas_1node_fast", lambda i, b, g: hp.hist_pallas(b, g + p(i), jnp.zeros((N,), jnp.int32), 1, NBT, precision="fast").sum(), bins, gh0)

@@ -1,0 +1,9 @@
+import json, time, sys
+t0 = time.time()
+try:
+    import jax
+    devs = jax.devices()
+    out = {"ok": True, "devices": [str(d) for d in devs], "platform": devs[0].platform, "t_init_s": round(time.time()-t0, 1)}
+except Exception as e:
+    out = {"ok": False, "error": repr(e)[:500], "t_init_s": round(time.time()-t0, 1)}
+print(json.dumps(out), flush=True)

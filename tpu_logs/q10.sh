@@ -1,0 +1,8 @@
+#!/bin/bash
+cd /root/repo
+export PYTHONPATH=/root/repo:${PYTHONPATH}
+L=/root/repo/tpu_logs
+while ! grep -q "Q9 ALL DONE" $L/r2.log; do sleep 20; done
+run() { echo "=== $1 start $(date +%T) ===" >> $L/r2.log; timeout "$2" "${@:3}" >> $L/r2.log 2>&1; echo "=== $1 exit=$? $(date +%T) ===" >> $L/r2.log; }
+run mslr 3600 python tests/release/benchmark_ranking.py 1 100 --groups 31000 --group-size 120
+echo "Q10 ALL DONE $(date +%T)" >> $L/r2.log

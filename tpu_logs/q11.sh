@@ -1,0 +1,13 @@
+#!/bin/bash
+# contingency: if steady2/higgs_full2 failed or timed out, retry with the
+# pallas kernel disabled (einsum deep path) to isolate infra hangs
+cd /root/repo
+export PYTHONPATH=/root/repo:${PYTHONPATH}
+L=/root/repo/tpu_logs
+while ! grep -q "Q10 ALL DONE" $L/r2.log; do sleep 30; done
+run() { echo "=== $1 start $(date +%T) ===" >> $L/r2.log; timeout "$2" "${@:3}" >> $L/r2.log 2>&1; echo "=== $1 exit=$? $(date +%T) ===" >> $L/r2.log; }
+if ! grep -q "higgs11m_100r_train_wall_clock" $L/r2.log; then
+  export RXGB_DISABLE_PALLAS=1
+  run higgs_nopallas 4500 python bench.py
+fi
+echo "Q11 ALL DONE $(date +%T)" >> $L/r2.log

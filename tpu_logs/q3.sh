@@ -1,0 +1,11 @@
+#!/bin/bash
+cd /root/repo
+export PYTHONPATH=/root/repo:${PYTHONPATH}
+L=/root/repo/tpu_logs
+run() { echo "=== $1 start $(date +%T) ===" >> $L/r2.log; timeout "$2" "${@:3}" >> $L/r2.log 2>&1; echo "=== $1 exit=$? $(date +%T) ===" >> $L/r2.log; }
+run parity2 1800 python tpu_logs/parity2.py
+for impl in pallas partition onehot; do
+  run hist2_$impl 2400 python tools/bench_hist.py --impls $impl
+done
+run quality 2400 python tpu_logs/quality_fast.py
+echo "Q3 ALL DONE $(date +%T)" >> $L/r2.log

@@ -1,0 +1,10 @@
+#!/bin/bash
+# wait for q3 to finish (single-client tunnel), then run parity2 + pieces
+cd /root/repo
+export PYTHONPATH=/root/repo:${PYTHONPATH}
+L=/root/repo/tpu_logs
+while ! grep -q "Q3 ALL DONE" $L/r2.log; do sleep 20; done
+run() { echo "=== $1 start $(date +%T) ===" >> $L/r2.log; timeout "$2" "${@:3}" >> $L/r2.log 2>&1; echo "=== $1 exit=$? $(date +%T) ===" >> $L/r2.log; }
+run parity2b 1800 python tpu_logs/parity2.py
+run pieces 2400 python tpu_logs/pieces.py
+echo "Q4 ALL DONE $(date +%T)" >> $L/r2.log

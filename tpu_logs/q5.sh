@@ -1,0 +1,9 @@
+#!/bin/bash
+cd /root/repo
+export PYTHONPATH=/root/repo:${PYTHONPATH}
+L=/root/repo/tpu_logs
+while ! grep -q "Q4 ALL DONE" $L/r2.log; do sleep 20; done
+run() { echo "=== $1 start $(date +%T) ===" >> $L/r2.log; timeout "$2" "${@:3}" >> $L/r2.log 2>&1; echo "=== $1 exit=$? $(date +%T) ===" >> $L/r2.log; }
+run steady 2400 python tpu_logs/steady.py
+run higgs_full 4500 python bench.py
+echo "Q5 ALL DONE $(date +%T)" >> $L/r2.log

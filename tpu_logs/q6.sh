@@ -1,0 +1,8 @@
+#!/bin/bash
+cd /root/repo
+export PYTHONPATH=/root/repo:${PYTHONPATH}
+L=/root/repo/tpu_logs
+while ! grep -q "Q5 ALL DONE" $L/r2.log; do sleep 20; done
+run() { echo "=== $1 start $(date +%T) ===" >> $L/r2.log; timeout "$2" "${@:3}" >> $L/r2.log 2>&1; echo "=== $1 exit=$? $(date +%T) ===" >> $L/r2.log; }
+run dbg_kernel 1800 python tpu_logs/dbg_kernel.py
+echo "Q6 ALL DONE $(date +%T)" >> $L/r2.log

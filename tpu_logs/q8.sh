@@ -1,0 +1,10 @@
+#!/bin/bash
+cd /root/repo
+export PYTHONPATH=/root/repo:${PYTHONPATH}
+L=/root/repo/tpu_logs
+while ! grep -q "Q7 ALL DONE" $L/r2.log; do sleep 20; done
+run() { echo "=== $1 start $(date +%T) ===" >> $L/r2.log; timeout "$2" "${@:3}" >> $L/r2.log 2>&1; echo "=== $1 exit=$? $(date +%T) ===" >> $L/r2.log; }
+run parity3 1800 python tpu_logs/parity2.py
+run steady2 2400 python tpu_logs/steady.py
+run higgs_full2 4500 python bench.py
+echo "Q8 ALL DONE $(date +%T)" >> $L/r2.log

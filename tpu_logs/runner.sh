@@ -1,0 +1,16 @@
+#!/bin/bash
+# Serialized TPU run queue — the tunnel is single-client; never overlap.
+cd /root/repo
+export PYTHONPATH=/root/repo:${PYTHONPATH}
+L=/root/repo/tpu_logs
+run() {  # run <name> <timeout_s> <cmd...>
+  echo "=== $1 start $(date +%T) ===" >> $L/runner.log
+  timeout "$2" "${@:3}" >> $L/runner.log 2>&1
+  echo "=== $1 exit=$? $(date +%T) ===" >> $L/runner.log
+}
+run smoke 1200 python tpu_logs/smoke.py
+for impl in scatter onehot partition pallas; do
+  run hist_$impl 2400 python tools/bench_hist.py --impls $impl
+done
+run pallas_parity 1200 python tpu_logs/pallas_parity.py
+echo "ALL DONE $(date +%T)" >> $L/runner.log

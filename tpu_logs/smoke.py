@@ -1,0 +1,24 @@
+"""On-TPU end-to-end smoke: small training run, accuracy sanity."""
+import time
+import numpy as np
+import jax
+assert jax.default_backend() == "tpu", jax.default_backend()
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+rng = np.random.RandomState(0)
+n = 200_000
+x = rng.standard_normal((n, 28)).astype(np.float32)
+logits = 0.8*x[:,0] - 0.6*x[:,1] + 0.4*x[:,2]*x[:,3] + 0.3*x[:,4]
+y = (logits + rng.standard_normal(n).astype(np.float32) > 0).astype(np.float32)
+dtrain = RayDMatrix(x, y)
+res = {}
+t0 = time.time()
+bst = train({"objective": "binary:logistic", "eval_metric": ["logloss", "error"],
+             "max_depth": 6, "eta": 0.3, "max_bin": 256, "tree_method": "tpu_hist"},
+            dtrain, num_boost_round=20,
+            evals=[(dtrain, "train")], evals_result=res,
+            ray_params=RayParams(num_actors=1, checkpoint_frequency=0))
+dt = time.time() - t0
+err = res["train"]["error"][-1]
+print(f"SMOKE rounds=20 wall={dt:.1f}s final_train_error={err:.4f} "
+      f"{'SMOKE_OK' if err < 0.25 else 'SMOKE_BAD'}", flush=True)

@@ -1,0 +1,19 @@
+"""Separate compile cost from steady-state per-round cost at 1M rows."""
+import time
+import numpy as np, jax
+assert jax.default_backend() == "tpu"
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+rng = np.random.RandomState(0)
+n = 1_000_000
+x = rng.standard_normal((n, 28)).astype(np.float32)
+y = (0.8*x[:,0] - 0.6*x[:,1] + 0.4*x[:,2]*x[:,3] > 0).astype(np.float32)
+for rounds in (8, 40):
+    add = {}
+    t0 = time.time()
+    train({"objective": "binary:logistic", "max_depth": 6, "eta": 0.1,
+           "tree_method": "tpu_hist"}, RayDMatrix(x, y), rounds,
+          additional_results=add,
+          ray_params=RayParams(num_actors=1, checkpoint_frequency=0))
+    print(f"rounds={rounds} wall={time.time()-t0:.1f}s "
+          f"train={add['training_time_s']:.1f}s", flush=True)

@@ -1,0 +1,24 @@
+"""Per-phase timing of train() on TPU: where do the seconds go?"""
+import time, os
+import numpy as np
+import jax
+assert jax.default_backend() == "tpu"
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+rng = np.random.RandomState(0)
+n = 1_000_000
+x = rng.standard_normal((n, 28)).astype(np.float32)
+y = (0.8*x[:,0] - 0.6*x[:,1] + 0.4*x[:,2]*x[:,3] > 0).astype(np.float32)
+
+t0 = time.time()
+dtrain = RayDMatrix(x, y)
+add = {}
+bst = train({"objective": "binary:logistic", "max_depth": 6, "eta": 0.1,
+             "max_bin": 256, "tree_method": "tpu_hist"},
+            dtrain, num_boost_round=16,
+            additional_results=add,
+            ray_params=RayParams(num_actors=1, checkpoint_frequency=0))
+total = time.time() - t0
+rt = add.get("round_times_s", [])
+print(f"total={total:.1f}s training_time={add.get('training_time_s'):.1f}s")
+print("round_times_s:", " ".join(f"{t:.2f}" for t in rt))

@@ -26,6 +26,7 @@ from xgboost_ray_tpu.ops.histogram import (
     build_histogram,
     hist_onehot,
     hist_partition_presorted,
+    select_small_child_rows,
     node_sums,
     update_partition_order,
 )
@@ -91,6 +92,9 @@ class GrowConfig:
     split: SplitParams = dataclasses.field(default_factory=SplitParams)
     hist_impl: str = "scatter"
     hist_chunk: int = 8192
+    # "highest": f32-exact histogram sums (bf16x3 MXU passes); "fast": one
+    # rounded bf16 pass (~0.2% relative error on bin sums, 2-3x fewer passes)
+    hist_precision: str = "highest"
     # Build only the globally-smaller child's histogram per parent and derive
     # the sibling as parent - child (xgboost hist/gpu_hist's core trick):
     # halves the built/allreduced histogram tensor at every level >= 1, and
@@ -177,29 +181,53 @@ def build_tree(
         n_nodes = 1 << d
         base = n_nodes - 1
 
-        def _build(gh_b, pos_b, order_b, counts_b, nn):
+        def _use_pallas() -> bool:
+            """Kernel is TPU-only (pltpu grid spec); other backends use the
+            identical-layout XLA einsum. RXGB_DISABLE_PALLAS opts out."""
+            import os
+
+            if os.environ.get("RXGB_DISABLE_PALLAS"):
+                return False
+            try:
+                from xgboost_ray_tpu.ops import hist_pallas as hp
+
+                return hp.PALLAS_AVAILABLE and jax.default_backend() == "tpu"
+            except Exception:
+                return False
+
+        def _build(gh_b, pos_b, order_b, counts_b, nn, bins_b=None):
             """One histogram build over nn node slots with the configured impl."""
-            if cfg.hist_impl == "pallas":
-                use_pallas = False
-                try:
+            bins_in = bins if bins_b is None else bins_b
+
+            def presorted(use_pallas: bool):
+                if use_pallas:
                     from xgboost_ray_tpu.ops import hist_pallas as hp
 
-                    # the kernel is TPU-only (pltpu grid spec); other backends
-                    # fall back to the identical-layout XLA einsum formulation
-                    use_pallas = hp.PALLAS_AVAILABLE and jax.default_backend() == "tpu"
-                except Exception:
-                    pass
-                if use_pallas:
                     return hp.hist_pallas_presorted(
-                        bins, gh_b, order_b, counts_b, nn, nbt
+                        bins_in, gh_b, order_b, counts_b, nn, nbt,
+                        precision=cfg.hist_precision,
                     )
-                return hist_partition_presorted(bins, gh_b, order_b, counts_b, nn, nbt)
-            if track_order and (cfg.hist_impl == "partition" or nn > 4):
-                return hist_partition_presorted(bins, gh_b, order_b, counts_b, nn, nbt)
+                return hist_partition_presorted(
+                    bins_in, gh_b, order_b, counts_b, nn, nbt,
+                    precision=cfg.hist_precision,
+                )
+
+            if cfg.hist_impl == "pallas":
+                return presorted(_use_pallas())
             if cfg.hist_impl == "mixed":
-                return hist_onehot(bins, gh_b, pos_b, nn, nbt, chunk=cfg.hist_chunk)
+                # measured on v5e (1M x 28 x 256): one-hot wins at tiny node
+                # fan-out (cost scales with nn), the fused block kernel is
+                # flat beyond; einsum fallback off-TPU
+                if nn <= 2:
+                    return hist_onehot(bins_in, gh_b, pos_b, nn, nbt,
+                                       chunk=cfg.hist_chunk,
+                                       precision=cfg.hist_precision)
+                return presorted(_use_pallas())
+            if track_order and cfg.hist_impl == "partition":
+                return presorted(False)
             return build_histogram(
-                bins, gh_b, pos_b, nn, nbt, impl=cfg.hist_impl, chunk=cfg.hist_chunk,
+                bins_in, gh_b, pos_b, nn, nbt, impl=cfg.hist_impl,
+                chunk=cfg.hist_chunk, precision=cfg.hist_precision,
             )
 
         if cfg.sibling_subtract and d > 0 and prev_hist is not None:
@@ -216,16 +244,47 @@ def build_tree(
             )
             # [n_par] True when the right child is the (weakly) smaller one
             small_is_right = child_counts[1::2] <= child_counts[0::2]
-            parent_pos = pos >> 1
-            is_right = (pos & 1).astype(bool)
-            sel = (is_right == small_is_right[parent_pos]) & ~done
-            gh_sel = gh * sel[:, None].astype(gh.dtype)
-            counts_par = (
-                counts.reshape(-1, 2).sum(axis=1) if track_order else None
-            )
-            hist_small = allreduce(
-                _build(gh_sel, parent_pos, order, counts_par, n_par)
-            )
+            if track_order:
+                # compact the smaller child's rows into an [N // 2] buffer so
+                # every impl processes HALF the rows (vs just zeroing gh).
+                # The child choice is GLOBAL (allreduced counts), so on a
+                # skewed shard the chosen children's LOCAL rows can exceed
+                # N // 2 — lax.cond falls back to the gh-zeroed full-row
+                # build there (shard-local control flow; the psum sits
+                # outside and runs on every shard either way).
+                rows, par_of_slot, valid_sel, counts_sel = (
+                    select_small_child_rows(order, counts, small_is_right)
+                )
+                fits = counts_sel.sum() <= rows.shape[0]
+
+                def _compacted(_):
+                    rows_c = jnp.minimum(rows, n - 1)
+                    keep = valid_sel & ~done[rows_c]
+                    bins_sel = bins[rows_c]
+                    gh_sel = gh[rows_c] * keep[:, None].astype(gh.dtype)
+                    return _build(gh_sel, par_of_slot,
+                                  jnp.arange(rows_c.shape[0], dtype=jnp.int32),
+                                  counts_sel, n_par, bins_b=bins_sel)
+
+                def _zeroed(_):
+                    parent_pos = pos >> 1
+                    is_right = (pos & 1).astype(bool)
+                    sel = (is_right == small_is_right[parent_pos]) & ~done
+                    gh_sel = gh * sel[:, None].astype(gh.dtype)
+                    counts_par = counts.reshape(-1, 2).sum(axis=1)
+                    return _build(gh_sel, parent_pos, order, counts_par, n_par)
+
+                hist_small = allreduce(
+                    jax.lax.cond(fits, _compacted, _zeroed, None)
+                )
+            else:
+                parent_pos = pos >> 1
+                is_right = (pos & 1).astype(bool)
+                sel = (is_right == small_is_right[parent_pos]) & ~done
+                gh_sel = gh * sel[:, None].astype(gh.dtype)
+                hist_small = allreduce(
+                    _build(gh_sel, parent_pos, None, None, n_par)
+                )
             hist_big = prev_hist - hist_small
             sir = small_is_right[:, None, None, None]
             left = jnp.where(sir, hist_big, hist_small)

@@ -28,21 +28,65 @@ except Exception:  # pragma: no cover
     PALLAS_AVAILABLE = False
 
 
-def _kernel(node_ref, init_ref, bp_ref, ghp_ref, out_ref, *, n_bins_total, n_features):
+def _kernel(node_ref, init_ref, bp_ref, ghp_ref, out_ref, *, nb_reg,
+            n_features, precision):
     # bp_ref: [1, block, F] int32; ghp_ref: [1, block, 2] f32
     # init_ref aliases out_ref (zero-initialized accumulator); unused directly
-    # out_ref: [1, F, n_bins_total, 2] f32 (accumulate)
+    # out_ref: [1, F, 2, nb_reg] f32 (accumulate) — bins ride the 128-lane
+    # axis (nb_reg is a lane multiple for the default max_bin=256); the
+    # missing bucket is reconstructed by subtraction outside the kernel.
     del init_ref
     gh = ghp_ref[0]  # [block, 2]
-    bins_ids = jax.lax.broadcasted_iota(jnp.int32, (1, n_bins_total), 1)
+    # "highest": split gh into two bf16-exact terms (hi + lo carries a 16-bit
+    # mantissa — sums over millions of O(1) grads stay f32-accurate) so each
+    # MXU pass is lossless; the one-hot operand is exact in bf16 already.
+    # "fast": one pass on bf16-rounded gh (~0.2% per-entry rounding).
+    # (Mosaic rejects per-operand Precision, so the split is done by hand.)
+    if precision == "highest":
+        gh_hi = gh.astype(jnp.bfloat16).astype(jnp.float32)
+        gh_terms = (gh_hi, gh - gh_hi)
+    else:
+        gh_terms = (gh,)
+    bins_ids = jax.lax.broadcasted_iota(jnp.int32, (1, nb_reg), 1)
     for f in range(n_features):
-        col = bp_ref[0, :, f][:, None]  # [block, 1]
-        oh = (col == bins_ids).astype(jnp.float32)  # [block, nbt]
-        contrib = jax.lax.dot_general(
-            oh,
-            gh,
-            (((0,), (0,)), ((), ())),  # contract over rows -> [nbt, 2]
-            preferred_element_type=jnp.float32,
+        col = bp_ref[0, :, f][:, None].astype(jnp.int32)  # [block, 1]
+        # missing rows (bin == nb_reg) match no iota value -> all-zero row
+        oh = (col == bins_ids).astype(jnp.float32)  # [block, nb_reg]
+        contrib = sum(
+            jax.lax.dot_general(
+                term,
+                oh,
+                (((0,), (0,)), ((), ())),  # contract over rows -> [2, nb_reg]
+                preferred_element_type=jnp.float32,
+            )
+            for term in gh_terms
+        )
+        out_ref[0, f, :, :] += contrib
+
+
+def _kernel_binrows(node_ref, init_ref, bp_ref, ghp_ref, out_ref, *, nb_reg,
+                    n_features, precision):
+    """Variant with the round-1-proven output orientation: out block
+    [1, F, nb_reg, 2] (bins on sublanes, gh pair on lanes)."""
+    del init_ref
+    gh = ghp_ref[0]  # [block, 2]
+    if precision == "highest":
+        gh_hi = gh.astype(jnp.bfloat16).astype(jnp.float32)
+        gh_terms = (gh_hi, gh - gh_hi)
+    else:
+        gh_terms = (gh,)
+    bins_ids = jax.lax.broadcasted_iota(jnp.int32, (1, nb_reg), 1)
+    for f in range(n_features):
+        col = bp_ref[0, :, f][:, None].astype(jnp.int32)  # [block, 1]
+        oh = (col == bins_ids).astype(jnp.float32)  # [block, nb_reg]
+        contrib = sum(
+            jax.lax.dot_general(
+                oh,
+                term,
+                (((0,), (0,)), ((), ())),  # contract over rows -> [nb_reg, 2]
+                preferred_element_type=jnp.float32,
+            )
+            for term in gh_terms
         )
         out_ref[0, f, :, :] += contrib
 
@@ -54,19 +98,35 @@ def hist_pallas_blocks(
     n_nodes: int,
     n_bins_total: int,
     interpret: bool = False,
+    precision: str = "highest",
+    layout: str = "bins_rows",  # "bins_rows" ([F,nb,2]) | "bins_lanes" ([F,2,nb])
+    # bins_rows is the default: the bins_lanes orientation (2-sublane output
+    # tile) miscompiles on real TPU — wrong sums at nb_reg < 128 and at
+    # large grid sizes (observed v5e, 2026-07); pass counts are identical.
 ) -> jnp.ndarray:
     """Accumulate per-node histograms from node-uniform blocks.
 
-    Returns [n_nodes + 1, F, n_bins_total, 2]; row n_nodes is the scratch row
-    for padding blocks.
+    The kernel builds only the ``n_bins_total - 1`` regular bins (keeping the
+    lane dimension 128-aligned); the missing bucket is reconstructed as
+    node_total - sum(regular bins). Returns [n_nodes + 1, F, n_bins_total, 2];
+    row n_nodes is the scratch row for padding blocks.
     """
     n_blocks, block, n_features = bp.shape
-    out_init = jnp.zeros((n_nodes + 1, n_features, n_bins_total, 2), jnp.float32)
-    kernel = functools.partial(
-        _kernel, n_bins_total=n_bins_total, n_features=n_features
-    )
+    nb_reg = n_bins_total - 1
+    if layout == "bins_lanes":
+        out_dims = (2, nb_reg)
+        kernel = functools.partial(
+            _kernel, nb_reg=nb_reg, n_features=n_features, precision=precision
+        )
+    else:
+        out_dims = (nb_reg, 2)
+        kernel = functools.partial(
+            _kernel_binrows, nb_reg=nb_reg, n_features=n_features,
+            precision=precision,
+        )
+    out_init = jnp.zeros((n_nodes + 1, n_features) + out_dims, jnp.float32)
     out_block_spec = pl.BlockSpec(
-        (1, n_features, n_bins_total, 2), lambda i, node: (node[i], 0, 0, 0)
+        (1, n_features) + out_dims, lambda i, node: (node[i], 0, 0, 0)
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -78,13 +138,22 @@ def hist_pallas_blocks(
         ],
         out_specs=out_block_spec,
     )
-    return pl.pallas_call(
+    hist_reg = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(out_init.shape, jnp.float32),
         input_output_aliases={1: 0},  # out_init (after the scalar operand)
         interpret=interpret,
     )(node_of_block, out_init, bp, ghp)
+    if layout == "bins_lanes":
+        hist_reg = hist_reg.transpose(0, 1, 3, 2)  # [nodes+1, F, nb_reg, 2]
+    from xgboost_ray_tpu.ops.histogram import (
+        _append_missing,
+        _node_totals_from_blocks,
+    )
+
+    node_tot = _node_totals_from_blocks(ghp, node_of_block, n_nodes)
+    return _append_missing(hist_reg, node_tot)
 
 
 def hist_pallas_presorted(
@@ -96,6 +165,8 @@ def hist_pallas_presorted(
     n_bins_total: int,
     block: int = 256,
     interpret: bool = False,
+    precision: str = "highest",
+    layout: str = "bins_rows",
 ) -> jnp.ndarray:
     """Pallas block kernel fed from the incrementally-maintained row order
     (``histogram.update_partition_order``) — skips ``hist_pallas``'s internal
@@ -107,7 +178,8 @@ def hist_pallas_presorted(
         bins, gh, order, counts, n_nodes, block
     )
     hist = hist_pallas_blocks(
-        bp, ghp, node_of_block, n_nodes, n_bins_total, interpret=interpret
+        bp, ghp, node_of_block, n_nodes, n_bins_total, interpret=interpret,
+        precision=precision, layout=layout,
     )
     return hist[:n_nodes]
 
@@ -120,6 +192,8 @@ def hist_pallas(
     n_bins_total: int,
     block: int = 256,
     interpret: bool = False,
+    precision: str = "highest",
+    layout: str = "bins_rows",
 ) -> jnp.ndarray:
     """Full histogram via node partitioning + the Pallas block kernel.
 
@@ -127,7 +201,6 @@ def hist_pallas(
     contraction runs in the Pallas kernel instead of an XLA einsum.
     """
     n, num_features = bins.shape
-    b32 = bins.astype(jnp.int32)
     order = jnp.argsort(pos, stable=True)
     pos_s = pos[order]
     counts = jnp.bincount(pos, length=n_nodes)
@@ -151,7 +224,7 @@ def hist_pallas(
         n_nodes,
     ).astype(jnp.int32)
 
-    bins_ext = jnp.concatenate([b32, jnp.zeros((1, num_features), jnp.int32)])
+    bins_ext = jnp.concatenate([bins, jnp.zeros((1, num_features), bins.dtype)])
     gh_ext = jnp.concatenate([gh, jnp.zeros((1, 2), gh.dtype)])
     bp = bins_ext[row_of_slot].reshape(n_blocks, block, num_features)
     ghp = gh_ext[row_of_slot].reshape(n_blocks, block, 2)
@@ -159,6 +232,7 @@ def hist_pallas(
     # padding blocks (row sentinel n) land their zero gh in the scratch row,
     # but their bin ids are 0 — zero gh means zero contribution either way
     hist = hist_pallas_blocks(
-        bp, ghp, node_of_block, n_nodes, n_bins_total, interpret=interpret
+        bp, ghp, node_of_block, n_nodes, n_bins_total, interpret=interpret,
+        precision=precision, layout=layout,
     )
     return hist[:n_nodes]

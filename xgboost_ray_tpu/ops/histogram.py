@@ -26,6 +26,38 @@ import jax
 import jax.numpy as jnp
 
 
+def _einsum_precision(precision: str):
+    """Histogram accumulation precision: "highest" (f32-exact bf16x3 passes)
+    or "fast" (single bf16 pass; ~0.2% relative rounding on gh entering the
+    MXU, 2-3x fewer MXU passes). Accumulation itself is always f32."""
+    return (
+        jax.lax.Precision.DEFAULT
+        if precision == "fast"
+        else jax.lax.Precision.HIGHEST
+    )
+
+
+def _append_missing(hist_reg: jnp.ndarray, node_tot: jnp.ndarray) -> jnp.ndarray:
+    """Reconstruct the missing-value bucket by subtraction.
+
+    ``hist_reg`` is [n_nodes, F, n_bins, 2] over the regular (non-missing)
+    bins; a row's gh lands in NO regular bin exactly when its value is
+    missing, so per (node, feature): missing = node_total - sum(regular).
+    Keeping the built histogram at n_bins (a 128-lane multiple for the
+    default max_bin=256) instead of n_bins+1 avoids a whole extra MXU tile
+    per pass (257 -> 3x128 tiles, 256 -> 2)."""
+    miss = node_tot[:, None, :] - hist_reg.sum(axis=2)  # [n_nodes, F, 2]
+    return jnp.concatenate([hist_reg, miss[:, :, None, :]], axis=2)
+
+
+def _node_totals_from_blocks(
+    ghp: jnp.ndarray, node_of_block: jnp.ndarray, n_nodes: int
+) -> jnp.ndarray:
+    """[n_blocks, block, 2] node-uniform blocks -> [n_nodes + 1, 2] totals."""
+    block_sums = ghp.sum(axis=1)
+    return jnp.zeros((n_nodes + 1, 2), jnp.float32).at[node_of_block].add(block_sums)
+
+
 def hist_scatter(
     bins: jnp.ndarray,  # [N, F] integer bins in 0..n_bins (n_bins == missing)
     gh: jnp.ndarray,  # [N, 2] float32 (grad, hess); padding rows must be 0
@@ -51,19 +83,24 @@ def hist_onehot(
     n_nodes: int,
     n_bins_total: int,
     chunk: int = 8192,
+    precision: str = "highest",
 ) -> jnp.ndarray:
     """MXU-friendly histogram: per feature, hist = onehot(node*bins)ᵀ @ gh.
 
     Scans row chunks (outer) and features (inner); each inner step builds a
-    [chunk, n_nodes*n_bins_total] one-hot and contracts it against the chunk's
-    [chunk, 2] grad/hess — a matmul XLA tiles onto the MXU. Padding rows have
-    gh == 0 so over-padding of the last chunk is harmless.
+    [chunk, n_nodes*n_bins] one-hot over the REGULAR bins (missing rows get an
+    all-zero one-hot and are reconstructed by subtraction, see
+    ``_append_missing``) and contracts it against the chunk's [chunk, 2]
+    grad/hess — a matmul XLA tiles onto the MXU. Padding rows have gh == 0 so
+    over-padding of the last chunk is harmless.
     """
     n, num_features = bins.shape
-    nb = n_nodes * n_bins_total
+    nb_reg = n_bins_total - 1  # regular bins; bucket nb_reg == missing
+    nb = n_nodes * nb_reg
+    prec = _einsum_precision(precision)
     n_chunks = -(-n // chunk)
     pad = n_chunks * chunk - n
-    b = bins.astype(jnp.int32)
+    b = bins  # keep the storage dtype (uint8/int16): HBM matters at 11M rows
     if pad:
         b = jnp.pad(b, ((0, pad), (0, 0)))
         gh = jnp.pad(gh, ((0, pad), (0, 0)))
@@ -72,23 +109,61 @@ def hist_onehot(
     ghc = gh.reshape(n_chunks, chunk, 2)
     posc = pos.reshape(n_chunks, chunk)
 
-    def chunk_step(acc, args):
+    # fast mode: materialize the one-hot (the HBM-bound operand) in bf16 —
+    # exact for 0/1 values, halves the traffic; gh rounds to bf16 (~0.2%)
+    oh_dtype = jnp.bfloat16 if precision == "fast" else jnp.float32
+
+    # tile features so each sequential step does one WIDE dot — the scan/fori
+    # step count, not FLOPs or HBM, bounds this path on TPU (measured v5e)
+    ftile = min(4, num_features)
+    n_ftiles = -(-num_features // ftile)
+    f_pad = n_ftiles * ftile - num_features
+
+    def chunk_step(carry, args):
+        acc, tot = carry
         bc, ghk, pk = args  # [chunk, F], [chunk, 2], [chunk]
-        base = pk * n_bins_total  # [chunk]
+        bc = bc.astype(jnp.int32)  # per-chunk transient upcast
+        if f_pad:
+            # pad with missing-valued columns -> all-zero one-hot rows
+            bc = jnp.pad(bc, ((0, 0), (0, f_pad)), constant_values=nb_reg)
+        base = pk * nb_reg  # [chunk]
+        ghk_c = ghk.astype(oh_dtype)
 
-        def feat_step(f, acc):
-            idx = base + bc[:, f]  # [chunk]
-            oh = jax.nn.one_hot(idx, nb, dtype=jnp.float32)  # [chunk, nb]
-            contrib = jnp.matmul(oh.T, ghk, precision=jax.lax.Precision.HIGHEST)  # [nb, 2] (MXU)
-            return acc.at[f].add(contrib)
+        def ftile_step(t, acc):
+            cols = jax.lax.dynamic_slice_in_dim(bc, t * ftile, ftile, axis=1)
+            # missing rows -> index -1 -> all-zero one-hot row
+            idx = jnp.where(cols >= nb_reg, -1, base[:, None] + cols)
+            oh = jax.nn.one_hot(idx, nb, dtype=oh_dtype)  # [chunk, ftile, nb]
+            oh = oh.reshape(oh.shape[0], ftile * nb)
+            contrib = jax.lax.dot_general(
+                oh, ghk_c, (((0,), (0,)), ((), ())),
+                precision=prec, preferred_element_type=jnp.float32,
+            )  # [ftile*nb, 2] (MXU, f32 accumulate)
+            return jax.lax.dynamic_update_slice_in_dim(
+                acc,
+                jax.lax.dynamic_slice_in_dim(acc, t * ftile, ftile, axis=0)
+                + contrib.reshape(ftile, nb, 2),
+                t * ftile,
+                axis=0,
+            )
 
-        acc = jax.lax.fori_loop(0, num_features, feat_step, acc)
-        return acc, None
+        acc = jax.lax.fori_loop(0, n_ftiles, ftile_step, acc)
+        # node totals ride the scan as one extra tiny matmul per chunk (a
+        # [N]-row scatter here measured ~20 ms/1M rows on TPU)
+        oh_node = jax.nn.one_hot(pk, n_nodes, dtype=jnp.float32)
+        tot = tot + jnp.matmul(oh_node.T, ghk, precision=jax.lax.Precision.HIGHEST)
+        return (acc, tot), None
 
-    acc0 = jnp.zeros((num_features, nb, 2), jnp.float32)
-    acc, _ = jax.lax.scan(chunk_step, acc0, (b, ghc, posc))
-    # [F, n_nodes*nbt, 2] -> [n_nodes, F, nbt, 2]
-    return acc.reshape(num_features, n_nodes, n_bins_total, 2).transpose(1, 0, 2, 3)
+    acc0 = (
+        jnp.zeros((n_ftiles * ftile, nb, 2), jnp.float32),
+        jnp.zeros((n_nodes, 2), jnp.float32),
+    )
+    (acc, node_tot), _ = jax.lax.scan(chunk_step, acc0, (b, ghc, posc))
+    # [F, n_nodes*nb_reg, 2] -> [n_nodes, F, nb_reg, 2]
+    hist_reg = acc[:num_features].reshape(
+        num_features, n_nodes, nb_reg, 2
+    ).transpose(1, 0, 2, 3)
+    return _append_missing(hist_reg, node_tot)
 
 
 def update_partition_order(
@@ -136,6 +211,41 @@ def update_partition_order(
     return new_order, new_counts
 
 
+def select_small_child_rows(
+    order: jnp.ndarray,  # [N] rows sorted stably by child node
+    counts: jnp.ndarray,  # [2 * n_par] rows per child node
+    small_is_right: jnp.ndarray,  # [n_par] bool
+):
+    """Compact the rows of every parent's smaller child into [N // 2] slots.
+
+    The globally-smaller children hold at most half of all rows, so the
+    compacted layout has a STATIC capacity of N // 2 — this is what turns
+    sibling subtraction into a real 2x on row traffic (zeroing gh of the
+    bigger child still feeds its rows through the MXU; gathering the smaller
+    child's rows does not). Returns (rows [N//2] with sentinel N for unused
+    slots, parent index per slot [N//2], valid mask [N//2], counts_sel
+    [n_par]); rows come out sorted by parent, so they are directly a
+    presorted (order=arange, counts=counts_sel) layout.
+    """
+    n = order.shape[0]
+    n_par = small_is_right.shape[0]
+    n_half = max(n // 2, 1)
+    c_small = 2 * jnp.arange(n_par, dtype=jnp.int32) + small_is_right.astype(jnp.int32)
+    counts_sel = counts[c_small]
+    seg_start = jnp.concatenate(
+        [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]]
+    )
+    cum_sel = jnp.cumsum(counts_sel)
+    start_sel = jnp.concatenate([jnp.zeros((1,), cum_sel.dtype), cum_sel[:-1]])
+    i = jnp.arange(n_half)
+    p = jnp.searchsorted(cum_sel, i, side="right")
+    pc = jnp.clip(p, 0, n_par - 1).astype(jnp.int32)
+    src = seg_start[c_small[pc]] + (i - start_sel[pc])
+    valid = i < cum_sel[-1]
+    rows = jnp.where(valid, order[jnp.clip(src, 0, n - 1)], n).astype(jnp.int32)
+    return rows, pc, valid, counts_sel
+
+
 def presorted_block_layout(
     bins: jnp.ndarray,
     gh: jnp.ndarray,
@@ -151,7 +261,6 @@ def presorted_block_layout(
     blocked-einsum path and the Pallas kernel so the layout math has one
     home."""
     n, num_features = bins.shape
-    b32 = bins.astype(jnp.int32)
     seg_start = jnp.concatenate(
         [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]]
     )
@@ -172,7 +281,10 @@ def presorted_block_layout(
         0,
         n_nodes,
     ).astype(jnp.int32)
-    bins_ext = jnp.concatenate([b32, jnp.zeros((1, num_features), jnp.int32)])
+    # keep the bins gather in the storage dtype (uint8/int16): the padded
+    # block copy is the largest per-level buffer (11M x 28 would be 1.2 GB
+    # as int32 — enough to OOM an 11M-row training step on a 16 GB chip)
+    bins_ext = jnp.concatenate([bins, jnp.zeros((1, num_features), bins.dtype)])
     gh_ext = jnp.concatenate([gh, jnp.zeros((1, 2), gh.dtype)])
     bp = bins_ext[row_of_slot].reshape(n_blocks, block, num_features)
     ghp = gh_ext[row_of_slot].reshape(n_blocks, block, 2)
@@ -188,6 +300,7 @@ def hist_partition_presorted(
     n_bins_total: int,
     block: int = 256,
     block_chunk: int = 512,
+    precision: str = "highest",
 ) -> jnp.ndarray:
     """hist_partition with the sort/bincount already maintained by the caller
     (see ``update_partition_order``)."""
@@ -196,12 +309,16 @@ def hist_partition_presorted(
         bins, gh, order, counts, n_nodes, block
     )
     return _blocked_hist(
-        bp, ghp, node_of_block, n_nodes, n_bins_total, num_features, block_chunk
+        bp, ghp, node_of_block, n_nodes, n_bins_total, num_features,
+        block_chunk, precision,
     )
 
 
 def _blocked_hist(bp, ghp, node_of_block, n_nodes, n_bins_total, num_features,
-                  block_chunk):
+                  block_chunk, precision: str = "highest"):
+    nb_reg = n_bins_total - 1  # regular bins; missing reconstructed after
+    prec = _einsum_precision(precision)
+    node_tot = _node_totals_from_blocks(ghp, node_of_block, n_nodes)
     n_blocks = bp.shape[0]
     n_chunks = -(-n_blocks // block_chunk)
     pad_blocks = n_chunks * block_chunk - n_blocks
@@ -213,22 +330,26 @@ def _blocked_hist(bp, ghp, node_of_block, n_nodes, n_bins_total, num_features,
     ghp = ghp.reshape(n_chunks, block_chunk, -1, 2)
     nodes_c = node_of_block.reshape(n_chunks, block_chunk)
 
+    oh_dtype = jnp.bfloat16 if precision == "fast" else jnp.float32
+
     def chunk_step(hist, args):
         bc, gc, nodes = args
+        bc = bc.astype(jnp.int32)  # per-chunk transient upcast
+        gc_c = gc.astype(oh_dtype)
 
         def feat_step(f, hist):
-            oh = jax.nn.one_hot(bc[:, :, f], n_bins_total, dtype=jnp.float32)
-            contrib = jnp.einsum(
-                "cbn,cbd->cnd", oh, gc, precision=jax.lax.Precision.HIGHEST
-            )
+            # bins == nb_reg (missing) exceed the one-hot width -> zero rows
+            oh = jax.nn.one_hot(bc[:, :, f], nb_reg, dtype=oh_dtype)
+            contrib = jnp.einsum("cbn,cbd->cnd", oh, gc_c, precision=prec,
+                                 preferred_element_type=jnp.float32)
             return hist.at[nodes, f].add(contrib)
 
         hist = jax.lax.fori_loop(0, num_features, feat_step, hist)
         return hist, None
 
-    hist0 = jnp.zeros((n_nodes + 1, num_features, n_bins_total, 2), jnp.float32)
+    hist0 = jnp.zeros((n_nodes + 1, num_features, nb_reg, 2), jnp.float32)
     hist, _ = jax.lax.scan(chunk_step, hist0, (bp, ghp, nodes_c))
-    return hist[:n_nodes]
+    return _append_missing(hist[:n_nodes], node_tot[:n_nodes])
 
 
 def hist_partition(
@@ -239,6 +360,7 @@ def hist_partition(
     n_bins_total: int,
     block: int = 256,
     block_chunk: int = 512,
+    precision: str = "highest",
 ) -> jnp.ndarray:
     """Node-contiguous blocked histogram — the deep-level TPU workhorse.
 
@@ -251,63 +373,16 @@ def hist_partition(
     independent of the node count. The final per-block scatter touches
     O(n_blocks) elements only.
     """
-    n, num_features = bins.shape
-    b32 = bins.astype(jnp.int32)
+    num_features = bins.shape[1]
     order = jnp.argsort(pos, stable=True)
-    pos_s = pos[order]
     counts = jnp.bincount(pos, length=n_nodes)
-    seg_start = jnp.concatenate(
-        [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]]
+    bp, ghp, node_of_block = presorted_block_layout(
+        bins, gh, order, counts, n_nodes, block
     )
-    padded_counts = ((counts + block - 1) // block) * block
-    padded_cum = jnp.cumsum(padded_counts)
-    padded_start = jnp.concatenate(
-        [jnp.zeros((1,), padded_cum.dtype), padded_cum[:-1]]
+    return _blocked_hist(
+        bp, ghp, node_of_block, n_nodes, n_bins_total, num_features,
+        block_chunk, precision,
     )
-    rank_in_node = jnp.arange(n) - seg_start[pos_s]
-    dest = (padded_start[pos_s] + rank_in_node).astype(jnp.int32)
-
-    cap = (-(-n // block) + n_nodes) * block  # static upper bound on slots
-    n_blocks = cap // block
-    row_of_slot = jnp.full((cap,), n, jnp.int32).at[dest].set(order.astype(jnp.int32))
-    node_of_block = jnp.clip(
-        jnp.searchsorted(padded_cum, jnp.arange(n_blocks) * block, side="right"),
-        0,
-        n_nodes,  # overflow blocks (all-sentinel) park in a scratch slot
-    )
-
-    bins_ext = jnp.concatenate([b32, jnp.zeros((1, num_features), jnp.int32)])
-    gh_ext = jnp.concatenate([gh, jnp.zeros((1, 2), gh.dtype)])
-    bp = bins_ext[row_of_slot].reshape(n_blocks, block, num_features)
-    ghp = gh_ext[row_of_slot].reshape(n_blocks, block, 2)
-
-    n_chunks = -(-n_blocks // block_chunk)
-    pad_blocks = n_chunks * block_chunk - n_blocks
-    if pad_blocks:
-        bp = jnp.pad(bp, ((0, pad_blocks), (0, 0), (0, 0)))
-        ghp = jnp.pad(ghp, ((0, pad_blocks), (0, 0), (0, 0)))
-        node_of_block = jnp.pad(node_of_block, (0, pad_blocks), constant_values=n_nodes)
-    bp = bp.reshape(n_chunks, block_chunk, block, num_features)
-    ghp = ghp.reshape(n_chunks, block_chunk, block, 2)
-    nodes_c = node_of_block.reshape(n_chunks, block_chunk)
-
-    def chunk_step(hist, args):
-        bc, gc, nodes = args  # [C, block, F], [C, block, 2], [C]
-
-        def feat_step(f, hist):
-            oh = jax.nn.one_hot(bc[:, :, f], n_bins_total, dtype=jnp.float32)
-            # [C, block, nbt]^T x [C, block, 2] -> [C, nbt, 2] per block
-            contrib = jnp.einsum(
-                "cbn,cbd->cnd", oh, gc, precision=jax.lax.Precision.HIGHEST
-            )
-            return hist.at[nodes, f].add(contrib)
-
-        hist = jax.lax.fori_loop(0, num_features, feat_step, hist)
-        return hist, None
-
-    hist0 = jnp.zeros((n_nodes + 1, num_features, n_bins_total, 2), jnp.float32)
-    hist, _ = jax.lax.scan(chunk_step, hist0, (bp, ghp, nodes_c))
-    return hist[:n_nodes]
 
 
 def node_sums(gh: jnp.ndarray, pos: jnp.ndarray, n_nodes: int) -> jnp.ndarray:
@@ -324,22 +399,28 @@ def build_histogram(
     n_bins_total: int,
     impl: str = "scatter",
     chunk: int = 8192,
+    precision: str = "highest",
 ) -> jnp.ndarray:
     if impl == "onehot":
-        return hist_onehot(bins, gh, pos, n_nodes, n_bins_total, chunk=chunk)
+        return hist_onehot(bins, gh, pos, n_nodes, n_bins_total, chunk=chunk,
+                           precision=precision)
     if impl == "partition":
-        return hist_partition(bins, gh, pos, n_nodes, n_bins_total)
+        return hist_partition(bins, gh, pos, n_nodes, n_bins_total,
+                              precision=precision)
     if impl == "mixed":
         # shallow levels: node axis is cheap in the one-hot width; deep
         # levels: row partitioning keeps FLOPs independent of node count
         if n_nodes <= 4:
-            return hist_onehot(bins, gh, pos, n_nodes, n_bins_total, chunk=chunk)
-        return hist_partition(bins, gh, pos, n_nodes, n_bins_total)
+            return hist_onehot(bins, gh, pos, n_nodes, n_bins_total,
+                               chunk=chunk, precision=precision)
+        return hist_partition(bins, gh, pos, n_nodes, n_bins_total,
+                              precision=precision)
     if impl == "pallas":
         try:
             from xgboost_ray_tpu.ops import hist_pallas
 
-            return hist_pallas.hist_pallas(bins, gh, pos, n_nodes, n_bins_total)
+            return hist_pallas.hist_pallas(bins, gh, pos, n_nodes, n_bins_total,
+                                           precision=precision)
         except Exception:
             return hist_scatter(bins, gh, pos, n_nodes, n_bins_total)
     return hist_scatter(bins, gh, pos, n_nodes, n_bins_total)

@@ -81,6 +81,9 @@ class TrainParams:
     huber_slope: float = 1.0
     # tpu_hist internals
     hist_impl: str = "auto"  # auto | scatter | onehot | partition | mixed | pallas
+    # histogram MXU precision: auto (fast on accelerators, highest on CPU) |
+    # highest (f32-exact) | fast (single bf16 pass, ~0.2% bin-sum rounding)
+    hist_precision: str = "auto"
     hist_chunk: int = 8192
     # build only the smaller child's histogram per parent, derive the sibling
     # by subtraction (xgboost hist-core behavior); disable for A/B debugging
