@@ -282,4 +282,3 @@ def test_incremental_forest_stacking_consistent():
     p0 = snapshots[0].predict(x, output_margin=True)
     eng.step(6)
     np.testing.assert_array_equal(p0, snapshots[0].predict(x, output_margin=True))
-

@@ -610,3 +610,70 @@ def test_sibling_compaction_overflow_falls_back():
         outs[sib] = (np.asarray(tree.feature), np.asarray(rv))
     np.testing.assert_array_equal(outs[True][0], outs[False][0])
     np.testing.assert_allclose(outs[True][1], outs[False][1], atol=1e-3)
+
+
+def test_quantile_regression_single_and_multi():
+    """reg:quantileerror (xgboost >= 2.0 pinball loss): empirical coverage of
+    each predicted quantile matches its alpha, multi-alpha outputs are
+    ordered, and the "quantile" eval metric decreases."""
+    import numpy as np
+    from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+    rng = np.random.RandomState(5)
+    n = 4000
+    x = rng.randn(n, 3).astype(np.float32)
+    y = (2.0 * x[:, 0] + rng.standard_normal(n)).astype(np.float32)
+
+    res = {}
+    bst = train({"objective": "reg:quantileerror",
+                 "quantile_alpha": [0.1, 0.5, 0.9],
+                 "eval_metric": ["quantile"], "max_depth": 4, "eta": 0.3},
+                RayDMatrix(x, y), 30,
+                evals=[(RayDMatrix(x, y), "train")], evals_result=res,
+                ray_params=RayParams(num_actors=2))
+    pin = res["train"]["quantile"]
+    assert pin[-1] < pin[0]
+    pred = bst.predict(x)
+    assert pred.shape == (n, 3)
+    for k, a in enumerate([0.1, 0.5, 0.9]):
+        cov = float((y <= pred[:, k]).mean())
+        assert abs(cov - a) < 0.08, (a, cov)
+    # quantile crossing should be rare on train data
+    assert float((pred[:, 0] <= pred[:, 2]).mean()) > 0.95
+
+    bst1 = train({"objective": "reg:quantileerror", "quantile_alpha": 0.75,
+                  "max_depth": 4, "eta": 0.3},
+                 RayDMatrix(x, y), 25, ray_params=RayParams(num_actors=2))
+    p1 = bst1.predict(x)
+    assert p1.shape == (n,)
+    assert abs(float((y <= p1).mean()) - 0.75) < 0.08
+
+
+def test_quantile_save_load_and_sklearn():
+    """quantile_alpha survives serialization (multi-output predict after
+    load) and flows through the sklearn regressor params."""
+    import numpy as np
+    from xgboost_ray_tpu import RayDMatrix, RayParams, train
+    from xgboost_ray_tpu.models.booster import Booster
+    from xgboost_ray_tpu.sklearn import RayXGBRegressor
+
+    rng = np.random.RandomState(6)
+    x = rng.randn(600, 3).astype(np.float32)
+    y = (x[:, 0] + 0.3 * rng.standard_normal(600)).astype(np.float32)
+    bst = train({"objective": "reg:quantileerror",
+                 "quantile_alpha": [0.25, 0.75], "max_depth": 3},
+                RayDMatrix(x, y), 6, ray_params=RayParams(num_actors=2))
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "m.json")
+        bst.save_model(p)
+        loaded = Booster.load_model(p)
+    assert loaded.num_outputs == 2
+    np.testing.assert_allclose(loaded.predict(x), bst.predict(x), atol=1e-6)
+
+    reg = RayXGBRegressor(objective="reg:quantileerror", quantile_alpha=0.5,
+                          n_estimators=5, max_depth=3,
+                          ray_params=RayParams(num_actors=2))
+    reg.fit(x, y)
+    p = reg.predict(x)
+    assert p.shape == (600,)

@@ -1,6 +1,7 @@
 #!/bin/bash
 cd /root/repo
 export PYTHONPATH=/root/repo:${PYTHONPATH}
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}
 echo "=== time_rounds start $(date +%T) ===" >> tpu_logs/bench.log
 timeout 2400 python tpu_logs/time_rounds.py >> tpu_logs/bench.log 2>&1
 echo "=== exit=$? $(date +%T) ===" >> tpu_logs/bench.log

@@ -1,6 +1,7 @@
 #!/bin/bash
 cd /root/repo
 export PYTHONPATH=/root/repo:${PYTHONPATH}
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}
 L=/root/repo/tpu_logs
 while ! grep -q "Q8 ALL DONE" $L/r2.log; do sleep 20; done
 run() { echo "=== $1 start $(date +%T) ===" >> $L/r2.log; timeout "$2" "${@:3}" >> $L/r2.log 2>&1; echo "=== $1 exit=$? $(date +%T) ===" >> $L/r2.log; }

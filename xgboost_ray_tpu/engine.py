@@ -168,6 +168,7 @@ class TpuEngine:
                 aft_loss_distribution=params.aft_loss_distribution,
                 aft_loss_distribution_scale=params.aft_loss_distribution_scale,
                 huber_slope=params.huber_slope,
+                quantile_alpha=params.quantile_alpha,
             )
         )
         self.is_ranking = isinstance(self.objective, RankingObjective)
@@ -206,6 +207,7 @@ class TpuEngine:
             hist_chunk=params.hist_chunk,
             sibling_subtract=params.sibling_subtract,
             cat_features=self._cat_features,
+            shards_may_skew=self.n_devices > 1 or jax.process_count() > 1,
         )
 
         # metrics (device/host split happens after eval sets exist — ndcg/map
@@ -721,6 +723,11 @@ class TpuEngine:
                         device_metric_contrib(
                             name, m, lab, w, gr, psum,
                             huber_slope=params.huber_slope,
+                            quantile_alpha=tuple(
+                                params.quantile_alpha
+                                if isinstance(params.quantile_alpha, (list, tuple))
+                                else [params.quantile_alpha]
+                            ),
                         )
                     )
                 contribs.append(tuple(set_contribs))

@@ -89,6 +89,9 @@ class RayXGBoostBooster:
 
     @property
     def num_outputs(self) -> int:
+        if self.params.objective == "reg:quantileerror":
+            qa = self.params.quantile_alpha
+            return len(qa) if isinstance(qa, (list, tuple)) else 1
         return max(self.params.num_class, 1)
 
     @property
@@ -193,7 +196,9 @@ class RayXGBoostBooster:
     def base_score_margin_np(self) -> float:
         """The margin-space offset implied by this booster's base_score."""
         obj = get_objective(
-            self.params.objective, self.params.num_class, self.params.scale_pos_weight
+            self.params.objective, self.params.num_class,
+            self.params.scale_pos_weight,
+            quantile_alpha=self.params.quantile_alpha,
         )
         return float(obj.base_score_to_margin(self.base_score))
 
@@ -204,7 +209,9 @@ class RayXGBoostBooster:
         n = x.shape[0]
         k = self.num_outputs
         obj = get_objective(
-            self.params.objective, self.params.num_class, self.params.scale_pos_weight
+            self.params.objective, self.params.num_class,
+            self.params.scale_pos_weight,
+            quantile_alpha=self.params.quantile_alpha,
         )
         m0 = obj.base_score_to_margin(self.base_score)
         out = np.empty((n, k), np.float32)
@@ -362,7 +369,9 @@ class RayXGBoostBooster:
         if output_margin:
             return margin[:, 0] if booster.num_outputs == 1 else margin
         obj = get_objective(
-            self.params.objective, self.params.num_class, self.params.scale_pos_weight
+            self.params.objective, self.params.num_class,
+            self.params.scale_pos_weight,
+            quantile_alpha=self.params.quantile_alpha,
         )
         pred = np.asarray(obj.transform(jnp.asarray(margin)))
         return pred

@@ -104,6 +104,12 @@ class GrowConfig:
     # one-vs-rest partitions routed by equality). Static tuple so it can ride
     # inside this hashable jit-static config.
     cat_features: tuple = ()
+    # True when this shard's counts can differ from the allreduced ones
+    # (world size > 1): the compacted sibling build then carries a lax.cond
+    # fallback for selections overflowing the N//2 buffer. Single-shard
+    # training sets False — the selection provably fits, and skipping the
+    # cond halves the per-level histogram code to compile.
+    shards_may_skew: bool = True
 
     @property
     def heap_size(self) -> int:
@@ -181,12 +187,18 @@ def build_tree(
         n_nodes = 1 << d
         base = n_nodes - 1
 
-        def _use_pallas() -> bool:
+        def _use_pallas(explicit: bool) -> bool:
             """Kernel is TPU-only (pltpu grid spec); other backends use the
-            identical-layout XLA einsum. RXGB_DISABLE_PALLAS opts out."""
+            identical-layout XLA einsum. The measured kernel is ~1.4x the
+            einsum per level, but compiling it rides the axon remote-compile
+            helper, which hangs/dies often enough (observed repeatedly on the
+            v5e tunnel) that `mixed`/auto only uses it when the user opts in
+            via hist_impl="pallas" or RXGB_ENABLE_PALLAS=1."""
             import os
 
             if os.environ.get("RXGB_DISABLE_PALLAS"):
+                return False
+            if not explicit and not os.environ.get("RXGB_ENABLE_PALLAS"):
                 return False
             try:
                 from xgboost_ray_tpu.ops import hist_pallas as hp
@@ -195,38 +207,53 @@ def build_tree(
             except Exception:
                 return False
 
-        def _build(gh_b, pos_b, order_b, counts_b, nn, bins_b=None):
-            """One histogram build over nn node slots with the configured impl."""
-            bins_in = bins if bins_b is None else bins_b
+        def _build(gh_b, pos_b, order_b, counts_b, nn, rows_sel=None):
+            """One histogram build over nn node slots with the configured impl.
+
+            ``rows_sel`` is a compacted row-id view into the FULL bins/gh
+            (sentinel n for unused slots). Presorted paths consume it directly
+            as the row order — the padded-block gather is then the only copy;
+            gather-based paths materialize the selection first.
+            """
+            def gathered():
+                if rows_sel is None:
+                    return bins, gh_b
+                rows_c = jnp.minimum(rows_sel, n - 1)
+                ok = (rows_sel < n)[:, None].astype(gh_b.dtype)
+                return bins[rows_c], gh_b[rows_c] * ok
+
+            order_in = order_b if rows_sel is None else rows_sel
 
             def presorted(use_pallas: bool):
                 if use_pallas:
                     from xgboost_ray_tpu.ops import hist_pallas as hp
 
                     return hp.hist_pallas_presorted(
-                        bins_in, gh_b, order_b, counts_b, nn, nbt,
+                        bins, gh_b, order_in, counts_b, nn, nbt,
                         precision=cfg.hist_precision,
                     )
                 return hist_partition_presorted(
-                    bins_in, gh_b, order_b, counts_b, nn, nbt,
+                    bins, gh_b, order_in, counts_b, nn, nbt,
                     precision=cfg.hist_precision,
                 )
 
             if cfg.hist_impl == "pallas":
-                return presorted(_use_pallas())
+                return presorted(_use_pallas(explicit=True))
             if cfg.hist_impl == "mixed":
                 # measured on v5e (1M x 28 x 256): one-hot wins at tiny node
                 # fan-out (cost scales with nn), the fused block kernel is
                 # flat beyond; einsum fallback off-TPU
                 if nn <= 2:
-                    return hist_onehot(bins_in, gh_b, pos_b, nn, nbt,
+                    bins_g, gh_g = gathered()
+                    return hist_onehot(bins_g, gh_g, pos_b, nn, nbt,
                                        chunk=cfg.hist_chunk,
                                        precision=cfg.hist_precision)
-                return presorted(_use_pallas())
+                return presorted(_use_pallas(explicit=False))
             if track_order and cfg.hist_impl == "partition":
                 return presorted(False)
+            bins_g, gh_g = gathered()
             return build_histogram(
-                bins_in, gh_b, pos_b, nn, nbt, impl=cfg.hist_impl,
+                bins_g, gh_g, pos_b, nn, nbt, impl=cfg.hist_impl,
                 chunk=cfg.hist_chunk, precision=cfg.hist_precision,
             )
 
@@ -252,19 +279,17 @@ def build_tree(
                 # N // 2 — lax.cond falls back to the gh-zeroed full-row
                 # build there (shard-local control flow; the psum sits
                 # outside and runs on every shard either way).
-                rows, par_of_slot, valid_sel, counts_sel = (
+                rows, par_of_slot, _valid_sel, counts_sel = (
                     select_small_child_rows(order, counts, small_is_right)
                 )
-                fits = counts_sel.sum() <= rows.shape[0]
 
                 def _compacted(_):
-                    rows_c = jnp.minimum(rows, n - 1)
-                    keep = valid_sel & ~done[rows_c]
-                    bins_sel = bins[rows_c]
-                    gh_sel = gh[rows_c] * keep[:, None].astype(gh.dtype)
-                    return _build(gh_sel, par_of_slot,
-                                  jnp.arange(rows_c.shape[0], dtype=jnp.int32),
-                                  counts_sel, n_par, bins_b=bins_sel)
+                    # done rows only live under inactive parents (they always
+                    # route left below their leaf), so the active nodes this
+                    # histogram feeds never see them — no done-mask needed;
+                    # sentinel slots zero out via the layouts' appended row.
+                    return _build(gh, par_of_slot, None, counts_sel, n_par,
+                                  rows_sel=rows)
 
                 def _zeroed(_):
                     parent_pos = pos >> 1
@@ -274,9 +299,13 @@ def build_tree(
                     counts_par = counts.reshape(-1, 2).sum(axis=1)
                     return _build(gh_sel, parent_pos, order, counts_par, n_par)
 
-                hist_small = allreduce(
-                    jax.lax.cond(fits, _compacted, _zeroed, None)
-                )
+                if cfg.shards_may_skew:
+                    fits = counts_sel.sum() <= rows.shape[0]
+                    hist_small = allreduce(
+                        jax.lax.cond(fits, _compacted, _zeroed, None)
+                    )
+                else:
+                    hist_small = allreduce(_compacted(None))
             else:
                 parent_pos = pos >> 1
                 is_right = (pos & 1).astype(bool)

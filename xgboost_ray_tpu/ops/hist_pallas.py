@@ -30,54 +30,30 @@ except Exception:  # pragma: no cover
 
 def _kernel(node_ref, init_ref, bp_ref, ghp_ref, out_ref, *, nb_reg,
             n_features, precision):
-    # bp_ref: [1, block, F] int32; ghp_ref: [1, block, 2] f32
+    # bp_ref: [1, block, F] int (storage dtype); ghp_ref: [1, block, 2] f32
     # init_ref aliases out_ref (zero-initialized accumulator); unused directly
-    # out_ref: [1, F, 2, nb_reg] f32 (accumulate) — bins ride the 128-lane
-    # axis (nb_reg is a lane multiple for the default max_bin=256); the
-    # missing bucket is reconstructed by subtraction outside the kernel.
+    # out_ref: [1, F, nb_reg, 2] f32 (accumulate) — bins on sublanes, gh pair
+    # on lanes. (A bins-on-lanes orientation was tried and MISCOMPILES on
+    # real v5e — wrong sums at <128-lane tiles and at large grids — with an
+    # identical MXU pass count, so this orientation is the only one.)
+    # The missing bucket is reconstructed by subtraction outside the kernel.
     del init_ref
     gh = ghp_ref[0]  # [block, 2]
-    # "highest": split gh into two bf16-exact terms (hi + lo carries a 16-bit
-    # mantissa — sums over millions of O(1) grads stay f32-accurate) so each
-    # MXU pass is lossless; the one-hot operand is exact in bf16 already.
-    # "fast": one pass on bf16-rounded gh (~0.2% per-entry rounding).
-    # (Mosaic rejects per-operand Precision, so the split is done by hand.)
+    # Mosaic rejects per-operand Precision, so gh's mantissa is split by hand
+    # into bf16-exact terms entering the MXU (the one-hot operand is exact in
+    # bf16 already). "highest": three terms = 24 mantissa bits, true f32
+    # accuracy (bf16x3). "fast": one bf16-rounded pass (~0.2% per entry).
     if precision == "highest":
-        gh_hi = gh.astype(jnp.bfloat16).astype(jnp.float32)
-        gh_terms = (gh_hi, gh - gh_hi)
+        hi = gh.astype(jnp.bfloat16).astype(jnp.float32)
+        r1 = gh - hi
+        mid = r1.astype(jnp.bfloat16).astype(jnp.float32)
+        gh_terms = (hi, mid, r1 - mid)
     else:
         gh_terms = (gh,)
     bins_ids = jax.lax.broadcasted_iota(jnp.int32, (1, nb_reg), 1)
     for f in range(n_features):
         col = bp_ref[0, :, f][:, None].astype(jnp.int32)  # [block, 1]
         # missing rows (bin == nb_reg) match no iota value -> all-zero row
-        oh = (col == bins_ids).astype(jnp.float32)  # [block, nb_reg]
-        contrib = sum(
-            jax.lax.dot_general(
-                term,
-                oh,
-                (((0,), (0,)), ((), ())),  # contract over rows -> [2, nb_reg]
-                preferred_element_type=jnp.float32,
-            )
-            for term in gh_terms
-        )
-        out_ref[0, f, :, :] += contrib
-
-
-def _kernel_binrows(node_ref, init_ref, bp_ref, ghp_ref, out_ref, *, nb_reg,
-                    n_features, precision):
-    """Variant with the round-1-proven output orientation: out block
-    [1, F, nb_reg, 2] (bins on sublanes, gh pair on lanes)."""
-    del init_ref
-    gh = ghp_ref[0]  # [block, 2]
-    if precision == "highest":
-        gh_hi = gh.astype(jnp.bfloat16).astype(jnp.float32)
-        gh_terms = (gh_hi, gh - gh_hi)
-    else:
-        gh_terms = (gh,)
-    bins_ids = jax.lax.broadcasted_iota(jnp.int32, (1, nb_reg), 1)
-    for f in range(n_features):
-        col = bp_ref[0, :, f][:, None].astype(jnp.int32)  # [block, 1]
         oh = (col == bins_ids).astype(jnp.float32)  # [block, nb_reg]
         contrib = sum(
             jax.lax.dot_general(
@@ -99,10 +75,6 @@ def hist_pallas_blocks(
     n_bins_total: int,
     interpret: bool = False,
     precision: str = "highest",
-    layout: str = "bins_rows",  # "bins_rows" ([F,nb,2]) | "bins_lanes" ([F,2,nb])
-    # bins_rows is the default: the bins_lanes orientation (2-sublane output
-    # tile) miscompiles on real TPU — wrong sums at nb_reg < 128 and at
-    # large grid sizes (observed v5e, 2026-07); pass counts are identical.
 ) -> jnp.ndarray:
     """Accumulate per-node histograms from node-uniform blocks.
 
@@ -113,20 +85,12 @@ def hist_pallas_blocks(
     """
     n_blocks, block, n_features = bp.shape
     nb_reg = n_bins_total - 1
-    if layout == "bins_lanes":
-        out_dims = (2, nb_reg)
-        kernel = functools.partial(
-            _kernel, nb_reg=nb_reg, n_features=n_features, precision=precision
-        )
-    else:
-        out_dims = (nb_reg, 2)
-        kernel = functools.partial(
-            _kernel_binrows, nb_reg=nb_reg, n_features=n_features,
-            precision=precision,
-        )
-    out_init = jnp.zeros((n_nodes + 1, n_features) + out_dims, jnp.float32)
+    kernel = functools.partial(
+        _kernel, nb_reg=nb_reg, n_features=n_features, precision=precision
+    )
+    out_init = jnp.zeros((n_nodes + 1, n_features, nb_reg, 2), jnp.float32)
     out_block_spec = pl.BlockSpec(
-        (1, n_features) + out_dims, lambda i, node: (node[i], 0, 0, 0)
+        (1, n_features, nb_reg, 2), lambda i, node: (node[i], 0, 0, 0)
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -145,8 +109,6 @@ def hist_pallas_blocks(
         input_output_aliases={1: 0},  # out_init (after the scalar operand)
         interpret=interpret,
     )(node_of_block, out_init, bp, ghp)
-    if layout == "bins_lanes":
-        hist_reg = hist_reg.transpose(0, 1, 3, 2)  # [nodes+1, F, nb_reg, 2]
     from xgboost_ray_tpu.ops.histogram import (
         _append_missing,
         _node_totals_from_blocks,
@@ -166,7 +128,6 @@ def hist_pallas_presorted(
     block: int = 256,
     interpret: bool = False,
     precision: str = "highest",
-    layout: str = "bins_rows",
 ) -> jnp.ndarray:
     """Pallas block kernel fed from the incrementally-maintained row order
     (``histogram.update_partition_order``) — skips ``hist_pallas``'s internal
@@ -179,7 +140,7 @@ def hist_pallas_presorted(
     )
     hist = hist_pallas_blocks(
         bp, ghp, node_of_block, n_nodes, n_bins_total, interpret=interpret,
-        precision=precision, layout=layout,
+        precision=precision,
     )
     return hist[:n_nodes]
 
@@ -193,7 +154,6 @@ def hist_pallas(
     block: int = 256,
     interpret: bool = False,
     precision: str = "highest",
-    layout: str = "bins_rows",
 ) -> jnp.ndarray:
     """Full histogram via node partitioning + the Pallas block kernel.
 
@@ -233,6 +193,6 @@ def hist_pallas(
     # but their bin ids are 0 — zero gh means zero contribution either way
     hist = hist_pallas_blocks(
         bp, ghp, node_of_block, n_nodes, n_bins_total, interpret=interpret,
-        precision=precision, layout=layout,
+        precision=precision,
     )
     return hist[:n_nodes]

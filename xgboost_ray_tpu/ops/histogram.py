@@ -259,8 +259,14 @@ def presorted_block_layout(
     Returns (bp [n_blocks, block, F], ghp [n_blocks, block, 2],
     node_of_block [n_blocks]); padding slots carry zero gh. Shared by the XLA
     blocked-einsum path and the Pallas kernel so the layout math has one
-    home."""
-    n, num_features = bins.shape
+    home.
+
+    ``order`` may be SHORTER than bins (a compacted selection, e.g. the
+    smaller-child rows under sibling subtraction): slots beyond
+    ``sum(counts)`` and entries holding the sentinel ``bins.shape[0]`` land
+    on the appended zero row and contribute nothing."""
+    sentinel, num_features = bins.shape
+    n_slots = order.shape[0]
     seg_start = jnp.concatenate(
         [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]]
     )
@@ -269,13 +275,20 @@ def presorted_block_layout(
     padded_start = jnp.concatenate(
         [jnp.zeros((1,), padded_cum.dtype), padded_cum[:-1]]
     )
-    seg_of_slot = jnp.searchsorted(jnp.cumsum(counts), jnp.arange(n), side="right")
-    rank_in_node = jnp.arange(n) - seg_start[seg_of_slot]
-    dest = (padded_start[seg_of_slot] + rank_in_node).astype(jnp.int32)
+    seg_of_slot = jnp.searchsorted(
+        jnp.cumsum(counts), jnp.arange(n_slots), side="right"
+    )
+    seg_c = jnp.minimum(seg_of_slot, counts.shape[0] - 1)
+    rank_in_node = jnp.arange(n_slots) - seg_start[seg_c]
+    in_range = seg_of_slot < counts.shape[0]
+    dest = jnp.where(in_range, padded_start[seg_c] + rank_in_node, -1).astype(jnp.int32)
 
-    cap = (-(-n // block) + n_nodes) * block
+    cap = (-(-n_slots // block) + n_nodes) * block
     n_blocks = cap // block
-    row_of_slot = jnp.full((cap,), n, jnp.int32).at[dest].set(order.astype(jnp.int32))
+    # OOB dest (-1 slots beyond the selection) are dropped by the scatter
+    row_of_slot = jnp.full((cap,), sentinel, jnp.int32).at[dest].set(
+        order.astype(jnp.int32), mode="drop"
+    )
     node_of_block = jnp.clip(
         jnp.searchsorted(padded_cum, jnp.arange(n_blocks) * block, side="right"),
         0,
@@ -331,24 +344,37 @@ def _blocked_hist(bp, ghp, node_of_block, n_nodes, n_bins_total, num_features,
     nodes_c = node_of_block.reshape(n_chunks, block_chunk)
 
     oh_dtype = jnp.bfloat16 if precision == "fast" else jnp.float32
+    # tile features per sequential step (step count, not FLOPs, bounds this
+    # path on TPU — same treatment as hist_onehot)
+    ftile = min(4, num_features)
+    n_ftiles = -(-num_features // ftile)
+    f_pad = n_ftiles * ftile - num_features
 
     def chunk_step(hist, args):
         bc, gc, nodes = args
         bc = bc.astype(jnp.int32)  # per-chunk transient upcast
+        if f_pad:
+            # missing-valued pad columns produce all-zero one-hot rows
+            bc = jnp.pad(bc, ((0, 0), (0, 0), (0, f_pad)), constant_values=nb_reg)
         gc_c = gc.astype(oh_dtype)
 
-        def feat_step(f, hist):
+        def ftile_step(t, hist):
+            cols = jax.lax.dynamic_slice_in_dim(bc, t * ftile, ftile, axis=2)
             # bins == nb_reg (missing) exceed the one-hot width -> zero rows
-            oh = jax.nn.one_hot(bc[:, :, f], nb_reg, dtype=oh_dtype)
-            contrib = jnp.einsum("cbn,cbd->cnd", oh, gc_c, precision=prec,
+            oh = jax.nn.one_hot(cols, nb_reg, dtype=oh_dtype)  # [C, b, T, nb]
+            contrib = jnp.einsum("cbtn,cbd->ctnd", oh, gc_c, precision=prec,
                                  preferred_element_type=jnp.float32)
-            return hist.at[nodes, f].add(contrib)
+            # scatter the [C, T, nb, 2] tile contributions into the node rows
+            sl = jax.lax.dynamic_slice_in_dim(hist, t * ftile, ftile, axis=1)
+            sl = sl.at[nodes, :, :, :].add(contrib)
+            return jax.lax.dynamic_update_slice_in_dim(hist, sl, t * ftile, axis=1)
 
-        hist = jax.lax.fori_loop(0, num_features, feat_step, hist)
+        hist = jax.lax.fori_loop(0, n_ftiles, ftile_step, hist)
         return hist, None
 
-    hist0 = jnp.zeros((n_nodes + 1, num_features, nb_reg, 2), jnp.float32)
+    hist0 = jnp.zeros((n_nodes + 1, n_ftiles * ftile, nb_reg, 2), jnp.float32)
     hist, _ = jax.lax.scan(chunk_step, hist0, (bp, ghp, nodes_c))
+    hist = hist[:, :num_features]
     return _append_missing(hist[:n_nodes], node_tot[:n_nodes])
 
 

@@ -85,6 +85,20 @@ def _poisson_nloglik(margin, label, weight):
     return jnp.sum(weight * nll), jnp.sum(weight)
 
 
+def _quantile_pinball(m, label, weight, alphas=(0.5,)):
+    """Mean pinball loss over the alpha outputs (xgboost "quantile" metric)."""
+    a = jnp.asarray(alphas, jnp.float32)[None, :]
+    if m.ndim == 1:
+        m = m[:, None]
+    if m.shape[1] != a.shape[1]:
+        # margin columns and alphas must align; fall back to broadcasting a
+        # single alpha over all outputs
+        a = jnp.broadcast_to(a[:, :1], (1, m.shape[1]))
+    diff = label[:, None] - m
+    pin = jnp.maximum(a * diff, (a - 1.0) * diff).mean(axis=1)
+    return jnp.sum(weight * pin), jnp.sum(weight)
+
+
 _ELEMENTWISE: Dict[str, Callable] = {
     "rmse": _rmse,
     "mae": _mae,
@@ -96,6 +110,7 @@ _ELEMENTWISE: Dict[str, Callable] = {
     "rmsle": _rmsle,
     "mphe": _mphe,
     "mape": _mape,
+    "quantile": _quantile_pinball,
 }
 
 
@@ -230,7 +245,7 @@ def is_device_metric(name: str, has_groups: bool) -> bool:
 
 
 def device_metric_contrib(name, margin, label, weight, group_rows, psum,
-                          huber_slope: float = 1.0):
+                          huber_slope: float = 1.0, quantile_alpha=(0.5,)):
     """Device-side psum-merged (num, den) for any device metric.
 
     The caller divides num/den on host (rmse additionally sqrts), so every
@@ -242,6 +257,8 @@ def device_metric_contrib(name, margin, label, weight, group_rows, psum,
             num, den = _error(margin, label, weight, arg)
         elif base == "mphe":
             num, den = _mphe(margin, label, weight, slope=huber_slope)
+        elif base == "quantile":
+            num, den = _quantile_pinball(margin, label, weight, quantile_alpha)
         else:
             num, den = _ELEMENTWISE[base](margin, label, weight)
         return psum(num), psum(den)

@@ -115,6 +115,37 @@ def _make_softmax(num_class: int, prob_output: bool) -> Objective:
     )
 
 
+def _make_quantile(alpha) -> Objective:
+    """reg:quantileerror (xgboost >= 2.0): pinball loss at one or several
+    quantiles. Multi-alpha trains one output per quantile (round-major trees,
+    like multiclass); g = 1{m >= y} - alpha, h = 1 (xgboost's convention for
+    the curvature-free pinball loss)."""
+    alphas = tuple(
+        float(a) for a in (alpha if isinstance(alpha, (list, tuple)) else [alpha])
+    )
+    if not alphas or not all(0.0 < a < 1.0 for a in alphas):
+        raise ValueError(
+            f"quantile_alpha must be in (0, 1), got {alphas!r}"
+        )
+    k = len(alphas)
+    a_vec = jnp.asarray(alphas, jnp.float32)
+
+    def gh(margin, label, weight):
+        ge = (margin >= label[:, None]).astype(jnp.float32)  # [N, K]
+        g = (ge - a_vec[None, :]) * weight[:, None]
+        h = jnp.broadcast_to(weight[:, None], g.shape)
+        return g, h
+
+    return Objective(
+        name="reg:quantileerror",
+        grad_hess=gh,
+        transform=(lambda m: m) if k > 1 else (lambda m: m[:, 0]),
+        num_outputs=k,
+        default_metric="quantile",
+        default_base_score=0.5,
+    )
+
+
 def _make_poisson() -> Objective:
     # log-link: pred = exp(margin); g = exp(m) - y; h = exp(m)
     def gh(margin, label, weight):
@@ -246,6 +277,7 @@ def get_objective(
     aft_loss_distribution: str = "normal",
     aft_loss_distribution_scale: float = 1.0,
     huber_slope: float = 1.0,
+    quantile_alpha=0.5,
 ) -> Objective:
     """Resolve an xgboost objective string to an Objective bundle.
 
@@ -270,6 +302,8 @@ def get_objective(
         return _make_squaredlogerror()
     if name == "reg:pseudohubererror":
         return _make_pseudohuber(slope=huber_slope)
+    if name == "reg:quantileerror":
+        return _make_quantile(quantile_alpha)
     if name == "count:poisson":
         return _make_poisson()
     if name == "reg:gamma":

@@ -79,6 +79,8 @@ class TrainParams:
     tweedie_variance_power: float = 1.5
     # reg:pseudohubererror
     huber_slope: float = 1.0
+    # reg:quantileerror target quantile(s): float or list of floats
+    quantile_alpha: float = 0.5
     # tpu_hist internals
     hist_impl: str = "auto"  # auto | scatter | onehot | partition | mixed | pallas
     # histogram MXU precision: auto (fast on accelerators, highest on CPU) |
