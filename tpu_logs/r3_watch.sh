@@ -1,0 +1,17 @@
+#!/bin/bash
+# Round-3 tunnel watchdog: probe the axon TPU backend until it comes up.
+# Appends one line per attempt to r3_probe.log; writes TUNNEL_UP marker file
+# on success and exits. Single-client tunnel: this only probes, never holds
+# the device (the probe process exits immediately after listing devices).
+L=/root/repo/tpu_logs
+while true; do
+  ts=$(date +%T)
+  out=$(timeout 240 python -c "import jax; print('DEVS', jax.devices())" 2>&1 | tail -2)
+  if echo "$out" | grep -q "DEVS"; then
+    echo "$ts UP: $out" >> $L/r3_probe.log
+    touch $L/TUNNEL_UP
+    exit 0
+  fi
+  echo "$ts down: $(echo "$out" | tr '\n' ' ' | cut -c1-160)" >> $L/r3_probe.log
+  sleep 180
+done

@@ -878,7 +878,8 @@ class TpuEngine:
 
         Returns the per-round metrics list (same schema as ``step``).
         Programs are cached per n_rounds; callers should use a fixed chunk
-        size (e.g. checkpoint_frequency) to avoid recompiles.
+        size (the driver uses ENV.SCAN_MAX_CHUNK, clamped to checkpoint
+        boundaries) to avoid recompiles.
         """
         if not self.can_batch_rounds():
             raise RuntimeError("host-side metrics require per-round stepping")

@@ -91,6 +91,13 @@ class _XGBoostEnv:
     ELASTIC_RESTART_RESOURCE_CHECK_S: float = 30.0
     ELASTIC_RESTART_GRACE_PERIOD_S: float = 10.0
     COMMUNICATION_SOFT_PLACEMENT: bool = True
+    # upper bound on rounds fused into one compiled lax.scan program in the
+    # batched fast path. Bounds compiled-program size and the stacked
+    # per-round outputs held live at once (the round-2 HIGGS-11M run fused
+    # all 100 rounds into a single program and crashed the TPU worker,
+    # tpu_logs/r2.log:180); 10 divides the usual 100-round protocols so the
+    # driver compiles exactly one scan program.
+    SCAN_MAX_CHUNK: int = 10
 
     def __getattribute__(self, item):
         old_val = object.__getattribute__(self, item)
@@ -601,9 +608,10 @@ def _train(
         if hasattr(model_cb, "before_training"):
             model_cb.before_training(proxy)
 
-    # Fast path: no per-round host interaction needed -> run whole
-    # checkpoint intervals as single compiled multi-round programs
-    # (lax.scan inside shard_map; see engine.step_many).
+    # Fast path: no per-round host interaction needed -> fuse rounds into
+    # compiled multi-round programs (lax.scan inside shard_map; see
+    # engine.step_many). Scan length is bounded by ENV.SCAN_MAX_CHUNK and
+    # clamped so no scan crosses a checkpoint boundary.
     use_batched = (
         not callbacks
         and obj is None
@@ -613,12 +621,19 @@ def _train(
         and boost_rounds_left > 1
     )
     if use_batched:
-        chunk = checkpoint_frequency if checkpoint_frequency else boost_rounds_left
+        # chunk size decoupled from checkpoint_frequency: scans never fuse
+        # more than SCAN_MAX_CHUNK rounds into one program, but checkpoints
+        # are still emitted exactly at checkpoint_frequency boundaries
+        chunk = max(1, ENV.SCAN_MAX_CHUNK)
         completed = 0
         while completed < boost_rounds_left:
             if state.stop_event.is_set():
                 raise RayXGBoostTrainingStopped("Training was aborted.")
             n = min(chunk, boost_rounds_left - completed)
+            if checkpoint_frequency:
+                # never scan across a checkpoint boundary
+                to_boundary = checkpoint_frequency - (completed % checkpoint_frequency)
+                n = min(n, to_boundary)
             chunk_started = time.time()
             chunk_results = engine.step_many(completed, n)
             round_times.extend([(time.time() - chunk_started) / n] * n)
@@ -640,7 +655,10 @@ def _train(
                     )
                     print(f"[{i}]\t{flat}")
             completed += n
-            if checkpoint_frequency:
+            if checkpoint_frequency and (
+                completed % checkpoint_frequency == 0
+                or completed == boost_rounds_left
+            ):
                 booster = engine.get_booster()
                 iteration = engine.iteration_offset + completed - 1
                 state.queue.put(
