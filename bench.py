@@ -59,13 +59,20 @@ def _probe_accelerator(timeout_s: float = 180.0, attempts: int = 3,
     # the backoff) from "plugin present but init failed/hung" (transient —
     # retry); jax silently falls back to cpu in the latter case when
     # JAX_PLATFORMS is unset, so checking default_backend() alone conflates
-    # the two
+    # the two. The public default_backend() check runs FIRST so the happy
+    # path never depends on the private _backend_factories attr; the private
+    # lookup is guarded and an unknown answer is treated as transient.
     code = (
         "import jax\n"
-        "from jax._src import xla_bridge as xb\n"
-        "plats = [p for p in xb._backend_factories if p != 'cpu']\n"
-        "print('NO_PLUGIN' if not plats else"
-        " ('ACCEL_OK' if jax.default_backend() != 'cpu' else 'INIT_FAIL'))\n"
+        "if jax.default_backend() != 'cpu':\n"
+        "    print('ACCEL_OK')\n"
+        "else:\n"
+        "    try:\n"
+        "        from jax._src import xla_bridge as xb\n"
+        "        plats = [p for p in xb._backend_factories if p != 'cpu']\n"
+        "    except Exception:\n"
+        "        plats = None  # unknown -> assume transient, retry\n"
+        "    print('NO_PLUGIN' if plats == [] else 'INIT_FAIL')\n"
     )
     for attempt in range(attempts):
         try:

@@ -125,6 +125,27 @@ def main() -> int:
         [r["train"]["ndcg@4"] for r in rresults], exp["rank_ndcg"], atol=1e-5
     )
 
+    # --- survival: batched rounds + device aft-nloglik on the 2-host mesh ---
+    sx, s_lo, s_hi = exp["sx"], exp["s_lo"], exp["s_hi"]
+    qn = sx.shape[0]
+    sshards = []
+    for rank in my_ranks:
+        idx = _get_sharding_indices(RayShardingMode.BATCH, rank, num_actors, qn)
+        sshards.append({
+            "data": sx[idx], "label": None, "weight": None,
+            "base_margin": None, "label_lower_bound": s_lo[idx],
+            "label_upper_bound": s_hi[idx], "qid": None,
+        })
+    sparams = parse_params({"objective": "survival:aft",
+                            "eval_metric": ["aft-nloglik"], "max_depth": 3})
+    seng = TpuEngine(sshards, sparams, num_actors=num_actors,
+                     evals=[(sshards, "train")])
+    assert seng.can_batch_rounds()
+    sresults = seng.step_many(0, int(exp["rounds"]))
+    np.testing.assert_allclose(
+        [r["train"]["aft-nloglik"] for r in sresults], exp["aft_nll"], atol=1e-5
+    )
+
     print(f"CHILD{pid} OK", flush=True)
     return 0
 

@@ -1,0 +1,116 @@
+"""Placement behavior (analog of ``xgboost_ray/tests/test_colocation.py``).
+
+The reference asserts WHERE work lands: SPREAD places training actors across
+nodes, PACK keeps a tune trial together, Queue/Event stay on the driver node
+(``test_colocation.py:17-139``). The TPU analog is device selection: which
+physical devices form the training mesh. These tests assert the actual
+chosen devices, not a strategy string.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from xgboost_ray_tpu.main import _select_mesh_devices, _get_placement_strategy
+
+
+class _FakeDev:
+    def __init__(self, i, proc):
+        self.id = i
+        self.process_index = proc
+
+    def __repr__(self):
+        return f"dev({self.id}@p{self.process_index})"
+
+
+def _fake_world(n_procs, per_proc):
+    return [
+        _FakeDev(p * per_proc + i, p) for p in range(n_procs) for i in range(per_proc)
+    ]
+
+
+def test_pack_fills_hosts_in_order():
+    devs = _fake_world(4, 4)
+    sel = _select_mesh_devices(4, "PACK", devs)
+    assert [d.id for d in sel] == [0, 1, 2, 3]
+    assert {d.process_index for d in sel} == {0}  # one host touched
+    sel8 = _select_mesh_devices(8, "PACK", devs)
+    assert {d.process_index for d in sel8} == {0, 1}
+
+
+def test_spread_takes_equal_share_from_every_host():
+    devs = _fake_world(4, 4)
+    sel = _select_mesh_devices(4, "SPREAD", devs)
+    assert [d.process_index for d in sel] == [0, 1, 2, 3]  # fault isolation
+    sel8 = _select_mesh_devices(8, "SPREAD", devs)
+    # two per host, strided within each host's ring
+    per_host = {}
+    for d in sel8:
+        per_host.setdefault(d.process_index, []).append(d.id % 4)
+    assert all(len(v) == 2 for v in per_host.values())
+    assert all(v == [0, 2] for v in per_host.values())
+
+
+def test_spread_single_host_strides_the_ring():
+    devs = _fake_world(1, 8)
+    sel = _select_mesh_devices(4, "SPREAD", devs)
+    assert [d.id for d in sel] == [0, 2, 4, 6]
+    assert [d.id for d in _select_mesh_devices(3, "SPREAD", devs)] == [0, 2, 5]
+
+
+def test_selection_preserves_process_contiguous_order():
+    devs = _fake_world(2, 4)
+    sel = _select_mesh_devices(6, "SPREAD", devs)
+    procs = [d.process_index for d in sel]
+    assert procs == sorted(procs)  # engine's multi-host layout requirement
+    assert len(sel) == 6
+
+
+def test_oversubscription_returns_all_devices():
+    devs = _fake_world(2, 2)
+    assert _select_mesh_devices(9, "SPREAD", devs) == devs
+    assert _select_mesh_devices(9, "PACK", devs) == devs
+
+
+def test_strategy_choice_matches_reference_semantics(monkeypatch):
+    assert _get_placement_strategy(in_tune_session=True) == "PACK"
+    assert _get_placement_strategy(in_tune_session=False) == "SPREAD"
+    monkeypatch.setenv("RXGB_USE_SPREAD_STRATEGY", "0")
+    assert _get_placement_strategy(in_tune_session=False) == "PACK"
+
+
+def test_training_mesh_actually_spreads_on_virtual_mesh():
+    """End-to-end: with 4 actors on the 8-device mesh, SPREAD trains on the
+    strided devices and PACK (via placement_options) on the first four."""
+    from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(512, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    all_devs = jax.devices()
+    captured = {}
+
+    from xgboost_ray_tpu import engine as engine_mod
+
+    orig_init = engine_mod.TpuEngine.__init__
+
+    def spy_init(self, *a, **kw):
+        orig_init(self, *a, **kw)
+        captured[captured.pop("key")] = list(self.mesh.devices.ravel())
+
+    engine_mod.TpuEngine.__init__ = spy_init
+    try:
+        captured["key"] = "spread"
+        train({"objective": "binary:logistic", "max_depth": 3}, RayDMatrix(x, y),
+              2, ray_params=RayParams(num_actors=4))
+        captured["key"] = "pack"
+        train({"objective": "binary:logistic", "max_depth": 3}, RayDMatrix(x, y),
+              2, ray_params=RayParams(num_actors=4,
+                                      placement_options={"strategy": "PACK"}))
+    finally:
+        engine_mod.TpuEngine.__init__ = orig_init
+
+    assert captured["pack"] == list(all_devs[:4])
+    assert captured["spread"] == [all_devs[i] for i in (0, 2, 4, 6)]
+    assert captured["spread"] != captured["pack"]

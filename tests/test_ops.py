@@ -677,3 +677,45 @@ def test_quantile_save_load_and_sklearn():
     reg.fit(x, y)
     p = reg.predict(x)
     assert p.shape == (600,)
+
+
+def test_quantile_metric_alpha_threading_and_mismatch_guard():
+    """compute_metric/elementwise_contrib take quantile_alpha (ADVICE r2:
+    host-side evaluation silently scored with alpha=0.5); a margin/alpha
+    count mismatch with >1 alphas raises instead of broadcasting."""
+    import numpy as np
+    import pytest
+    from xgboost_ray_tpu.ops.metrics import compute_metric
+
+    y = np.array([0.0, 1.0, 2.0, 4.0], np.float32)
+    m = np.array([1.0, 1.0, 1.0, 1.0], np.float32)
+    v10 = compute_metric("quantile", m, y, quantile_alpha=0.1)
+    v90 = compute_metric("quantile", m, y, quantile_alpha=0.9)
+    # pinball: alpha * max(y-m, 0) + (1-alpha) * max(m-y, 0)
+    def pinball(a):
+        d = y - m
+        return float(np.mean(np.maximum(a * d, (a - 1) * d)))
+    assert v10 == pytest.approx(pinball(0.1), rel=1e-5)
+    assert v90 == pytest.approx(pinball(0.9), rel=1e-5)
+    assert v10 != pytest.approx(v90)
+    # one alpha broadcasts over multi-output margins; >1 mismatched raises
+    m2 = np.stack([m, m], axis=1)
+    compute_metric("quantile", m2, y, quantile_alpha=0.5)
+    with pytest.raises(ValueError, match="must align"):
+        compute_metric("quantile", m2, y, quantile_alpha=(0.1, 0.5, 0.9))
+
+
+def test_mphe_metric_huber_slope_threading():
+    import numpy as np
+    import pytest
+    from xgboost_ray_tpu.ops.metrics import compute_metric
+
+    y = np.zeros(4, np.float32)
+    m = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+    v1 = compute_metric("mphe", m, y, huber_slope=1.0)
+    v3 = compute_metric("mphe", m, y, huber_slope=3.0)
+    def mphe(s):
+        return float(np.mean(s * s * (np.sqrt(1 + (m / s) ** 2) - 1)))
+    assert v1 == pytest.approx(mphe(1.0), rel=1e-5)
+    assert v3 == pytest.approx(mphe(3.0), rel=1e-5)
+    assert v1 != pytest.approx(v3)

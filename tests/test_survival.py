@@ -78,3 +78,60 @@ def test_gamma_and_tweedie_objectives():
         pred = bst.predict(x)
         assert np.all(pred > 0)
         assert np.corrcoef(np.log(pred), np.log(mu))[0, 1] > 0.8, objective
+
+
+def test_aft_nloglik_device_contrib_matches_host():
+    """Device (num, den) contribution == host scipy implementation across
+    censoring kinds and both distributions (VERDICT r2 #6)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from xgboost_ray_tpu.ops.survival import aft_nloglik_contrib, aft_nloglik_np
+
+    rng = np.random.RandomState(9)
+    n = 400
+    margin = rng.randn(n, 1).astype(np.float32)
+    lower = np.exp(rng.randn(n).astype(np.float32))
+    kind = rng.randint(0, 3, size=n)
+    upper = np.where(
+        kind == 0, lower,                      # uncensored
+        np.where(kind == 1, np.inf, lower * 2.0)  # right- / interval-censored
+    ).astype(np.float32)
+    weight = rng.rand(n).astype(np.float32) + 0.5
+    for dist in ("normal", "logistic"):
+        for sigma in (1.0, 1.7):
+            num, den = aft_nloglik_contrib(
+                jnp.asarray(margin), jnp.asarray(lower), jnp.asarray(upper),
+                jnp.asarray(weight), distribution=dist, sigma=sigma,
+            )
+            got = float(num) / float(den)
+            want = aft_nloglik_np(margin, lower, upper, weight,
+                                  distribution=dist, sigma=sigma)
+            assert abs(got - want) < 5e-4 * max(1.0, abs(want)), (dist, sigma)
+
+
+def test_aft_batches_rounds_with_device_metric():
+    """survival:aft + aft-nloglik no longer forces per-round host stepping:
+    the engine reports batchable and the scan path reproduces the per-round
+    metric series."""
+    import numpy as np
+    from xgboost_ray_tpu.engine import TpuEngine
+    from xgboost_ray_tpu.params import parse_params
+
+    rng = np.random.RandomState(10)
+    n = 600
+    x = rng.randn(n, 4).astype(np.float32)
+    t = np.exp(0.7 * x[:, 0] + 0.2 * rng.randn(n)).astype(np.float32)
+    hi = np.where(rng.rand(n) < 0.3, np.inf, t).astype(np.float32)
+    shards = [{"data": x, "label": None, "weight": None, "base_margin": None,
+               "label_lower_bound": t, "label_upper_bound": hi, "qid": None}]
+    params = parse_params({"objective": "survival:aft",
+                           "eval_metric": ["aft-nloglik"], "max_depth": 3})
+    eng = TpuEngine(shards, params, num_actors=2, evals=[(shards, "train")])
+    assert eng.can_batch_rounds()
+    assert eng._device_metrics == ["aft-nloglik"] and not eng._host_metrics
+    batched = [r["train"]["aft-nloglik"] for r in eng.step_many(0, 5)]
+    assert batched[-1] < batched[0]
+
+    eng2 = TpuEngine(shards, params, num_actors=2, evals=[(shards, "train")])
+    stepped = [eng2.step(i)["train"]["aft-nloglik"] for i in range(5)]
+    np.testing.assert_allclose(batched, stepped, atol=1e-5)

@@ -1,0 +1,50 @@
+"""Round-3: bisect the 11M-row worker crash (r2.log:180).
+
+Trains a few rounds at increasing row counts with the bounded-chunk scan
+path, logging HBM-relevant sizes, so the crash (if it persists) is localized
+to a row count and phase. Run serialized on the tunnel.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    print(f"backend={jax.default_backend()} devices={jax.devices()}", flush=True)
+    sys.path.insert(0, "/root/repo")
+    from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+    rows_list = [int(float(r)) for r in os.environ.get(
+        "BISECT_ROWS", "2e6,4e6,8e6,11e6").split(",")]
+    rounds = int(os.environ.get("BISECT_ROUNDS", "3"))
+    for n_rows in rows_list:
+        rng = np.random.RandomState(0)
+        x = rng.standard_normal((n_rows, 28)).astype(np.float32)
+        y = (x[:, 0] - 0.5 * x[:, 1] > 0).astype(np.float32)
+        print(f"--- rows={n_rows} gen done {x.nbytes/1e9:.2f}GB host ---", flush=True)
+        t0 = time.time()
+        try:
+            bst = train(
+                {"objective": "binary:logistic", "eval_metric": ["logloss"],
+                 "max_depth": 6, "max_bin": 256, "tree_method": "tpu_hist"},
+                RayDMatrix(x, y), num_boost_round=rounds,
+                ray_params=RayParams(num_actors=1, checkpoint_frequency=0),
+            )
+            print(f"rows={n_rows} OK wall={time.time()-t0:.1f}s "
+                  f"rounds={bst.num_boosted_rounds()}", flush=True)
+        except Exception as exc:
+            print(f"rows={n_rows} FAIL {type(exc).__name__}: {str(exc)[:300]}",
+                  flush=True)
+            raise
+        del x, y
+
+
+if __name__ == "__main__":
+    main()

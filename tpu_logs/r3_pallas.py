@@ -1,0 +1,83 @@
+"""Round-3: decide the Pallas kernel's fate (VERDICT r2 #3).
+
+Parity + timing for the bins-on-rows presorted kernel vs the XLA einsum at
+production shapes: 1M x 28 x 256, nodes in {1, 8, 64}, both precisions.
+Keep (and promote) only if it is exact and faster; otherwise it gets
+deleted.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+os.environ["RXGB_ENABLE_PALLAS"] = "1"
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    print(f"backend={jax.default_backend()}", flush=True)
+    sys.path.insert(0, "/root/repo")
+    from xgboost_ray_tpu.ops import hist_pallas as hp
+    from xgboost_ray_tpu.ops.histogram import hist_partition_presorted
+
+    assert hp.PALLAS_AVAILABLE
+    n, f, max_bin = 1_000_000, 28, 256
+    nbt = max_bin + 1
+    rng = np.random.RandomState(0)
+    bins_np = rng.randint(0, nbt, size=(n, f)).astype(np.uint8)
+    gh_np = rng.randn(n, 2).astype(np.float32)
+
+    for nodes in (1, 8, 64):
+        # contiguous node segments (the presorted invariant)
+        counts_np = np.full(nodes, n // nodes, np.int32)
+        counts_np[-1] += n - counts_np.sum()
+        order_np = np.arange(n, dtype=np.int32)
+        bins = jnp.asarray(bins_np)
+        gh = jnp.asarray(gh_np)
+        order = jnp.asarray(order_np)
+        counts = jnp.asarray(counts_np)
+        for precision in ("highest", "fast"):
+            ref_fn = jax.jit(lambda b, g, o, c: hist_partition_presorted(
+                b, g, o, c, nodes, nbt, precision=precision))
+            pal_fn = jax.jit(lambda b, g, o, c: hp.hist_pallas_presorted(
+                b, g, o, c, nodes, nbt, precision=precision))
+            try:
+                ref = ref_fn(bins, gh, order, counts)
+                ref_np = np.asarray(ref)
+                pal = pal_fn(bins, gh, order, counts)
+                pal_np = np.asarray(pal)
+            except Exception as exc:
+                print(f"nodes={nodes} prec={precision} COMPILE/RUN FAIL "
+                      f"{type(exc).__name__}: {str(exc)[:200]}", flush=True)
+                continue
+            scale = max(np.abs(ref_np).max(), 1e-6)
+            err = np.abs(pal_np - ref_np).max() / scale
+            # timing: scan-repeat inside one program, one scalar sync
+            def timed(fn, reps=20):
+                def body(c, _):
+                    h = fn(bins, gh, order, counts)
+                    return c + h[0, 0, 0, 0], None
+                prog = jax.jit(lambda: jax.lax.scan(
+                    body, jnp.float32(0.0), None, length=reps)[0])
+                prog()  # compile+warm
+                t0 = time.time(); float(prog()); dt = time.time() - t0
+                return dt / reps
+            t_ref = timed(lambda b=bins, g=gh, o=order, c=counts:
+                          hist_partition_presorted(b, g, o, c, nodes, nbt,
+                                                   precision=precision))
+            t_pal = timed(lambda b=bins, g=gh, o=order, c=counts:
+                          hp.hist_pallas_presorted(b, g, o, c, nodes, nbt,
+                                                   precision=precision))
+            verdict = "PARITY_OK" if err < 1e-5 else f"PARITY_FAIL rel={err:.3e}"
+            print(f"nodes={nodes} prec={precision} {verdict} "
+                  f"einsum={t_ref*1e3:.1f}ms pallas={t_pal*1e3:.1f}ms "
+                  f"speedup={t_ref/max(t_pal,1e-9):.2f}x", flush=True)
+
+
+if __name__ == "__main__":
+    main()

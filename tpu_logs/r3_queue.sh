@@ -1,0 +1,21 @@
+#!/bin/bash
+# Round-3 serialized TPU queue (single-client tunnel — never overlap).
+# Order: crash bisection first (validates the 11M fix), then the headline
+# bench while the tunnel is known-good, then overhead attribution, MSLR
+# ranking, pallas fate, precision quality.
+cd /root/repo
+export PYTHONPATH=/root/repo:${PYTHONPATH}
+export JAX_COMPILATION_CACHE_DIR=/tmp/jax_cache
+L=/root/repo/tpu_logs
+run() {  # run <name> <timeout_s> <cmd...>
+  echo "=== $1 start $(date +%T) ===" >> $L/r3.log
+  timeout "$2" "${@:3}" >> $L/r3.log 2>&1
+  echo "=== $1 exit=$? $(date +%T) ===" >> $L/r3.log
+}
+run bisect 3600 python tpu_logs/r3_bisect.py
+run bench_full 4000 python bench.py
+run steady 2400 python tpu_logs/r3_steady.py
+run mslr 3600 python tests/release/benchmark_ranking.py 1 100
+run pallas 2400 python tpu_logs/r3_pallas.py
+run quality 1800 python tpu_logs/quality_fast.py
+echo "R3 QUEUE ALL DONE $(date +%T)" >> $L/r3.log

@@ -1,0 +1,43 @@
+"""Round-3: steady-state per-round cost + overhead attribution at 1M x 28.
+
+Times two training lengths with the bounded-chunk scan path (difference
+isolates the marginal per-round cost from compile+data setup), then one
+profiled chunk when RXGB_PROFILE_DIR is set. VERDICT r2 #2: tree build was
+~0.5 s while rounds cost 0.8-1.4 s more than that — attribute the rest.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    print(f"backend={jax.default_backend()}", flush=True)
+    sys.path.insert(0, "/root/repo")
+    from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+    n_rows = int(float(os.environ.get("STEADY_ROWS", "1e6")))
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal((n_rows, 28)).astype(np.float32)
+    y = (x[:, 0] - 0.5 * x[:, 1] > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "eval_metric": ["logloss"],
+              "max_depth": 6, "max_bin": 256, "tree_method": "tpu_hist"}
+
+    for rounds in (10, 50):
+        t0 = time.time()
+        train(params, RayDMatrix(x, y), num_boost_round=rounds,
+              ray_params=RayParams(num_actors=1, checkpoint_frequency=0))
+        wall = time.time() - t0
+        print(f"rounds={rounds} wall={wall:.1f}s", flush=True)
+    # marginal/round = (wall50 - wall10) / 40 with identical compiles
+    # (same chunk program sizes thanks to SCAN_MAX_CHUNK=10 divisor).
+
+
+if __name__ == "__main__":
+    main()

@@ -105,6 +105,7 @@ class _EvalSet:
         self.label_np = None
         self.weight_np = None
         self.group_rows_dev = None  # sharded [NG, G] layout for device ndcg/map
+        self.bounds_dev = None  # (lower, upper) device rows for device aft-nloglik
 
 
 class _EvalArrs(NamedTuple):
@@ -119,6 +120,7 @@ class _EvalArrs(NamedTuple):
     margins: Any
     group_rows: Any  # [NG, G] or scalar placeholder
     margins_static: Any  # dart only; scalar placeholder otherwise
+    bounds: Any  # (lower, upper) rows or scalar placeholder (survival only)
 
 
 class TpuEngine:
@@ -324,7 +326,9 @@ class TpuEngine:
         # weight), so cut points concentrate where the weighted mass is.
         # weight_dev is all-ones when the user passed no weights, which makes
         # the weighted sketch bit-identical to the unweighted one.
-        self.bins, self.cuts = self._sketch_and_bin(x_dev, self.valid, self.weight_dev)
+        self.bins, self.cuts, self._feat_has_missing = self._sketch_and_bin(
+            x_dev, self.valid, self.weight_dev
+        )
 
         # ---- ranking group structure (per device block) ------------------
         # built whenever qid exists (ranking gradients AND device ndcg/map
@@ -385,11 +389,19 @@ class TpuEngine:
             else (es.group_rows_dev is not None)
             for es in self.evals
         )
+        has_bounds = all(
+            (self.bounds_dev is not None)
+            if es.is_train
+            else (es.bounds_dev is not None)
+            for es in self.evals
+        )
         self._device_metrics = [
-            m for m in self.metric_names if is_device_metric(m, has_groups)
+            m for m in self.metric_names if is_device_metric(m, has_groups, has_bounds)
         ]
         self._host_metrics = [
-            m for m in self.metric_names if not is_device_metric(m, has_groups)
+            m
+            for m in self.metric_names
+            if not is_device_metric(m, has_groups, has_bounds)
         ]
         if self._host_metrics and jax.process_count() > 1:
             raise NotImplementedError(
@@ -399,6 +411,12 @@ class TpuEngine:
             )
 
         self.trees: List[Tree] = []  # host-side forest, one [K*T, heap] entry per round
+        # per-round device forests pending host transfer: under the tunneled
+        # TPU relay every host read costs ~70-90 ms, so the per-round step
+        # path defers the (tiny) forest transfer and flushes in one batched
+        # stack per checkpoint/get_booster instead of 9 reads per round
+        # (VERDICT r2 #2: per-round np.asarray transfers)
+        self._trees_dev: List[Tree] = []
         # incremental stacked-forest cache (amortized O(1) copies per tree;
         # re-stacking the whole forest per checkpoint interval was O(T^2))
         self._stack_entries = 0  # how many of (_init_trees + trees) are stacked
@@ -483,16 +501,24 @@ class TpuEngine:
                 code_cuts = jnp.arange(max_bin - 1, dtype=cuts.dtype) + 0.5
                 cuts = jnp.where(cat_mask[:, None], code_cuts[None, :], cuts)
             bins = binning.bin_matrix(x, cuts, max_bin)
-            return bins, cuts
+            # global per-feature "has any missing value" mask (padding rows
+            # are excluded — they bin to the missing bucket by construction):
+            # lets the tree builder zero phantom missing mass that the
+            # subtraction-reconstructed bucket picks up under fast precision
+            miss_cnt = jnp.sum(
+                ((bins == max_bin) & v[:, None]).astype(jnp.float32), axis=0
+            )
+            has_missing = jax.lax.psum(miss_cnt, "actors") > 0
+            return bins, cuts, has_missing
 
         mapped = shard_map(
             fn,
             mesh=self.mesh,
             in_specs=(P("actors"), P("actors"), P("actors")),
-            out_specs=(P("actors"), P()),
+            out_specs=(P("actors"), P(), P()),
         )
-        bins, cuts = jax.jit(mapped)(x_dev, valid, weight_dev)
-        return bins, cuts
+        bins, cuts, has_missing = jax.jit(mapped)(x_dev, valid, weight_dev)
+        return bins, cuts, has_missing
 
     def _bin_with_cuts(self, x_dev):
         max_bin = self.params.max_bin
@@ -594,6 +620,11 @@ class TpuEngine:
         es.weight_np = weight
         es.lower_np = lo if lo is not None else label
         es.upper_np = hi if hi is not None else es.lower_np
+        if self.is_survival and es.lower_np is not None:
+            es.bounds_dev = (
+                put_rows(es.lower_np, np.float32, fill=1.0),
+                put_rows(es.upper_np, np.float32, fill=1.0),
+            )
         margins_static = np.full(
             (x.shape[0], self.n_outputs), self.base_margin0, np.float32
         )
@@ -685,6 +716,7 @@ class TpuEngine:
                         colsample_bynode=params.colsample_bynode,
                         allreduce=psum,
                         feature_log_weights=self._log_fw,
+                        feat_has_missing=self._feat_has_missing,
                     )
                     trees.append(tree)
                     new_margins = new_margins.at[:, k].add(row_value / t_par)
@@ -700,14 +732,14 @@ class TpuEngine:
             return new_margins, tuple(new_eval_margins), forest
 
         def metric_contribs(new_margins, new_eval_margins, label, w_eff,
-                            train_group_rows, eval_data):
+                            train_group_rows, eval_data, bounds=None):
             """Post-update psum'd (num, den) pairs per eval set x metric."""
             contribs = []
             ei = 0
             for es in self.evals:
                 if es.is_train:
                     m, lab, w = new_margins, label, w_eff
-                    gr = train_group_rows
+                    gr, bnd = train_group_rows, bounds
                 else:
                     ed = eval_data[ei]
                     m, lab, w = (
@@ -715,7 +747,7 @@ class TpuEngine:
                         ed.label,
                         ed.weight * ed.valid.astype(jnp.float32),
                     )
-                    gr = ed.group_rows
+                    gr, bnd = ed.group_rows, ed.bounds
                     ei += 1
                 set_contribs = []
                 for name in dev_metrics:
@@ -728,6 +760,9 @@ class TpuEngine:
                                 if isinstance(params.quantile_alpha, (list, tuple))
                                 else [params.quantile_alpha]
                             ),
+                            bounds=bnd,
+                            aft_distribution=params.aft_loss_distribution,
+                            aft_sigma=params.aft_loss_distribution_scale,
                         )
                     )
                 contribs.append(tuple(set_contribs))
@@ -750,6 +785,9 @@ class TpuEngine:
                 es.margins_static
                 if es.margins_static is not None
                 else jnp.zeros((), jnp.float32),
+                es.bounds_dev
+                if es.bounds_dev is not None
+                else jnp.zeros((), jnp.float32),
             ))
         return tuple(out)
 
@@ -762,6 +800,7 @@ class TpuEngine:
                 P("actors"), P("actors"), P("actors"), P("actors"), P("actors"),
                 P("actors") if es.group_rows_dev is not None else P(),
                 P("actors") if es.margins_static is not None else P(),
+                (P("actors"), P("actors")) if es.bounds_dev is not None else P(),
             ))
         return tuple(specs)
 
@@ -779,6 +818,7 @@ class TpuEngine:
             contribs = metric_contribs(
                 new_margins, new_eval_margins, label,
                 weight * valid.astype(jnp.float32), group_rows, eval_data,
+                bounds=bounds,
             )
             return new_margins, new_eval_margins, forest, contribs
 
@@ -837,6 +877,7 @@ class TpuEngine:
                 contribs = metric_contribs(
                     new_margins, new_eval_margins, label,
                     weight * valid.astype(jnp.float32), group_rows, eval_data,
+                    bounds=bounds,
                 )
                 return (new_margins, new_eval_margins), (forest, contribs)
 
@@ -911,6 +952,7 @@ class TpuEngine:
             if not es.is_train:
                 es.margins = new_eval_margins[ei]
                 ei += 1
+        self._flush_trees()  # keep round order if per-round steps preceded
         forests_np = jax.tree.map(np.asarray, forests)  # [n, K*T, heap] fields
         for r in range(n_rounds):
             self.trees.append(jax.tree.map(lambda a: a[r], forests_np))
@@ -983,15 +1025,26 @@ class TpuEngine:
             if not es.is_train:
                 es.margins = new_eval_margins[ei]
                 ei += 1
-        self.trees.append(jax.tree.map(np.asarray, forest))
+        self._trees_dev.append(forest)
 
-        # metrics
+        # metrics: one stacked transfer for all (num, den) scalars instead of
+        # a blocking host read per scalar (each read is a relay round trip)
+        flat_scalars = [
+            c
+            for si in range(len(self.evals))
+            for mi in range(len(self._device_metrics))
+            for c in contribs[si][mi]
+        ]
+        flat_vals = (
+            np.asarray(jnp.stack(flat_scalars)) if flat_scalars else np.zeros(0)
+        )
         results: Dict[str, Dict[str, float]] = {}
+        fi = 0
         for si, es in enumerate(self.evals):
             row: Dict[str, float] = {}
             for mi, name in enumerate(self._device_metrics):
-                num, den = contribs[si][mi]
-                num, den = float(num), float(den)
+                num, den = float(flat_vals[fi]), float(flat_vals[fi + 1])
+                fi += 2
                 val = num / max(den, 1e-12)
                 base, _ = parse_metric_name(name)
                 row[name] = float(np.sqrt(val)) if base in ("rmse", "rmsle") else val
@@ -1016,6 +1069,8 @@ class TpuEngine:
                         es.label_np if es.label_np is not None else self.label_np,
                         es.weight_np,
                         group_ptr=es.group_ptr,
+                        huber_slope=self.params.huber_slope,
+                        quantile_alpha=self.params.quantile_alpha,
                     )
             results[es.name] = row
         return results
@@ -1034,6 +1089,7 @@ class TpuEngine:
         """Stacked [T, heap] forest with incremental appends: only rounds added
         since the last call are copied into capacity-doubling buffers, so T/k
         checkpoints over T rounds cost O(T) total tree copies, not O(T^2)."""
+        self._flush_trees()
         all_trees = self._init_trees + self.trees
         if not all_trees:
             raise ValueError("empty forest")
@@ -1056,6 +1112,26 @@ class TpuEngine:
         self._stack_rows = need
         self._stack_entries = len(all_trees)
         return Tree(*[f[: self._stack_rows] for f in self._stack_buf])
+
+    def _flush_trees(self) -> None:
+        """Transfer any pending per-round device forests to host in one
+        batched stack (one read per Tree field, not per round x field)."""
+        if not self._trees_dev:
+            return
+        if len(self._trees_dev) == 1:
+            self.trees.append(jax.tree.map(np.asarray, self._trees_dev[0]))
+        else:
+            stacked = jax.tree.map(
+                lambda *xs: np.asarray(jnp.stack(xs)), *self._trees_dev
+            )
+            for r in range(len(self._trees_dev)):
+                self.trees.append(jax.tree.map(lambda a: a[r], stacked))
+        self._trees_dev.clear()
+
+    @property
+    def num_round_trees(self) -> int:
+        """Rounds recorded so far (host-resident + pending device forests)."""
+        return len(self.trees) + len(self._trees_dev)
 
     def get_booster(self) -> RayXGBoostBooster:
         forest = self._stacked_forest()
@@ -1167,6 +1243,7 @@ class TpuEngine:
             contribs = metric_contribs(
                 m_full, new_eval_margins, label,
                 weight * valid.astype(jnp.float32), group_rows, eval_data,
+                bounds=bounds,
             )
             return m_full, tuple(new_eval_margins), forest, round_forest, contribs
 
@@ -1281,7 +1358,7 @@ class TpuEngine:
             if not es.is_train:
                 es.margins = new_eval_margins[ei]
                 ei += 1
-        self.trees.append(jax.tree.map(np.asarray, round_forest))
+        self._trees_dev.append(round_forest)
         w_new_vec = w_post
         w_new_vec[self.dart_t : self.dart_t + self.n_outputs] = new_w
         self.dart_weights = w_new_vec
@@ -1305,6 +1382,8 @@ class TpuEngine:
                         es.label_np if es.label_np is not None else self.label_np,
                         es.weight_np,
                         group_ptr=es.group_ptr,
+                        huber_slope=self.params.huber_slope,
+                        quantile_alpha=self.params.quantile_alpha,
                     )
             results[es.name] = row
         return results

@@ -298,12 +298,52 @@ def _create_actor(
 def _get_placement_strategy(in_tune_session: bool) -> str:
     """SPREAD for standalone training (fault isolation), PACK inside tuning
     trials — the reference's strategy choice (``main.py:1581-1599``,
-    ``tune.py:123``), gated on RXGB_USE_SPREAD_STRATEGY. On TPU the mesh
-    placement is physical, but schedulers above (multi-slice trial runners)
-    still consume this hint via get_tune_resources()."""
+    ``tune.py:123``), gated on RXGB_USE_SPREAD_STRATEGY. Consumed by
+    ``_select_mesh_devices`` (actual mesh placement) and re-exported through
+    ``get_tune_resources()`` for schedulers above."""
     if in_tune_session:
         return "PACK"
     return "SPREAD" if ENV.USE_SPREAD_STRATEGY else "PACK"
+
+
+def _select_mesh_devices(num: int, strategy: str, devices=None) -> list:
+    """Choose which physical devices form the training mesh — the TPU analog
+    of the reference's placement group (``main.py:958-1019``): there,
+    SPREAD/PACK decides which *nodes* host the actors; here it decides which
+    devices (and thereby hosts) host the mesh shards.
+
+    PACK fills hosts/devices in order — fewest hosts touched, the locality
+    choice for tune trials sharing one machine. SPREAD takes an equal share
+    from every host and an even stride across each host's device ring —
+    fault isolation across hosts and maximal spacing on the ICI ring, the
+    reference's default for standalone training.
+
+    The selection is returned in jax.devices() order (process-contiguous),
+    which the engine's multi-host row layout requires.
+    """
+    import jax
+
+    devices = list(devices) if devices is not None else list(jax.devices())
+    if num >= len(devices) or num <= 0:
+        return devices
+    if strategy == "PACK":
+        return devices[:num]
+    by_proc: Dict[int, list] = {}
+    for pos, d in enumerate(devices):
+        by_proc.setdefault(getattr(d, "process_index", 0), []).append((pos, d))
+    procs = sorted(by_proc)
+    base, extra = divmod(num, len(procs))
+    chosen = []
+    for i, p in enumerate(procs):
+        k = base + (1 if i < extra else 0)
+        group = by_proc[p]
+        if k >= len(group):
+            chosen.extend(group)
+        else:
+            # int(j * len / k) is strictly increasing when len > k
+            chosen.extend(group[int(j * len(group) / k)] for j in range(k))
+    chosen.sort(key=lambda t: t[0])
+    return [d for _, d in chosen[:num]]
 
 
 def _handle_queue(queue: Queue, checkpoint: _Checkpoint, callback_returns: Dict):
@@ -364,7 +404,7 @@ class _EngineBoosterProxy:
         self._cached_rounds = -1
 
     def _materialize(self) -> RayXGBoostBooster:
-        n = len(self._engine.trees)
+        n = self._engine.num_round_trees
         if self._cached is None or self._cached_rounds != n:
             self._cached = self._engine.get_booster()
             self._cached_rounds = n
@@ -544,6 +584,15 @@ def _train(
 
     _sess = _tune_mod.get_session()
     trial_devices = getattr(_sess, "devices", None) if _sess else None
+    if trial_devices is None:
+        # real placement: SPREAD/PACK (or the user's placement_options
+        # override) decides WHICH devices form the mesh, not just a hint
+        strategy = None
+        if ray_params.placement_options:
+            strategy = ray_params.placement_options.get("strategy")
+        if strategy is None:
+            strategy = _get_placement_strategy(in_tune_session=_sess is not None)
+        trial_devices = _select_mesh_devices(len(alive), str(strategy).upper())
     engine = TpuEngine(
         train_shards,
         parsed,

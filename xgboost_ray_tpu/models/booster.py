@@ -11,6 +11,7 @@ predict / save / load / dump.
 import base64
 import io
 import json
+import warnings
 from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
@@ -347,6 +348,14 @@ class RayXGBoostBooster:
             if iteration_range is not None and iteration_range != (0, 0):
                 booster = self.slice_rounds(iteration_range[0], iteration_range[1])
             if pred_interactions:
+                if approx_contribs:
+                    warnings.warn(
+                        "approx_contribs=True is ignored with "
+                        "pred_interactions: only the exact "
+                        "O(2^depth * depth^2) interactions kernel is "
+                        "implemented (xgboost's approximate interactions "
+                        "path has no TPU equivalent here)."
+                    )
                 return booster.predict_interactions_np(
                     x, ntree_limit=ntree_limit, base_margin=base_margin
                 )

@@ -157,6 +157,7 @@ def build_tree(
     colsample_bynode: float = 1.0,
     allreduce: Callable[[jnp.ndarray], jnp.ndarray] = lambda x: x,
     feature_log_weights: Optional[jnp.ndarray] = None,  # [F] log(fw), -inf at 0
+    feat_has_missing: Optional[jnp.ndarray] = None,  # [F] bool, global
 ):
     """Grow one tree. Returns (Tree, row_value[N]) — row_value is the leaf
     value each row receives (learning-rate scaled), used to update margins
@@ -214,7 +215,27 @@ def build_tree(
             (sentinel n for unused slots). Presorted paths consume it directly
             as the row order — the padded-block gather is then the only copy;
             gather-based paths materialize the selection first.
+
+            The missing bucket is reconstructed by subtraction (node_total -
+            sum of regular bins), so with hist_precision="fast" the bf16
+            rounding residue of the regular bins lands there; for features
+            with NO missing values (known globally from the binned matrix)
+            the bucket is exactly zero, so it is zeroed to keep phantom
+            missing mass from steering the learned default direction.
             """
+            return _zero_phantom_missing(
+                _build_raw(gh_b, pos_b, order_b, counts_b, nn, rows_sel)
+            )
+
+        def _zero_phantom_missing(h):
+            if feat_has_missing is None:
+                return h
+            # h: [nn, F, nbt, 2]; zero the last (missing) bucket where the
+            # feature provably has no missing values
+            keep = feat_has_missing[None, :, None].astype(h.dtype)
+            return h.at[:, :, -1, :].multiply(keep)
+
+        def _build_raw(gh_b, pos_b, order_b, counts_b, nn, rows_sel=None):
             def gathered():
                 if rows_sel is None:
                     return bins, gh_b

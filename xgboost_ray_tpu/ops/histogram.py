@@ -442,11 +442,15 @@ def build_histogram(
         return hist_partition(bins, gh, pos, n_nodes, n_bins_total,
                               precision=precision)
     if impl == "pallas":
-        try:
-            from xgboost_ray_tpu.ops import hist_pallas
+        # no silent fallback: a user explicitly opting into the kernel must
+        # not silently get a different impl with different perf (VERDICT r2)
+        from xgboost_ray_tpu.ops import hist_pallas
 
-            return hist_pallas.hist_pallas(bins, gh, pos, n_nodes, n_bins_total,
-                                           precision=precision)
-        except Exception:
-            return hist_scatter(bins, gh, pos, n_nodes, n_bins_total)
+        if not hist_pallas.PALLAS_AVAILABLE:
+            raise RuntimeError(
+                "hist_impl='pallas' requested but the Pallas TPU kernel is "
+                "unavailable on this backend; use hist_impl='auto'."
+            )
+        return hist_pallas.hist_pallas(bins, gh, pos, n_nodes, n_bins_total,
+                                       precision=precision)
     return hist_scatter(bins, gh, pos, n_nodes, n_bins_total)

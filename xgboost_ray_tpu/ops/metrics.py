@@ -91,9 +91,15 @@ def _quantile_pinball(m, label, weight, alphas=(0.5,)):
     if m.ndim == 1:
         m = m[:, None]
     if m.shape[1] != a.shape[1]:
-        # margin columns and alphas must align; fall back to broadcasting a
-        # single alpha over all outputs
-        a = jnp.broadcast_to(a[:, :1], (1, m.shape[1]))
+        if a.shape[1] != 1:
+            # a mismatch with >1 alphas means the caller wired the wrong
+            # outputs/alphas together — broadcasting would silently score
+            # every column against alphas[0] and mask the bug
+            raise ValueError(
+                f"quantile metric got {m.shape[1]} margin columns but "
+                f"{a.shape[1]} quantile_alpha values; they must align."
+            )
+        a = jnp.broadcast_to(a, (1, m.shape[1]))
     diff = label[:, None] - m
     pin = jnp.maximum(a * diff, (a - 1.0) * diff).mean(axis=1)
     return jnp.sum(weight * pin), jnp.sum(weight)
@@ -231,9 +237,10 @@ def rank_metric_contrib(kind, margin, label, group_rows, k, group_chunk: int = 0
     return num, den
 
 
-def is_device_metric(name: str, has_groups: bool) -> bool:
+def is_device_metric(name: str, has_groups: bool, has_bounds: bool = False) -> bool:
     """True if the metric can be computed inside the sharded round step
-    (keeping the lax.scan batched path available)."""
+    (keeping the lax.scan batched path available). ``has_bounds``: every
+    eval set carries device-resident label bounds (survival training)."""
     base, _ = parse_metric_name(name)
     if base in _ELEMENTWISE:
         return True
@@ -241,26 +248,36 @@ def is_device_metric(name: str, has_groups: bool) -> bool:
         return True
     if base in ("ndcg", "map"):
         return has_groups
+    if name == "aft-nloglik":
+        return has_bounds
     return False
 
 
 def device_metric_contrib(name, margin, label, weight, group_rows, psum,
-                          huber_slope: float = 1.0, quantile_alpha=(0.5,)):
+                          huber_slope: float = 1.0, quantile_alpha=(0.5,),
+                          bounds=None, aft_distribution: str = "normal",
+                          aft_sigma: float = 1.0):
     """Device-side psum-merged (num, den) for any device metric.
 
     The caller divides num/den on host (rmse additionally sqrts), so every
-    metric is reduced to two replicated scalars.
+    metric is reduced to two replicated scalars. ``bounds`` carries the
+    (lower, upper) label-bound arrays for aft-nloglik (the analog of
+    ``group_rows`` for the ranking metrics).
     """
     base, arg = parse_metric_name(name)
+    if name == "aft-nloglik":
+        from xgboost_ray_tpu.ops.survival import aft_nloglik_contrib
+
+        num, den = aft_nloglik_contrib(
+            margin, bounds[0], bounds[1], weight,
+            distribution=aft_distribution, sigma=aft_sigma,
+        )
+        return psum(num), psum(den)
     if base in _ELEMENTWISE:
-        if base == "error" and arg is not None:
-            num, den = _error(margin, label, weight, arg)
-        elif base == "mphe":
-            num, den = _mphe(margin, label, weight, slope=huber_slope)
-        elif base == "quantile":
-            num, den = _quantile_pinball(margin, label, weight, quantile_alpha)
-        else:
-            num, den = _ELEMENTWISE[base](margin, label, weight)
+        num, den = elementwise_contrib(
+            name, margin, label, weight,
+            huber_slope=huber_slope, quantile_alpha=quantile_alpha,
+        )
         return psum(num), psum(den)
     if base in ("auc", "aucpr"):
         h = psum(auc_hist(margin, label, weight))
@@ -366,16 +383,29 @@ def is_elementwise_metric(name: str) -> bool:
     return base in _ELEMENTWISE
 
 
-def elementwise_contrib(name: str, margin, label, weight):
+def elementwise_contrib(name: str, margin, label, weight,
+                        huber_slope: float = 1.0, quantile_alpha=(0.5,)):
     """Device-side (num, den) contribution for an elementwise metric.
 
     margin: [N, K], label/weight: [N] (weight 0 for padding rows). The caller
     psums both parts across shards; rmse additionally takes a sqrt on host.
+    Parameterized metrics (quantile, mphe) take their objective params so
+    host-side evaluation matches the trained objective.
     """
     base, arg = parse_metric_name(name)
     if base == "error" and arg is not None:
         return _error(margin, label, weight, arg)
+    if base == "mphe":
+        return _mphe(margin, label, weight, slope=huber_slope)
+    if base == "quantile":
+        return _quantile_pinball(margin, label, weight, _as_alphas(quantile_alpha))
     return _ELEMENTWISE[base](margin, label, weight)
+
+
+def _as_alphas(quantile_alpha) -> Tuple[float, ...]:
+    if isinstance(quantile_alpha, (list, tuple, np.ndarray)):
+        return tuple(float(a) for a in quantile_alpha)
+    return (float(quantile_alpha),)
 
 
 def parse_metric_name(name: str) -> Tuple[str, Optional[float]]:
@@ -399,15 +429,35 @@ def compute_metric(
     label: np.ndarray,
     weight: Optional[np.ndarray] = None,
     group_ptr: Optional[np.ndarray] = None,
+    huber_slope: float = 1.0,
+    quantile_alpha=(0.5,),
+    bounds=None,
+    aft_distribution: str = "normal",
+    aft_sigma: float = 1.0,
 ) -> float:
     """Compute a named metric on full (gathered) arrays.
 
     margin: [N] or [N, K] raw margin scores; label: [N]; weight: [N] or None;
-    group_ptr: [n_groups+1] for ranking metrics.
+    group_ptr: [n_groups+1] for ranking metrics. huber_slope/quantile_alpha
+    parameterize the mphe and quantile metrics (pass the objective's values
+    so evaluation matches training); bounds=(lower, upper) + the aft params
+    feed aft-nloglik.
     """
     margin = np.asarray(margin, dtype=np.float32)
     if margin.ndim == 1:
         margin = margin[:, None]
+    if name == "aft-nloglik":
+        from xgboost_ray_tpu.ops.survival import aft_nloglik_np
+
+        if bounds is None:
+            raise ValueError(
+                "aft-nloglik needs bounds=(label_lower_bound, "
+                "label_upper_bound)."
+            )
+        return aft_nloglik_np(
+            margin, bounds[0], bounds[1], weight,
+            distribution=aft_distribution, sigma=aft_sigma,
+        )
     label = np.asarray(label, dtype=np.float32)
     weight = (
         np.ones(label.shape[0], np.float32)
@@ -416,12 +466,10 @@ def compute_metric(
     )
     base, arg = parse_metric_name(name)
     if base in _ELEMENTWISE:
-        if base == "error" and arg is not None:
-            num, den = _error(jnp.asarray(margin), jnp.asarray(label), jnp.asarray(weight), arg)
-        else:
-            num, den = _ELEMENTWISE[base](
-                jnp.asarray(margin), jnp.asarray(label), jnp.asarray(weight)
-            )
+        num, den = elementwise_contrib(
+            name, jnp.asarray(margin), jnp.asarray(label), jnp.asarray(weight),
+            huber_slope=huber_slope, quantile_alpha=quantile_alpha,
+        )
         num, den = float(num), float(den)
         val = num / max(den, 1e-12)
         return float(np.sqrt(val)) if base in ("rmse", "rmsle") else val

@@ -98,6 +98,48 @@ def make_aft_grad_hess(distribution: str, sigma: float) -> Callable:
     return grad_hess
 
 
+def aft_nloglik_contrib(
+    margin,
+    lower,
+    upper,
+    weight,
+    distribution: str = "normal",
+    sigma: float = 1.0,
+):
+    """Device-side psum-able (num, den) for the ``aft-nloglik`` metric.
+
+    Same likelihood as :func:`aft_nloglik_np`, expressed as weighted-sum
+    contributions so survival training can batch rounds (lax.scan fast path)
+    and run on multi-host meshes where labels/bounds are process-local —
+    mirrors the reference's allreduce-merged native metrics
+    (``xgboost_ray/main.py:745-752`` leaves metric merging to xgboost).
+    ``weight`` must already be zeroed on padding rows.
+    """
+    if distribution not in _DISTS:
+        raise ValueError(
+            f"aft_loss_distribution must be one of {sorted(_DISTS)}, got "
+            f"{distribution!r}"
+        )
+    _, cdf = _DISTS[distribution]
+    m = margin[:, 0]
+    log_lo = jnp.log(jnp.maximum(lower, _EPS))
+    z_lo = (log_lo - m) / sigma
+    uncensored = jnp.isfinite(upper) & (jnp.abs(upper - lower) < 1e-10)
+    if distribution == "normal":
+        logpdf = -0.5 * z_lo * z_lo - jnp.log(_SQRT2PI)
+    else:  # logistic: log pdf(z) = -(softplus(z) + softplus(-z))
+        logpdf = -(jax.nn.softplus(z_lo) + jax.nn.softplus(-z_lo))
+    nll_unc = -(logpdf - jnp.log(sigma) - log_lo)
+    finite_hi = jnp.isfinite(upper)
+    z_hi = (
+        jnp.log(jnp.maximum(jnp.where(finite_hi, upper, 1.0), _EPS)) - m
+    ) / sigma
+    cdf_hi = jnp.where(finite_hi, cdf(z_hi), 1.0)
+    nll_cen = -jnp.log(jnp.maximum(cdf_hi - cdf(z_lo), _EPS))
+    nll = jnp.where(uncensored, nll_unc, nll_cen)
+    return jnp.sum(nll * weight), jnp.sum(weight)
+
+
 def aft_nloglik_np(
     margin: np.ndarray,
     lower: np.ndarray,
