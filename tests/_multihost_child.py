@@ -146,6 +146,33 @@ def main() -> int:
         [r["train"]["aft-nloglik"] for r in sresults], exp["aft_nll"], atol=1e-5
     )
 
+    # --- custom objective + host feval over the 2-host mesh -----------------
+    # Each process computes grad/hess and the host metric from ITS OWN rows
+    # (get_margins_local + local label_np) — the reference's per-actor local
+    # computation (``xgboost_ray/main.py:745-752``); combine_host_scalar
+    # merges the per-process metric. Must match the single-process run
+    # bit-for-bit (gradients are identical, placement is identical).
+    ceng = TpuEngine(shards, params, num_actors=num_actors,
+                     evals=[(shards, "train")])
+    c_logloss, c_merror = [], []
+    for i in range(int(exp["rounds"])):
+        m = ceng.get_margins_local()[:, 0]
+        assert m.shape[0] == ceng.label_np.shape[0] == n // 2
+        p = 1.0 / (1.0 + np.exp(-m))
+        g = (p - ceng.label_np).astype(np.float32)
+        h = (p * (1.0 - p)).astype(np.float32)
+        r = ceng.step(i, gh_custom=(g, h))
+        c_logloss.append(r["train"]["logloss"])
+        p2 = 1.0 / (1.0 + np.exp(-ceng.get_margins_local()[:, 0]))
+        merr = float(((p2 > 0.5) != (ceng.label_np > 0.5)).mean())
+        c_merror.append(ceng.combine_host_scalar(merr, ceng.evals[0]))
+    np.testing.assert_allclose(c_logloss, exp["c_logloss"], atol=1e-5)
+    np.testing.assert_allclose(c_merror, exp["c_merror"], atol=1e-6)
+    np.testing.assert_allclose(
+        ceng.get_booster().predict(x, output_margin=True),
+        exp["c_margins"], atol=1e-4,
+    )
+
     print(f"CHILD{pid} OK", flush=True)
     return 0
 

@@ -1,0 +1,115 @@
+"""Child process for the REAL-process fault-injection test (VERDICT r3 #3).
+
+Two jax.distributed processes train over one 8-device mesh. Child 1 SIGKILLs
+itself (a real OS-level process death, the reference's kill-actor injection,
+``xgboost_ray/tests/utils.py:110-180``) at the start of round MH_KILL_ROUND.
+Child 0 must SURFACE the failure rather than hang, after having checkpointed
+every completed round — the parent (playing the cluster orchestrator) then
+restarts from that checkpoint on the surviving world and asserts the resumed
+model matches the no-failure run (the reference's determinism-under-failure
+guarantee, ``tests/test_fault_tolerance.py:401-449``).
+
+How the failure surfaces: the JAX distributed runtime's coordination service
+detects the dead peer's missed heartbeats and deliberately TERMINATES the
+surviving process with a fatal diagnostic ("Terminating process because the
+JAX distributed service detected fatal errors ... another task died",
+client.h:80) — there is no Python-level exception to catch mid-collective.
+This is the SPMD failure model SURVEY §5.8 anticipates: the mesh is static,
+so recovery lives at the DRIVER level (restart from checkpoint on the
+surviving world), exactly like the reference's restart-from-checkpoint
+control flow. The except branch below still handles JAX versions that do
+raise into Python (exit 7).
+
+Exit codes: killed-by-runtime (nonzero, with the fatal diagnostic on stdout)
+or 7 = failure surfaced; 3 = hang (watchdog); 0 = trained all rounds (only
+when no kill is scheduled).
+
+Usage: python _multihost_ft_child.py <coordinator> <process_id> <data.npz>
+Env: MH_KILL_ROUND (child 1 only), MH_CKPT (child 0: checkpoint path prefix).
+"""
+
+import os
+import signal
+import sys
+import threading
+
+import numpy as np
+
+
+def main() -> int:
+    coordinator, pid, data_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+    import jax
+
+    # same hermeticity trick as conftest.py: drop any non-CPU PJRT factory
+    from jax._src import xla_bridge as _xb
+
+    jax.config.update("jax_platforms", "cpu")
+    for _name in list(_xb._backend_factories):
+        if _name not in ("cpu",):
+            _xb._backend_factories.pop(_name, None)
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator, num_processes=2, process_id=pid
+    )
+    assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+
+    from xgboost_ray_tpu.engine import TpuEngine
+    from xgboost_ray_tpu.matrix import RayShardingMode, _get_sharding_indices
+    from xgboost_ray_tpu.params import parse_params
+
+    exp = np.load(data_path)
+    x, y = exp["x"], exp["y"]
+    n, num_actors, rounds = x.shape[0], 8, int(exp["rounds"])
+    kill_round = int(os.environ.get("MH_KILL_ROUND", "-1"))
+    ckpt_path = os.environ.get("MH_CKPT", "")
+
+    shards = []
+    for rank in range(pid * 4, (pid + 1) * 4):
+        idx = _get_sharding_indices(RayShardingMode.INTERLEAVED, rank, num_actors, n)
+        shards.append({
+            "data": x[idx], "label": y[idx], "weight": None,
+            "base_margin": None, "label_lower_bound": None,
+            "label_upper_bound": None, "qid": None,
+        })
+    params = parse_params({"objective": "binary:logistic",
+                           "eval_metric": ["logloss"], "max_depth": 3})
+    eng = TpuEngine(shards, params, num_actors=num_actors,
+                    evals=[(shards, "train")])
+
+    for i in range(rounds):
+        if pid == 1 and i == kill_round:
+            # REAL process death, mid-training, no cleanup — the TPU analog
+            # of the reference's SIGKILL-from-callback fault injection
+            os.kill(os.getpid(), signal.SIGKILL)
+        # watchdog: a step that blocks >180 s means the failure was NOT
+        # surfaced to the coordinator — fail distinctly rather than time out
+        timer = threading.Timer(180.0, lambda: os._exit(3))
+        timer.daemon = True
+        timer.start()
+        try:
+            eng.step(i)
+        except Exception as exc:  # noqa: BLE001 - any surfaced error counts
+            timer.cancel()
+            print(
+                f"CHILD{pid} FAILURE_SURFACED round={i} {type(exc).__name__}: "
+                f"{str(exc)[:200]}",
+                flush=True,
+            )
+            os._exit(7)  # skip jax.distributed teardown (world is broken)
+        timer.cancel()
+        if ckpt_path:
+            # checkpoint every completed round (driver-side checkpointing,
+            # mirror of the reference rank-0 callback main.py:612-626)
+            tmp = f"{ckpt_path}.tmp"
+            eng.get_booster().save_model(tmp)
+            os.replace(tmp, ckpt_path)
+            with open(f"{ckpt_path}.round", "w") as f:
+                f.write(str(i))
+
+    print(f"CHILD{pid} OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,74 @@
+"""Distributed-prediction benchmark (VERDICT r3 #5 'done' criterion).
+
+Reference surface: ``xgboost_ray/main.py:1750-1896`` — predict fans the
+model out to actors and re-assembles with combine_data; its release harness
+times training but never prediction. Here prediction is ALSO a mesh program
+(rows sharded over devices, gather walk under shard_map), so this harness
+records distributed-predict wall-clock at >= 1M rows for both paths:
+
+  spmd   RXGB_SPMD_PREDICT=1 (default): one compiled shard_map program
+  host   RXGB_SPMD_PREDICT=0: per-actor host loop (the reference's shape)
+
+Usage: python benchmark_predict.py [num_actors] [rows] [--smoke-test]
+Prints one JSON line: {"metric": "predict_1m_wall_clock", ...}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    smoke = "--smoke-test" in sys.argv
+    num_actors = int(args[0]) if args else 8
+    n_rows = int(float(args[1])) if len(args) > 1 else (50_000 if smoke else 1_000_000)
+    n_feat = 28
+
+    import jax
+
+    backend = jax.default_backend()
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal((n_rows, n_feat)).astype(np.float32)
+    y = (x[:, 0] - 0.5 * x[:, 1] > 0).astype(np.float32)
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+    from xgboost_ray_tpu import RayDMatrix, RayParams, predict, train
+
+    bst = train(
+        {"objective": "binary:logistic", "max_depth": 6, "max_bin": 256,
+         "tree_method": "tpu_hist"},
+        RayDMatrix(x, y), num_boost_round=10 if smoke else 50,
+        ray_params=RayParams(num_actors=num_actors, checkpoint_frequency=0),
+    )
+
+    results = {}
+    for label, flag in (("spmd", "1"), ("host", "0")):
+        os.environ["RXGB_SPMD_PREDICT"] = flag
+        dpred = RayDMatrix(x)
+        # warm-up: compile + first dispatch
+        predict(bst, dpred, ray_params=RayParams(num_actors=num_actors))
+        t0 = time.time()
+        out = predict(bst, dpred, ray_params=RayParams(num_actors=num_actors))
+        results[label] = time.time() - t0
+        assert out.shape == (n_rows,)
+        print(f"[predict-bench] {label}: {results[label]:.3f}s "
+              f"({n_rows / results[label] / 1e6:.2f} Mrows/s)",
+              file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "predict_1m_wall_clock" + ("" if backend != "cpu" else "_cpu_mesh"),
+        "value": round(results["spmd"] * (1_000_000 / n_rows), 3),
+        "unit": "s",
+        "rows": n_rows,
+        "actors": num_actors,
+        "backend": backend,
+        "speedup_vs_host_loop": round(results["host"] / results["spmd"], 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -67,6 +67,26 @@ def test_selection_preserves_process_contiguous_order():
     assert len(sel) == 6
 
 
+def test_spread_redistributes_uneven_host_deficit():
+    """A host with fewer devices than its even share must not shrink the
+    mesh (ADVICE r3): the deficit is redistributed to hosts with spare
+    devices so exactly ``num`` devices come back."""
+    # host 0 has 1 device, hosts 1-2 have 4 each
+    devs = [_FakeDev(0, 0)] + [
+        _FakeDev(1 + p * 4 + i, p + 1) for p in range(2) for i in range(4)
+    ]
+    sel = _select_mesh_devices(6, "SPREAD", devs)
+    assert len(sel) == 6
+    per_host = {}
+    for d in sel:
+        per_host.setdefault(d.process_index, 0)
+        per_host[d.process_index] += 1
+    # even share would be 2/2/2; host 0 can only give 1 → 1/3/2 or 1/2/3
+    assert per_host[0] == 1 and per_host[1] + per_host[2] == 5
+    procs = [d.process_index for d in sel]
+    assert procs == sorted(procs)
+
+
 def test_oversubscription_returns_all_devices():
     devs = _fake_world(2, 2)
     assert _select_mesh_devices(9, "SPREAD", devs) == devs

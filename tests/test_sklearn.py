@@ -95,6 +95,112 @@ def test_eval_set_and_early_stopping(bc):
     assert hasattr(clf, "best_iteration")
 
 
+def test_early_stopping_predict_uses_best_iteration(bc):
+    """After early stopping, predict() must default to the best model —
+    xgboost's sklearn contract (reference ported suite,
+    ``xgboost_ray/tests/test_sklearn.py:143-1240``: best_iteration consumed
+    by predict/iteration_range)."""
+    x_tr, x_te, y_tr, y_te = bc
+    clf = RayXGBClassifier(
+        n_estimators=50, max_depth=6, eval_metric=["logloss"], random_state=0
+    )
+    clf.fit(x_tr, y_tr, eval_set=[(x_te, y_te)], early_stopping_rounds=3,
+            ray_params=RP)
+    res = clf.evals_result()["validation_0"]["logloss"]
+    assert clf.best_iteration is not None
+    assert np.isclose(clf.best_score, min(res))
+    assert res.index(min(res)) == clf.best_iteration
+    default_margin = clf.predict(x_te, output_margin=True, ray_params=RP)
+    best_margin = clf.predict(
+        x_te, output_margin=True,
+        iteration_range=(0, clf.best_iteration + 1), ray_params=RP,
+    )
+    np.testing.assert_allclose(default_margin, best_margin, atol=1e-6)
+    if clf.best_iteration + 1 < len(res):
+        full_margin = clf.predict(
+            x_te, output_margin=True, iteration_range=(0, len(res)),
+            ray_params=RP,
+        )
+        assert not np.allclose(default_margin, full_margin, atol=1e-6)
+
+
+def test_multi_metric_early_stop_tracks_last_metric(bc):
+    """With multiple eval metrics, early stopping tracks the LAST metric on
+    the last eval set (xgboost semantics)."""
+    x_tr, x_te, y_tr, y_te = bc
+    clf = RayXGBClassifier(
+        n_estimators=60, max_depth=6, eval_metric=["auc", "logloss"],
+        random_state=0,
+    )
+    clf.fit(x_tr, y_tr, eval_set=[(x_te, y_te)], early_stopping_rounds=4,
+            ray_params=RP)
+    res = clf.evals_result()["validation_0"]
+    assert set(res) == {"auc", "logloss"}
+    # best_score is the minimized last metric (logloss), not auc
+    assert np.isclose(clf.best_score, min(res["logloss"]))
+    assert len(res["logloss"]) < 60
+
+
+def test_sample_weight_eval_set_values_match_manual(bc):
+    """sample_weight_eval_set must flow into the eval metric: the reported
+    weighted logloss equals a manual weighted computation from the final
+    model's probabilities."""
+    x_tr, x_te, y_tr, y_te = bc
+    rng = np.random.RandomState(7)
+    w_te = rng.uniform(0.2, 3.0, len(y_te)).astype(np.float32)
+    clf = RayXGBClassifier(n_estimators=8, max_depth=3, eval_metric=["logloss"],
+                           random_state=0)
+    clf.fit(
+        x_tr, y_tr,
+        eval_set=[(x_te, y_te)], sample_weight_eval_set=[w_te],
+        ray_params=RP,
+    )
+    reported = clf.evals_result()["validation_0"]["logloss"][-1]
+    p = np.clip(clf.predict_proba(x_te, ray_params=RP)[:, 1], 1e-7, 1 - 1e-7)
+    manual = float(
+        -(w_te * (y_te * np.log(p) + (1 - y_te) * np.log(1 - p))).sum()
+        / w_te.sum()
+    )
+    assert np.isclose(reported, manual, atol=1e-4)
+    # and it must differ from the unweighted metric
+    clf2 = RayXGBClassifier(n_estimators=8, max_depth=3,
+                            eval_metric=["logloss"], random_state=0)
+    clf2.fit(x_tr, y_tr, eval_set=[(x_te, y_te)], ray_params=RP)
+    unweighted = clf2.evals_result()["validation_0"]["logloss"][-1]
+    assert not np.isclose(reported, unweighted, atol=1e-6)
+
+
+def test_callbacks_through_fit(bc):
+    """User callbacks passed to fit() fire per round and can stop training
+    (reference: callbacks kwarg routed through train,
+    ``xgboost_ray/tests/test_xgboost_api.py:154``)."""
+    x_tr, _, y_tr, _ = bc
+
+    class Counter:
+        def __init__(self, stop_at=None):
+            self.before = 0
+            self.after = 0
+            self.stop_at = stop_at
+
+        def before_iteration(self, model, epoch, evals_log):
+            self.before += 1
+
+        def after_iteration(self, model, epoch, evals_log):
+            self.after += 1
+            return self.stop_at is not None and epoch + 1 >= self.stop_at
+
+    cb = Counter()
+    clf = RayXGBClassifier(n_estimators=8, max_depth=3)
+    clf.fit(x_tr, y_tr, callbacks=[cb], ray_params=RP)
+    assert cb.before == 8 and cb.after == 8
+
+    stopper = Counter(stop_at=3)
+    clf2 = RayXGBClassifier(n_estimators=20, max_depth=3)
+    clf2.fit(x_tr, y_tr, callbacks=[stopper], ray_params=RP)
+    assert stopper.after == 3
+    assert clf2.get_booster().num_boosted_rounds() == 3
+
+
 def test_clone_and_get_params():
     clf = RayXGBClassifier(n_estimators=7, max_depth=2, learning_rate=0.1)
     cloned = clone(clf)

@@ -43,6 +43,26 @@ def test_aft_learns_survival_times():
     assert corr > 0.8
 
 
+def test_aft_with_dart_booster_computes_host_metric():
+    """dart + survival:aft must use the same aft-nloglik host fallback as the
+    regular step path (ADVICE r3: step_dart previously hit compute_metric
+    directly and raised at metric time)."""
+    x, lower, upper, _ = _survival_data(seed=3)
+    dtrain = RayDMatrix(x, label_lower_bound=lower, label_upper_bound=upper)
+    evals_result = {}
+    bst = train(
+        {"objective": "survival:aft", "booster": "dart", "rate_drop": 0.1,
+         "eval_metric": ["aft-nloglik"], "max_depth": 3,
+         "aft_loss_distribution": "normal", "aft_loss_distribution_scale": 1.0},
+        dtrain, 8, evals=[(dtrain, "train")], evals_result=evals_result,
+        ray_params=RayParams(num_actors=2),
+    )
+    nll = evals_result["train"]["aft-nloglik"]
+    assert len(nll) == 8
+    assert np.isfinite(nll).all()
+    assert bst.num_boosted_rounds() == 8
+
+
 def test_aft_logistic_distribution_runs():
     x, lower, upper, _ = _survival_data(seed=1)
     dtrain = RayDMatrix(x, label_lower_bound=lower, label_upper_bound=upper)

@@ -93,6 +93,7 @@ class _EvalSet:
         self.n_rows = n_rows
         self.group_ptr = group_ptr
         self.is_train = is_train
+        self.local_rows = n_rows  # multi-host: set to this process's rows
         self.lower_np = None
         self.upper_np = None
         self.margins_static = None
@@ -403,12 +404,12 @@ class TpuEngine:
             for m in self.metric_names
             if not is_device_metric(m, has_groups, has_bounds)
         ]
-        if self._host_metrics and jax.process_count() > 1:
-            raise NotImplementedError(
-                f"metrics {self._host_metrics} need host-side computation, "
-                f"which is not supported on multi-host meshes (labels are "
-                f"process-local); use device metrics."
-            )
+        # Host metrics on multi-host meshes are computed per process on its
+        # local rows and combined as a weight-/row-weighted mean across
+        # processes — the reference's per-worker metric semantics (each actor
+        # evaluates its shard, xgboost averages across workers). Exact for
+        # per-row-mean metrics; an approximation for order-statistics like a
+        # host-fallback AUC (use the device histogram-AUC for exactness).
 
         self.trees: List[Tree] = []  # host-side forest, one [K*T, heap] entry per round
         # per-round device forests pending host transfer: under the tunneled
@@ -595,6 +596,7 @@ class TpuEngine:
             None if qid is None else build_group_rows(qid)[1],
             False,
         )
+        es.local_rows = local_rows
 
         from xgboost_ray_tpu.distributed import put_rows_global
 
@@ -994,15 +996,20 @@ class TpuEngine:
         eval_data = self._eval_arrs()
         group_rows = self.group_rows if self.group_rows is not None else jnp.zeros((), jnp.int32)
         if custom:
-            if jax.process_count() > 1:
-                raise NotImplementedError(
-                    "custom objectives are not supported on multi-host meshes "
-                    "(gradients are computed host-side from gathered margins)."
-                )
+            # g/h hold THIS process's rows (the driver computes the custom
+            # objective from get_margins_local + process-local labels — the
+            # reference's per-actor local computation, ``main.py:745-752``);
+            # _put_rows assembles them into the global sharded layout.
             g, h = gh_custom
             gh_in = (
-                self._put_rows(np.asarray(g, np.float32).reshape(self.n_rows, -1), np.float32),
-                self._put_rows(np.asarray(h, np.float32).reshape(self.n_rows, -1), np.float32),
+                self._put_rows(
+                    np.asarray(g, np.float32).reshape(self._local_rows, -1),
+                    np.float32,
+                ),
+                self._put_rows(
+                    np.asarray(h, np.float32).reshape(self._local_rows, -1),
+                    np.float32,
+                ),
             )
         else:
             gh_in = jnp.zeros((), jnp.float32)
@@ -1049,31 +1056,39 @@ class TpuEngine:
                 base, _ = parse_metric_name(name)
                 row[name] = float(np.sqrt(val)) if base in ("rmse", "rmsle") else val
             if self._host_metrics:
-                margin = self.get_margins(es)
+                margin = self.get_margins_local(es)
                 for name in self._host_metrics:
-                    if name == "aft-nloglik":
-                        from xgboost_ray_tpu.ops import survival as survival_mod
-
-                        row[name] = survival_mod.aft_nloglik_np(
-                            margin,
-                            es.lower_np if es.lower_np is not None else self.lower_np,
-                            es.upper_np if es.upper_np is not None else self.upper_np,
-                            es.weight_np,
-                            distribution=self.params.aft_loss_distribution,
-                            sigma=self.params.aft_loss_distribution_scale,
-                        )
-                        continue
-                    row[name] = compute_metric(
-                        name,
-                        margin,
-                        es.label_np if es.label_np is not None else self.label_np,
-                        es.weight_np,
-                        group_ptr=es.group_ptr,
-                        huber_slope=self.params.huber_slope,
-                        quantile_alpha=self.params.quantile_alpha,
+                    row[name] = self.combine_host_scalar(
+                        self._host_metric_value(name, margin, es), es,
+                        metric=name,
                     )
             results[es.name] = row
         return results
+
+    def _host_metric_value(self, name: str, margin: np.ndarray, es) -> float:
+        """One host-side metric value, including the aft-nloglik special case
+        (which consumes label *bounds* rather than labels). Shared by the
+        regular ``step()`` and the dart ``step_dart()`` results paths."""
+        if name == "aft-nloglik":
+            from xgboost_ray_tpu.ops import survival as survival_mod
+
+            return survival_mod.aft_nloglik_np(
+                margin,
+                es.lower_np if es.lower_np is not None else self.lower_np,
+                es.upper_np if es.upper_np is not None else self.upper_np,
+                es.weight_np,
+                distribution=self.params.aft_loss_distribution,
+                sigma=self.params.aft_loss_distribution_scale,
+            )
+        return compute_metric(
+            name,
+            margin,
+            es.label_np if es.label_np is not None else self.label_np,
+            es.weight_np,
+            group_ptr=es.group_ptr,
+            huber_slope=self.params.huber_slope,
+            quantile_alpha=self.params.quantile_alpha,
+        )
 
     def get_margins(self, es: Optional[_EvalSet] = None) -> np.ndarray:
         """Gather (unpadded) margins for the train set or an eval set.
@@ -1084,6 +1099,54 @@ class TpuEngine:
         if es is None or es.is_train:
             return self._fetch_rows(self.margins, self.valid, self.n_rows)
         return self._fetch_rows(es.margins, es.valid, es.n_rows)
+
+    def get_margins_local(self, es: Optional[_EvalSet] = None) -> np.ndarray:
+        """This process's rows' (unpadded) margins — the per-actor local view
+        the reference computes custom obj/feval on (``main.py:745-752``).
+        Pairs with the process-local ``label_np``/``weight_np`` arrays.
+        Single-host this IS the global view."""
+        if jax.process_count() == 1:
+            return self.get_margins(es)
+        if es is None or es.is_train:
+            arr, local_n = self.margins, self._local_rows
+        else:
+            arr, local_n = es.margins, es.local_rows
+        shards = sorted(
+            arr.addressable_shards, key=lambda s: s.index[0].start or 0
+        )
+        slab = np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+        return slab[:local_n]
+
+    def combine_host_scalar(
+        self, value: float, es: Optional[_EvalSet] = None,
+        metric: Optional[str] = None,
+    ) -> float:
+        """Combine a process-locally computed scalar metric into the global
+        value: weighted mean across processes. The weight matches the
+        metric's own averaging unit — GROUP count for per-group metrics
+        (ndcg/map/pre are means over query groups), otherwise weight sum
+        (weighted eval set) or row count. Identity on single-host meshes.
+        Deterministic and identical on every process (allgather-based), so
+        evals_result stays replica-consistent."""
+        if jax.process_count() == 1:
+            return float(value)
+        from jax.experimental import multihost_utils
+
+        base = parse_metric_name(metric)[0] if metric else None
+        if base in ("ndcg", "map", "pre") and es is not None and es.group_ptr is not None:
+            wt = float(len(es.group_ptr) - 1)
+        elif es is not None and es.weight_np is not None:
+            wt = float(np.sum(es.weight_np))
+        elif es is not None and es.label_np is not None:
+            wt = float(len(es.label_np))
+        else:
+            wt = float(self._local_rows)
+        arr = np.asarray(
+            multihost_utils.process_allgather(
+                np.array([float(value) * wt, wt], np.float64)
+            )
+        ).reshape(-1, 2).sum(axis=0)
+        return float(arr[0] / max(arr[1], 1e-12))
 
     def _stacked_forest(self) -> Tree:
         """Stacked [T, heap] forest with incremental appends: only rounds added
@@ -1374,16 +1437,11 @@ class TpuEngine:
                 base, _ = parse_metric_name(name)
                 row[name] = float(np.sqrt(val)) if base in ("rmse", "rmsle") else val
             if self._host_metrics:
-                margin = self.get_margins(es)
+                margin = self.get_margins_local(es)
                 for name in self._host_metrics:
-                    row[name] = compute_metric(
-                        name,
-                        margin,
-                        es.label_np if es.label_np is not None else self.label_np,
-                        es.weight_np,
-                        group_ptr=es.group_ptr,
-                        huber_slope=self.params.huber_slope,
-                        quantile_alpha=self.params.quantile_alpha,
+                    row[name] = self.combine_host_scalar(
+                        self._host_metric_value(name, margin, es), es,
+                        metric=name,
                     )
             results[es.name] = row
         return results

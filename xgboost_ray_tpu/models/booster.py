@@ -27,6 +27,53 @@ _PREDICT_CHUNK = 1 << 16
 # exact TreeSHAP materializes [2^depth, chunk, F] slot contributions: smaller
 _SHAP_CHUNK = 1 << 12
 
+# jitted SPMD margin programs, keyed on everything that changes the traced
+# function (jit's own cache then handles shape polymorphism). Without this a
+# fresh closure per predict() call would defeat jit caching and recompile
+# every time — seconds per call on TPU.
+_SPMD_MARGIN_FNS: Dict[tuple, Any] = {}
+
+
+def _spmd_margin_fn(devices, k, max_depth, npt, ntree_limit, has_tw,
+                    cat_features):
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    try:  # jax >= 0.4.35 exposes shard_map at top level
+        shard_map = jax.shard_map
+    except AttributeError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map  # type: ignore
+
+    key = (
+        tuple(getattr(d, "id", i) for i, d in enumerate(devices)),
+        k, max_depth, npt, int(ntree_limit), has_tw, tuple(cat_features),
+    )
+    mapped = _SPMD_MARGIN_FNS.get(key)
+    if mapped is not None:
+        return mapped
+    mesh = Mesh(np.asarray(devices), ("actors",))
+
+    def fn(forest, tw, xb, bb):
+        return predict_ops.predict_margin(
+            forest, xb, bb,
+            max_depth=max_depth, num_outputs=k,
+            num_parallel_tree=npt, ntree_limit=int(ntree_limit),
+            tree_weights=tw if has_tw else None,
+            cat_features=tuple(cat_features),
+        )
+
+    mapped = jax.jit(
+        shard_map(
+            fn, mesh=mesh,
+            in_specs=(P(), P(), P("actors"), P("actors")),
+            out_specs=P("actors"),
+        )
+    )
+    if len(_SPMD_MARGIN_FNS) > 16:  # bound retained programs; evict oldest
+        _SPMD_MARGIN_FNS.pop(next(iter(_SPMD_MARGIN_FNS)))
+    _SPMD_MARGIN_FNS[key] = mapped
+    return mapped
+
 
 def _forest_to_np(forest: Tree) -> Tree:
     return Tree(*[np.asarray(f) for f in forest])
@@ -239,6 +286,72 @@ class RayXGBoostBooster:
             out[lo:hi] = np.asarray(margin)
         return out
 
+    def predict_margin_spmd(
+        self,
+        x: np.ndarray,
+        devices,
+        ntree_limit: int = 0,
+        base_margin: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """Raw margin [N, K], row-sharded over an explicit device mesh.
+
+        The tree walk is embarrassingly parallel over rows, so each device
+        walks its row block against the replicated forest inside ONE compiled
+        shard_map program — the SPMD replacement for the reference's
+        per-actor host loop (``xgboost_ray/main.py:1750-1896``), where every
+        actor calls ``model.predict`` on its local shard.
+        """
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        n_dev = len(devices)
+        if n_dev <= 1:
+            return self.predict_margin_np(
+                x, ntree_limit=ntree_limit, base_margin=base_margin
+            )
+        n = x.shape[0]
+        k = self.num_outputs
+        obj = get_objective(
+            self.params.objective, self.params.num_class,
+            self.params.scale_pos_weight,
+            quantile_alpha=self.params.quantile_alpha,
+        )
+        m0 = obj.base_score_to_margin(self.base_score)
+        mesh = Mesh(np.asarray(devices), ("actors",))
+        repl = NamedSharding(mesh, P())
+        rows = NamedSharding(mesh, P("actors"))
+        forest_dev = Tree(*[jax.device_put(np.asarray(f), repl) for f in self.forest])
+        has_tw = self.tree_weights is not None
+        tw_dev = jax.device_put(
+            np.asarray(self.tree_weights, np.float32)
+            if has_tw else np.zeros(0, np.float32),
+            repl,
+        )
+        mapped = _spmd_margin_fn(
+            devices, k, self.max_depth, self.params.num_parallel_tree,
+            ntree_limit, has_tw, self.cat_features,
+        )
+        chunk = _PREDICT_CHUNK * n_dev
+        out = np.empty((n, k), np.float32)
+        for lo in range(0, n, chunk):
+            hi = min(lo + chunk, n)
+            rows_n = hi - lo
+            pad = (-rows_n) % n_dev
+            xb = np.asarray(x[lo:hi], np.float32)
+            if pad:
+                xb = np.concatenate([xb, np.zeros((pad, xb.shape[1]), np.float32)])
+            base = np.full((rows_n + pad, k), m0, np.float32)
+            if base_margin is not None:
+                base[:rows_n] += np.asarray(
+                    base_margin[lo:hi], np.float32
+                ).reshape(rows_n, -1)
+            margin = mapped(
+                forest_dev, tw_dev,
+                jax.device_put(xb, rows), jax.device_put(base, rows),
+            )
+            out[lo:hi] = np.asarray(margin)[:rows_n]
+        return out
+
     def _assert_node_stats(self):
         if not self._has_node_stats:
             raise ValueError(
@@ -375,15 +488,19 @@ class RayXGBoostBooster:
         if iteration_range is not None and iteration_range != (0, 0):
             booster = self.slice_rounds(iteration_range[0], iteration_range[1])
         margin = booster.predict_margin_np(x, ntree_limit=ntree_limit, base_margin=base_margin)
+        return booster._margin_to_prediction(margin, output_margin)
+
+    def _margin_to_prediction(self, margin: np.ndarray, output_margin: bool) -> np.ndarray:
+        """Shared margin→prediction transform — used by this host predict
+        path AND main's SPMD predict path so the two cannot diverge."""
         if output_margin:
-            return margin[:, 0] if booster.num_outputs == 1 else margin
+            return margin[:, 0] if self.num_outputs == 1 else margin
         obj = get_objective(
             self.params.objective, self.params.num_class,
             self.params.scale_pos_weight,
             quantile_alpha=self.params.quantile_alpha,
         )
-        pred = np.asarray(obj.transform(jnp.asarray(margin)))
-        return pred
+        return np.asarray(obj.transform(jnp.asarray(margin)))
 
     # -- serialization -----------------------------------------------------
 

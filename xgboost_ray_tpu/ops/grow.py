@@ -259,7 +259,17 @@ def build_tree(
                 )
 
             if cfg.hist_impl == "pallas":
-                return presorted(_use_pallas(explicit=True))
+                if not _use_pallas(explicit=True):
+                    # no silent fallback (mirrors build_histogram): a user
+                    # explicitly opting into the kernel must not silently get
+                    # a different impl with different perf
+                    raise RuntimeError(
+                        "hist_impl='pallas' requested but the Pallas TPU "
+                        "kernel cannot run here (kernel unavailable, non-TPU "
+                        "backend, or RXGB_DISABLE_PALLAS set); use "
+                        "hist_impl='auto'."
+                    )
+                return presorted(True)
             if cfg.hist_impl == "mixed":
                 # measured on v5e (1M x 28 x 256): one-hot wins at tiny node
                 # fan-out (cost scales with nn), the fused block kernel is
@@ -345,7 +355,13 @@ def build_tree(
         else:
             hist = allreduce(_build(gh, pos, order, counts, n_nodes))
         prev_hist = hist
-        node_gh = hist[:, 0, :, :].sum(axis=1)  # [n_nodes, 2] (feature 0 covers all rows)
+        # [n_nodes, 2]: feature 0's buckets cover every row. Under
+        # hist_precision="fast" these totals carry the regular bins' bf16
+        # rounding (when feature 0 has no missing values its zeroed missing
+        # bucket no longer re-balances the sum) — accepted as part of the
+        # fast-precision accuracy/speed contract; use the default precision
+        # when exact node totals matter.
+        node_gh = hist[:, 0, :, :].sum(axis=1)
 
         fmask = feature_mask
         if colsample_bylevel < 1.0 and level_rng is not None:

@@ -173,6 +173,28 @@ def parse_params(params: Optional[Dict[str, Any]]) -> TrainParams:
                 pass
         setattr(out, name, value)
 
+    if out.hist_impl == "pallas":
+        # static, data-independent misconfiguration: fail at parameter time
+        # instead of deep inside the first traced histogram build (the
+        # trace-time RuntimeError in ops/grow.py stays as a backstop)
+        import os as _os
+
+        _ok = False
+        if not _os.environ.get("RXGB_DISABLE_PALLAS"):
+            try:
+                import jax as _jax
+                from xgboost_ray_tpu.ops import hist_pallas as _hp
+
+                _ok = _hp.PALLAS_AVAILABLE and _jax.default_backend() == "tpu"
+            except Exception:
+                _ok = False
+        if not _ok:
+            raise ValueError(
+                "hist_impl='pallas' requested but the Pallas TPU kernel "
+                "cannot run here (kernel unavailable, non-TPU backend, or "
+                "RXGB_DISABLE_PALLAS set); use hist_impl='auto'."
+            )
+
     if out.max_depth < 1:
         raise ValueError("max_depth must be >= 1 for tpu_hist")
     if out.max_depth > 14:

@@ -208,6 +208,10 @@ class RayXGBMixin:
             esr = getattr(self, "early_stopping_rounds", None)
         if esr is not None:
             extra["early_stopping_rounds"] = esr
+        # a refit must not inherit a previous fit's early-stop state: a stale
+        # best_iteration would silently truncate predict() on the new model
+        self.best_iteration = None
+        self.best_score = None
         booster = ray_train(
             params,
             train_dmatrix,
@@ -259,6 +263,13 @@ class RayXGBMixin:
         )
         if ntree_limit:
             kwargs["ntree_limit"] = ntree_limit
+        if iteration_range is None and not ntree_limit:
+            # early stopping: predict with the best model by default, the
+            # xgboost sklearn contract (reference's ported suite checks
+            # best_iteration feeding predict, ``tests/test_sklearn.py``)
+            best_it = getattr(self, "best_iteration", None)
+            if best_it is not None:
+                iteration_range = (0, int(best_it) + 1)
         if iteration_range is not None:
             kwargs["iteration_range"] = iteration_range
         if isinstance(X, RayDMatrix):
