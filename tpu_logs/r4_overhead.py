@@ -1,0 +1,116 @@
+"""Round-4: per-round overhead attribution by ablation (VERDICT r3 #2).
+
+r2 measured tree build ~0.5 s/round on v5e at 1M x 28 while full-protocol
+rounds cost 0.8-1.4 s more. This script attributes the gap by ablating the
+driver-protocol features one at a time and measuring the MARGINAL per-round
+cost of each config via two run lengths (identical compiles thanks to the
+SCAN_MAX_CHUNK divisor), plus an engine-only loop that excludes the driver
+entirely:
+
+  engine_only   TpuEngine.step_many, no driver at all
+  bare          train() with no evals, no checkpointing
+  evals         + evals=[(dtrain,"train")] (device logloss per round)
+  evals_ckpt    + checkpoint_frequency=5 (booster serialization + queue)
+
+deltas: (bare - engine_only) = driver dispatch; (evals - bare) = eval-margin
+updates + metric transfer; (evals_ckpt - evals) = checkpoint serialization.
+
+Run serialized on the tunnel; also meaningful on the CPU mesh for RANKING
+the host-side suspects (python dispatch, serialization, metric transfers are
+hardware-independent; device compute is not).
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+
+import numpy as np
+
+
+def main():
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # hermeticity guard (same as tests/conftest.py): the axon plugin
+        # self-registers and would be initialized even under
+        # JAX_PLATFORMS=cpu, hanging/failing when the tunnel is down
+        import jax
+        from jax._src import xla_bridge as _xb
+
+        jax.config.update("jax_platforms", "cpu")
+        for _name in list(_xb._backend_factories):
+            if _name != "cpu":
+                _xb._backend_factories.pop(_name, None)
+    import jax
+
+    backend = jax.default_backend()
+    print(f"backend={backend} devices={len(jax.devices())}", flush=True)
+    sys.path.insert(0, "/root/repo")
+    from xgboost_ray_tpu import RayDMatrix, RayParams, train
+    from xgboost_ray_tpu.engine import TpuEngine
+    from xgboost_ray_tpu.params import parse_params
+
+    n_rows = int(float(os.environ.get(
+        "OVERHEAD_ROWS", "1e6" if backend != "cpu" else "2e5")))
+    r_lo, r_hi = 10, 50
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal((n_rows, 28)).astype(np.float32)
+    y = (x[:, 0] - 0.5 * x[:, 1] > 0).astype(np.float32)
+    base_params = {"objective": "binary:logistic", "max_depth": 6,
+                   "max_bin": 256, "tree_method": "tpu_hist"}
+
+    def timed_train(rounds, evals, ckpt, eval_metric):
+        params = dict(base_params)
+        if eval_metric:
+            params["eval_metric"] = eval_metric
+        dtrain = RayDMatrix(x, y)
+        t0 = time.time()
+        train(params, dtrain, num_boost_round=rounds,
+              evals=[(dtrain, "train")] if evals else (),
+              ray_params=RayParams(num_actors=int(
+                  os.environ.get("OVERHEAD_ACTORS", "1" if backend != "cpu" else "8")),
+                  checkpoint_frequency=ckpt))
+        return time.time() - t0
+
+    def timed_engine(rounds):
+        params = parse_params(dict(base_params))
+        shard = [{"data": x, "label": y, "weight": None, "base_margin": None,
+                  "label_lower_bound": None, "label_upper_bound": None,
+                  "qid": None}]
+        eng = TpuEngine(shard, params, num_actors=1)
+        t0 = time.time()
+        done = 0
+        while done < rounds:
+            n = min(10, rounds - done)
+            eng.step_many(done, n)
+            done += n
+        return time.time() - t0
+
+    rows = {}
+    for name, fn in (
+        ("engine_only", lambda r: timed_engine(r)),
+        ("bare", lambda r: timed_train(r, evals=False, ckpt=0, eval_metric=None)),
+        ("evals", lambda r: timed_train(r, evals=True, ckpt=0,
+                                        eval_metric=["logloss"])),
+        ("evals_ckpt", lambda r: timed_train(r, evals=True, ckpt=5,
+                                             eval_metric=["logloss"])),
+    ):
+        w_lo = fn(r_lo)
+        w_hi = fn(r_hi)
+        marginal = (w_hi - w_lo) / (r_hi - r_lo)
+        rows[name] = marginal
+        print(f"{name:12s} wall{r_lo}={w_lo:7.1f}s wall{r_hi}={w_hi:7.1f}s "
+              f"marginal={marginal:6.3f} s/round", flush=True)
+
+    print("--- attribution (s/round) ---", flush=True)
+    print(f"tree build + engine   : {rows['engine_only']:.3f}", flush=True)
+    print(f"driver dispatch       : {rows['bare'] - rows['engine_only']:+.3f}",
+          flush=True)
+    print(f"eval margins + metric : {rows['evals'] - rows['bare']:+.3f}",
+          flush=True)
+    print(f"checkpoint every 5    : {rows['evals_ckpt'] - rows['evals']:+.3f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
