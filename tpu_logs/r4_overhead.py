@@ -73,17 +73,28 @@ def main():
         return time.time() - t0
 
     def timed_engine(rounds):
+        # SAME actor count as the train() configs — the delta to "bare" must
+        # isolate driver dispatch, not mesh size
+        n_act = int(os.environ.get("OVERHEAD_ACTORS",
+                                   "1" if backend != "cpu" else "8"))
+        from xgboost_ray_tpu.matrix import RayShardingMode, _get_sharding_indices
+
         params = parse_params(dict(base_params))
-        shard = [{"data": x, "label": y, "weight": None, "base_margin": None,
-                  "label_lower_bound": None, "label_upper_bound": None,
-                  "qid": None}]
-        eng = TpuEngine(shard, params, num_actors=1)
+        shards = []
+        for rank in range(n_act):
+            idx = _get_sharding_indices(
+                RayShardingMode.INTERLEAVED, rank, n_act, x.shape[0])
+            shards.append({"data": x[idx], "label": y[idx], "weight": None,
+                           "base_margin": None, "label_lower_bound": None,
+                           "label_upper_bound": None, "qid": None})
+        eng = TpuEngine(shards, params, num_actors=n_act)
         t0 = time.time()
         done = 0
         while done < rounds:
             n = min(10, rounds - done)
             eng.step_many(done, n)
             done += n
+        eng.get_booster()  # flush deferred forests — train() configs pay this too
         return time.time() - t0
 
     rows = {}

@@ -417,7 +417,7 @@ class TpuEngine:
         # path defers the (tiny) forest transfer and flushes in one batched
         # stack per checkpoint/get_booster instead of 9 reads per round
         # (VERDICT r2 #2: per-round np.asarray transfers)
-        self._trees_dev: List[Tree] = []
+        self._trees_dev: List[Tuple[Tree, Optional[int]]] = []
         # incremental stacked-forest cache (amortized O(1) copies per tree;
         # re-stacking the whole forest per checkpoint interval was O(T^2))
         self._stack_entries = 0  # how many of (_init_trees + trees) are stacked
@@ -954,20 +954,42 @@ class TpuEngine:
             if not es.is_train:
                 es.margins = new_eval_margins[ei]
                 ei += 1
-        self._flush_trees()  # keep round order if per-round steps preceded
-        forests_np = jax.tree.map(np.asarray, forests)  # [n, K*T, heap] fields
-        for r in range(n_rounds):
-            self.trees.append(jax.tree.map(lambda a: a[r], forests_np))
+        # defer forest transfer: keep the whole stacked chunk on device
+        # (order-safe alongside per-round step()s) and materialize it in ONE
+        # batched read per Tree field at the next checkpoint/get_booster —
+        # under the tunneled relay every host read costs ~70-90 ms, so the
+        # previous eager 9-field read per chunk was ~0.07 s/round of latency
+        self._trees_dev.append((forests, n_rounds))
 
+        # metrics: one stacked transfer for ALL (num, den) scalars of the
+        # whole chunk instead of a device read per (eval, metric, row)
+        flat_scalars = [
+            c
+            for si in range(len(self.evals))
+            for mi in range(len(self._device_metrics))
+            for c in contribs[si][mi]
+        ]
+        if flat_scalars:
+            flat_vals = np.asarray(jnp.stack(flat_scalars))
+        else:
+            flat_vals = np.zeros((0, n_rounds))
+            # with no eval sets, the metric read above is skipped and (with
+            # forest transfer deferred) nothing else syncs — force one tiny
+            # host read so returning means "chunk computed", keeping
+            # round_times_s and the overhead ablation honest (under the
+            # tunneled relay block_until_ready does not reliably block)
+            shard0 = new_margins.addressable_shards[0].data
+            np.asarray(shard0[:1, :1])
         results: List[Dict[str, Dict[str, float]]] = []
-        contribs_np = jax.tree.map(np.asarray, contribs)
         for r in range(n_rounds):
             round_res: Dict[str, Dict[str, float]] = {}
+            fi = 0
             for si, es in enumerate(self.evals):
                 row: Dict[str, float] = {}
                 for mi, name in enumerate(self._device_metrics):
-                    num = float(contribs_np[si][mi][0][r])
-                    den = float(contribs_np[si][mi][1][r])
+                    num = float(flat_vals[fi][r])
+                    den = float(flat_vals[fi + 1][r])
+                    fi += 2
                     val = num / max(den, 1e-12)
                     base, _ = parse_metric_name(name)
                     row[name] = float(np.sqrt(val)) if base in ("rmse", "rmsle") else val
@@ -1032,7 +1054,7 @@ class TpuEngine:
             if not es.is_train:
                 es.margins = new_eval_margins[ei]
                 ei += 1
-        self._trees_dev.append(forest)
+        self._trees_dev.append((forest, None))
 
         # metrics: one stacked transfer for all (num, den) scalars instead of
         # a blocking host read per scalar (each read is a relay round trip)
@@ -1177,24 +1199,40 @@ class TpuEngine:
         return Tree(*[f[: self._stack_rows] for f in self._stack_buf])
 
     def _flush_trees(self) -> None:
-        """Transfer any pending per-round device forests to host in one
-        batched stack (one read per Tree field, not per round x field)."""
-        if not self._trees_dev:
+        """Transfer pending device forests to host with batched reads.
+
+        Entries are ``(tree, None)`` for one round (per-round step paths) or
+        ``(stacked_tree, n_rounds)`` for a whole scan chunk. ALL pending
+        entries are concatenated on device first (per-round trees expand to a
+        length-1 leading axis; forest shapes are constant within a run), so a
+        flush costs exactly one host read per Tree field no matter how many
+        rounds or chunks are pending — one round trip per field under the
+        tunneled relay."""
+        entries = self._trees_dev
+        if not entries:
             return
-        if len(self._trees_dev) == 1:
-            self.trees.append(jax.tree.map(np.asarray, self._trees_dev[0]))
-        else:
-            stacked = jax.tree.map(
-                lambda *xs: np.asarray(jnp.stack(xs)), *self._trees_dev
-            )
-            for r in range(len(self._trees_dev)):
-                self.trees.append(jax.tree.map(lambda a: a[r], stacked))
+        total = sum(1 if n is None else n for _, n in entries)
+        if len(entries) == 1 and entries[0][1] is None:
+            self.trees.append(jax.tree.map(np.asarray, entries[0][0]))
+            self._trees_dev.clear()
+            return
+        expanded = [
+            jax.tree.map(lambda a: a[None], t) if n is None else t
+            for t, n in entries
+        ]
+        stacked = jax.tree.map(
+            lambda *xs: np.asarray(jnp.concatenate(xs, axis=0)), *expanded
+        )
+        for r in range(total):
+            self.trees.append(jax.tree.map(lambda a, _r=r: a[_r], stacked))
         self._trees_dev.clear()
 
     @property
     def num_round_trees(self) -> int:
         """Rounds recorded so far (host-resident + pending device forests)."""
-        return len(self.trees) + len(self._trees_dev)
+        return len(self.trees) + sum(
+            1 if n is None else n for _, n in self._trees_dev
+        )
 
     def get_booster(self) -> RayXGBoostBooster:
         forest = self._stacked_forest()
@@ -1421,7 +1459,7 @@ class TpuEngine:
             if not es.is_train:
                 es.margins = new_eval_margins[ei]
                 ei += 1
-        self._trees_dev.append(round_forest)
+        self._trees_dev.append((round_forest, None))
         w_new_vec = w_post
         w_new_vec[self.dart_t : self.dart_t + self.n_outputs] = new_w
         self.dart_weights = w_new_vec
