@@ -165,15 +165,37 @@ def run_measurement():
     }
 
     train_start = time.time()
+    additional_results = {}
     bst = train(
         params,
         dtrain,
         num_boost_round=rounds,
+        additional_results=additional_results,
         ray_params=RayParams(num_actors=actors, checkpoint_frequency=0),
     )
     train_time = time.time() - train_start
     print(f"[bench] TRAIN TIME TAKEN: {train_time:.2f}s", file=sys.stderr)
     assert bst.num_boosted_rounds() == rounds
+
+    # per-round time series: the artifact the single-chip -> 8-chip projection
+    # argues from (VERDICT r3 weak #7). First chunk carries the compile; the
+    # median of the rest is the steady-state marginal.
+    rt = additional_results.get("round_times_s") or []
+    detail = {}
+    if rt:
+        chunk = max(1, int(os.environ.get("RXGB_SCAN_MAX_CHUNK", "10")))
+        detail = {
+            "round_times_s": [round(v, 4) for v in rt],
+            "first_chunk_mean_s": round(float(np.mean(rt[:chunk])), 4),
+        }
+        if len(rt) > chunk:
+            # steady-state excludes the compile-carrying first chunk; with
+            # fewer rounds than one chunk there IS no steady sample — omit
+            # rather than mislabel compile time
+            steady = rt[chunk:]
+            detail["steady_median_s"] = round(float(np.median(steady)), 4)
+            detail["steady_p90_s"] = round(float(np.percentile(steady, 90)), 4)
+        print(f"[bench] round-time detail: {detail}", file=sys.stderr)
 
     # normalize to the full protocol (11M rows x 100 rounds) when a smaller
     # config was run, so the metric stays comparable across environments
@@ -211,6 +233,12 @@ def run_measurement():
                 "value": round(normalized, 2),
                 "unit": "s",
                 "vs_baseline": round(BASELINE_GPU_HIST_S / normalized, 3),
+                "backend": backend,
+                "rows": n_rows,
+                "rounds": rounds,
+                "actors": actors,
+                "train_time_s": round(train_time, 2),
+                **detail,
             }
         )
     )

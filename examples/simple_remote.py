@@ -1,0 +1,45 @@
+"""Train and predict through the remote-execution tier (thin-driver mode).
+
+The analog of the reference's Ray-client example flow
+(``xgboost_ray/main.py:1413-1452``: a thin client re-runs train as a remote
+task on the server): ``_remote=True`` ships the call to a spawned server
+process that owns the accelerator, so this driver process never initializes
+the device. Note the ``__main__`` guard — required by multiprocessing spawn.
+"""
+
+import argparse
+
+import numpy as np
+from sklearn import datasets
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, predict, train
+
+
+def main(num_actors):
+    data, labels = datasets.load_breast_cancer(return_X_y=True)
+    data = data.astype(np.float32)
+
+    evals_result = {}
+    bst = train(
+        {"objective": "binary:logistic", "eval_metric": ["logloss", "error"]},
+        RayDMatrix(data, labels),
+        num_boost_round=10,
+        evals_result=evals_result,
+        evals=[(RayDMatrix(data, labels), "train")],
+        ray_params=RayParams(num_actors=num_actors),
+        _remote=True,
+    )
+    bst.save_model("simple_remote.json")
+    print("Final training error: {:.4f}".format(evals_result["train"]["error"][-1]))
+
+    pred = predict(bst, RayDMatrix(data),
+                   ray_params=RayParams(num_actors=num_actors), _remote=True)
+    acc = ((pred > 0.5) == labels).mean()
+    print("Prediction accuracy (remote predict): {:.4f}".format(acc))
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-actors", type=int, default=2)
+    args = parser.parse_args()
+    main(args.num_actors)

@@ -332,3 +332,39 @@ def test_fast_precision_no_missing_matches_highest_on_cpu():
                     RayDMatrix(x, y), 4, ray_params=RayParams(num_actors=2))
         preds[prec] = bst.predict(x)
     np.testing.assert_allclose(preds["fast"], preds["highest"], atol=1e-5)
+
+
+def test_interleaved_step_and_scan_preserve_forest_order():
+    """step_many defers whole stacked chunks while step() defers single
+    rounds; a mixed sequence must flush into the exact per-round order and
+    match a pure per-round run bit-for-bit (the deferred-transfer change)."""
+    rng = np.random.RandomState(11)
+    x = rng.randn(300, 5).astype(np.float32)
+    y = (x[:, 0] + 0.3 * x[:, 1] > 0).astype(np.float32)
+    p = parse_params({"objective": "binary:logistic", "max_depth": 3,
+                      "eta": 0.4})
+    shards = [{"data": x, "label": y}]
+
+    eng_mixed = TpuEngine(shards, p, num_actors=2)
+    assert eng_mixed.can_batch_rounds()
+    eng_mixed.step_many(0, 4)         # chunk entry (stacked, n=4)
+    eng_mixed.step(4)                 # single entry
+    eng_mixed.step(5)                 # single entry
+    eng_mixed.step_many(6, 3)         # another chunk
+    assert eng_mixed.num_round_trees == 9
+    bst_mixed = eng_mixed.get_booster()
+    assert bst_mixed.num_boosted_rounds() == 9
+
+    eng_seq = TpuEngine(shards, p, num_actors=2)
+    for i in range(9):
+        eng_seq.step(i)
+    bst_seq = eng_seq.get_booster()
+
+    np.testing.assert_allclose(
+        bst_mixed.predict(x, output_margin=True),
+        bst_seq.predict(x, output_margin=True), atol=1e-5,
+    )
+    # stacked forest fields match elementwise — round ORDER preserved, not
+    # just the ensemble sum
+    for t_m, t_s in zip(bst_mixed.forest, bst_seq.forest):
+        np.testing.assert_allclose(np.asarray(t_m), np.asarray(t_s), atol=1e-5)

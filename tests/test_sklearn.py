@@ -201,6 +201,22 @@ def test_callbacks_through_fit(bc):
     assert clf2.get_booster().num_boosted_rounds() == 3
 
 
+def test_callable_eval_metric(bc):
+    """xgboost >= 1.6 sklearn API: eval_metric may be a sklearn-style
+    callable metric(y_true, y_pred); values flow into evals_result under the
+    function's name."""
+    from sklearn.metrics import log_loss
+
+    x_tr, x_te, y_tr, y_te = bc
+    clf = RayXGBClassifier(n_estimators=6, max_depth=3, eval_metric=log_loss,
+                           random_state=0)
+    clf.fit(x_tr, y_tr, eval_set=[(x_te, y_te)], ray_params=RP)
+    res = clf.evals_result()["validation_0"]["log_loss"]
+    assert len(res) == 6
+    p = clf.predict_proba(x_te, ray_params=RP)[:, 1]
+    assert np.isclose(res[-1], log_loss(y_te, p), atol=1e-4)
+
+
 def test_clone_and_get_params():
     clf = RayXGBClassifier(n_estimators=7, max_depth=2, learning_rate=0.1)
     cloned = clone(clf)

@@ -502,6 +502,20 @@ class RayXGBoostBooster:
         )
         return np.asarray(obj.transform(jnp.asarray(margin)))
 
+    def export_xgboost_json(self, fname: Optional[str] = None) -> str:
+        """Serialize in the xgboost JSON model schema (loadable by any
+        xgboost runtime — the interop property reference users have)."""
+        from xgboost_ray_tpu.models.xgb_export import export_xgboost_json
+
+        return export_xgboost_json(self, fname)
+
+    @classmethod
+    def import_xgboost_json(cls, data) -> "RayXGBoostBooster":
+        """Load an xgboost JSON model (ours or real xgboost's)."""
+        from xgboost_ray_tpu.models.xgb_export import import_xgboost_json
+
+        return import_xgboost_json(data)
+
     # -- serialization -----------------------------------------------------
 
     def _to_dict(self) -> Dict[str, Any]:

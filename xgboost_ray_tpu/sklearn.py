@@ -98,6 +98,30 @@ def _check_if_params_are_ray_dmatrix(X, sample_weight, base_margin, eval_set,
     return train_dmatrix, evals
 
 
+class _SklearnMetricAdapter:
+    """Picklable wrapper turning a sklearn-style ``metric(y_true, y_pred)``
+    into the train() custom-metric contract ``(preds, dmat) -> (name, value)``
+    with the objective's prediction transform applied first. Module-level (a
+    class, not a closure) so it survives the ``_remote=True`` spawn pickling."""
+
+    def __init__(self, fn, obj_name: str, num_class: int):
+        self.fn = fn
+        self.obj_name = obj_name
+        self.num_class = num_class
+
+    def __call__(self, preds, dmat):
+        import jax.numpy as jnp
+
+        from xgboost_ray_tpu.ops.objectives import get_objective
+
+        y = dmat.get_label()
+        o = get_objective(self.obj_name, self.num_class, 1.0)
+        yp = np.asarray(
+            o.transform(jnp.asarray(np.asarray(preds).reshape(len(y), -1)))
+        )
+        return self.fn.__name__, float(self.fn(y, yp))
+
+
 class RayXGBMixin:
     """Shared plumbing for all estimators."""
 
@@ -203,6 +227,34 @@ class RayXGBMixin:
             params["objective"] = "reg:squarederror"
         if obj is not None:
             extra["obj"] = obj
+
+        # xgboost >= 1.6 sklearn API: eval_metric may be a sklearn-style
+        # callable metric(y_true, y_pred) (e.g. sklearn.metrics.log_loss);
+        # route it through the train() custom-metric hook with the
+        # objective's prediction transform applied first.
+        em = params.get("eval_metric")
+        metric_fn = None
+        if callable(em):
+            metric_fn = em
+            params.pop("eval_metric")
+        elif isinstance(em, (list, tuple)) and any(callable(m) for m in em):
+            fns = [m for m in em if callable(m)]
+            if len(fns) > 1:
+                raise ValueError(
+                    "at most one callable eval_metric is supported per fit"
+                )
+            metric_fn = fns[0]
+            rest = [m for m in em if not callable(m)]
+            if rest:
+                params["eval_metric"] = list(rest)
+            else:
+                params.pop("eval_metric")
+        if metric_fn is not None:
+            extra["custom_metric"] = _SklearnMetricAdapter(
+                metric_fn,
+                params.get("objective", "reg:squarederror"),
+                int(params.get("num_class", 0) or 0),
+            )
         esr = early_stopping_rounds
         if esr is None:
             esr = getattr(self, "early_stopping_rounds", None)
