@@ -69,8 +69,13 @@ def test_roundtrip_binary_prediction_parity(tmp_path):
         bst.predict(x, output_margin=True), atol=1e-5,
     )
     np.testing.assert_allclose(back.predict(x), bst.predict(x), atol=1e-5)
-    # node stats survive: contributions still work and sum to the margin
+    # node stats survive the lr-convention translation (export writes
+    # pre-learning-rate base_weights, import rescales): contributions of the
+    # imported model match the original's and sum to the margin
     contribs = back.predict(x[:16], pred_contribs=True)
+    np.testing.assert_allclose(
+        contribs, bst.predict(x[:16], pred_contribs=True), atol=1e-4
+    )
     np.testing.assert_allclose(
         contribs.sum(axis=-1), back.predict(x[:16], output_margin=True),
         atol=1e-4,
@@ -199,3 +204,29 @@ def test_import_rejects_categorical_splits():
            "version": [2, 0, 0]}
     with pytest.raises(ValueError, match="categorical"):
         RayXGBoostBooster.import_xgboost_json(doc)
+
+
+def test_get_dump_json_format():
+    """get_dump(dump_format='json') emits xgboost's nested node dicts."""
+    bst, _ = _binary_model(rounds=2)
+    dumps = bst.get_dump(with_stats=True, dump_format="json")
+    assert len(dumps) == 2
+    for d in dumps:
+        root = json.loads(d)
+        assert root["nodeid"] == 0
+        if "leaf" not in root:
+            assert root["split"].startswith("f")
+            assert {"split_condition", "yes", "no", "missing",
+                    "children", "gain", "cover"} <= set(root)
+            # leaves reachable, each with a value
+            stack = [root]
+            leaves = 0
+            while stack:
+                n = stack.pop()
+                if "leaf" in n:
+                    leaves += 1
+                else:
+                    stack.extend(n["children"])
+            assert leaves >= 2
+    with pytest.raises(ValueError, match="dump_format"):
+        bst.get_dump(dump_format="dot")

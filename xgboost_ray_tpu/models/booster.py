@@ -605,6 +605,12 @@ class RayXGBoostBooster:
     # -- model dump (structural comparison; reference tests/utils.py) ------
 
     def get_dump(self, with_stats: bool = False, dump_format: str = "text") -> List[str]:
+        if dump_format == "json":
+            return self._get_dump_json(with_stats)
+        if dump_format != "text":
+            raise ValueError(
+                f"Unsupported dump_format {dump_format!r} (text or json)."
+            )
         dumps = []
         heap = self.forest.feature.shape[1]
         for t in range(self.num_trees):
@@ -642,6 +648,46 @@ class RayXGBoostBooster:
 
             rec(0, 0)
             dumps.append("\n".join(lines) + "\n")
+        return dumps
+
+    def _get_dump_json(self, with_stats: bool) -> List[str]:
+        """xgboost ``dump_format="json"``: one nested node-dict JSON string
+        per tree (``nodeid/depth/split/split_condition/yes/no/missing/
+        children`` for internal nodes, ``nodeid/leaf`` for leaves)."""
+        heap = self.forest.feature.shape[1]
+        dumps = []
+        for t in range(self.num_trees):
+
+            def rec(idx: int, depth: int):
+                if bool(self.forest.is_leaf[t, idx]):
+                    node = {"nodeid": idx, "leaf": float(self.forest.value[t, idx])}
+                    if with_stats:
+                        node["cover"] = float(self.forest.cover[t, idx])
+                    return node
+                f = int(self.forest.feature[t, idx])
+                if f < 0:
+                    return None  # unused slot
+                miss = 2 * idx + 1 if bool(self.forest.default_left[t, idx]) else 2 * idx + 2
+                node = {
+                    "nodeid": idx,
+                    "depth": depth,
+                    "split": f"f{f}",
+                    "split_condition": float(self.forest.threshold[t, idx]),
+                    "yes": 2 * idx + 1,
+                    "no": 2 * idx + 2,
+                    "missing": miss,
+                }
+                if with_stats:
+                    node["gain"] = float(self.forest.gain[t, idx])
+                    node["cover"] = float(self.forest.cover[t, idx])
+                children = [
+                    rec(2 * idx + 1, depth + 1), rec(2 * idx + 2, depth + 1)
+                ]
+                node["children"] = [c for c in children if c is not None]
+                return node
+
+            root = rec(0, 0)
+            dumps.append(json.dumps(root if root is not None else {}))
         return dumps
 
     def trees_to_dataframe(self):

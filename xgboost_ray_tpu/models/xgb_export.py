@@ -16,6 +16,7 @@ serialization.
 """
 
 import json
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -23,8 +24,13 @@ import numpy as np
 _INT_MAX = 2147483647
 
 
-def _tree_to_xgb(tree_np, t_id: int, num_feature: int) -> Dict[str, Any]:
-    """One padded-heap tree -> xgboost compact node-array dict (BFS ids)."""
+def _tree_to_xgb(tree_np, t_id: int, num_feature: int,
+                 learning_rate: float = 1.0) -> Dict[str, Any]:
+    """One padded-heap tree -> xgboost compact node-array dict (BFS ids).
+
+    ``base_weights`` convention: xgboost stores PRE-learning-rate node
+    weights (leaf value = eta * base_weight); this repo's Tree.base_weight is
+    lr-scaled, so export divides by ``learning_rate``."""
     feature = np.asarray(tree_np.feature)
     threshold = np.asarray(tree_np.threshold)
     default_left = np.asarray(tree_np.default_left)
@@ -42,9 +48,9 @@ def _tree_to_xgb(tree_np, t_id: int, num_feature: int) -> Dict[str, Any]:
     # BFS over reachable heap slots; compact ids in visit order (root = 0)
     ids: Dict[int, int] = {}
     order: List[int] = []
-    queue = [0]
+    queue = deque([0])
     while queue:
-        h = queue.pop(0)
+        h = queue.popleft()
         ids[h] = len(order)
         order.append(h)
         if _internal(h):
@@ -70,7 +76,7 @@ def _tree_to_xgb(tree_np, t_id: int, num_feature: int) -> Dict[str, Any]:
             dleft.append(0)
             losses.append(0.0)
         hess.append(float(cover[h]))
-        bw.append(float(base_weight[h]))
+        bw.append(float(base_weight[h]) / max(learning_rate, 1e-12))
         if h == 0:
             parents.append(_INT_MAX)
         else:
@@ -131,11 +137,12 @@ def export_xgboost_json(booster, fname: Optional[str] = None) -> str:
     per_round = k * npt
 
     n_trees = int(np.asarray(forest.feature).shape[0])
+    lr = float(getattr(booster.params, "learning_rate", 1.0) or 1.0)
     trees = []
     tree_info = []
     for t in range(n_trees):
         tree_np = type(forest)(*[np.asarray(f)[t] for f in forest])
-        trees.append(_tree_to_xgb(tree_np, t, num_feature))
+        trees.append(_tree_to_xgb(tree_np, t, num_feature, learning_rate=lr))
         tree_info.append((t % per_round) // npt if k > 1 else 0)
 
     rounds = max(1, n_trees // per_round)
@@ -204,15 +211,12 @@ def _xgb_tree_to_heap(t: Dict[str, Any]) -> Tuple[Dict[str, np.ndarray], int]:
     right = t["right_children"]
     n = len(left)
 
-    # depth of the compact tree (leaves included)
-    depth_of = [0] * n
+    # depth of the compact tree: node order in xgboost dumps is not
+    # guaranteed parent-before-child, so walk from the root
     max_depth = 0
-    # nodes appear before their children in xgboost dumps is NOT guaranteed;
-    # compute depths by walking from the root
     stack = [(0, 0)]
     while stack:
         nid, d = stack.pop()
-        depth_of[nid] = d
         max_depth = max(max_depth, d)
         if left[nid] != -1:
             stack.append((left[nid], d + 1))
@@ -246,15 +250,30 @@ def _xgb_tree_to_heap(t: Dict[str, Any]) -> Tuple[Dict[str, np.ndarray], int]:
     sh = t.get("sum_hessian", [0.0] * n)
     bw = t.get("base_weights", [0.0] * n)
 
+    # xgboost base_weights are PRE-learning-rate (leaf value = eta * weight);
+    # this repo's convention is lr-scaled (base_weight == value at leaves).
+    # The schema does not store eta, so recover the scale from the leaves'
+    # value/weight ratios (median for robustness; 1.0 when degenerate, e.g.
+    # our own exports round-tripped or an all-zero-weight tree).
+    ratios = [
+        sc[i] / bw[i]
+        for i in range(n)
+        if left[i] == -1 and abs(bw[i]) > 1e-12
+    ]
+    eta_scale = float(np.median(ratios)) if ratios else 1.0
+    if not np.isfinite(eta_scale) or eta_scale <= 0:
+        eta_scale = 1.0
+
     stack = [(0, 0)]  # (compact id, heap slot)
     while stack:
         nid, h = stack.pop()
         fields["cover"][h] = sh[nid]
-        fields["base_weight"][h] = bw[nid]
+        fields["base_weight"][h] = bw[nid] * eta_scale
         if left[nid] == -1:
             fields["is_leaf"][h] = True
             fields["value"][h] = sc[nid]
-            fields["base_weight"][h] = bw[nid] if bw[nid] else sc[nid]
+            # exact convention: base_weight equals the leaf value at leaves
+            fields["base_weight"][h] = sc[nid]
         else:
             fields["feature"][h] = si[nid]
             fields["threshold"][h] = sc[nid]
@@ -315,8 +334,9 @@ def import_xgboost_json(data) -> "RayXGBoostBooster":
                 out[k] = v
         return out
 
+    padded = [_pad(f) for f, _ in per_tree]
     stacked = {
-        k: np.stack([_pad(f)[k] for f, _ in per_tree])
+        k: np.stack([p[k] for p in padded])
         for k in per_tree[0][0]
     } if per_tree else {
         k: np.zeros((0, heap), np.float32) for k in (

@@ -119,6 +119,10 @@ class _SklearnMetricAdapter:
         yp = np.asarray(
             o.transform(jnp.asarray(np.asarray(preds).reshape(len(y), -1)))
         )
+        w = dmat.get_weight()
+        if w is not None and np.asarray(w).size:
+            # xgboost's _metric_decorator passes eval-set weights through
+            return self.fn.__name__, float(self.fn(y, yp, sample_weight=w))
         return self.fn.__name__, float(self.fn(y, yp))
 
 
