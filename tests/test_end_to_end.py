@@ -130,6 +130,19 @@ def test_spmd_predict_softprob_and_iteration_range(monkeypatch):
         np.testing.assert_allclose(spmd, host, atol=1e-6)
 
 
+def test_spmd_predict_more_actors_than_devices(monkeypatch):
+    """num_actors > mesh devices folds shards onto the available devices in
+    both predict paths (the engine's folding rule), preserving parity."""
+    x, y, _ = _one_hot_fixture()
+    bst = train(_PARAMS, RayDMatrix(x, y), 8, ray_params=RayParams(num_actors=2))
+    monkeypatch.setenv("RXGB_SPMD_PREDICT", "1")
+    spmd = predict(bst, RayDMatrix(x), ray_params=RayParams(num_actors=16))
+    monkeypatch.setenv("RXGB_SPMD_PREDICT", "0")
+    host = predict(bst, RayDMatrix(x), ray_params=RayParams(num_actors=16))
+    assert spmd.shape == (32,)
+    np.testing.assert_allclose(spmd, host, atol=1e-6)
+
+
 def test_predict_softprob_2d_combine():
     rng = np.random.RandomState(0)
     n = 90
