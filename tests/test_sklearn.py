@@ -393,3 +393,23 @@ def test_apply_returns_leaf_indices(bc):
     bst = clf.get_booster()
     for t in range(4):
         assert bst.forest.is_leaf[t, leaves[:, t]].all()
+
+
+def test_apply_iteration_range_and_best_model(bc):
+    """apply() honors iteration_range and defaults to the best model after
+    early stopping (xgboost >= 1.6 semantics)."""
+    x_tr, x_te, y_tr, y_te = bc
+    clf = RayXGBClassifier(n_estimators=30, max_depth=5, eval_metric=["logloss"],
+                           random_state=0)
+    clf.fit(x_tr, y_tr, eval_set=[(x_te, y_te)], early_stopping_rounds=2,
+            ray_params=RP)
+    n_rounds = len(clf.evals_result()["validation_0"]["logloss"])
+    full = clf.apply(x_te, iteration_range=(0, n_rounds))
+    assert full.shape == (len(y_te), n_rounds)
+    sliced = clf.apply(x_te, iteration_range=(0, 3))
+    assert sliced.shape == (len(y_te), 3)
+    np.testing.assert_array_equal(sliced, full[:, :3])
+    # default after early stopping = best model
+    best = clf.apply(x_te)
+    assert best.shape == (len(y_te), clf.best_iteration + 1)
+    np.testing.assert_array_equal(best, full[:, : clf.best_iteration + 1])

@@ -477,11 +477,14 @@ class RayXGBoostBooster:
                 approx=approx_contribs,
             )
         if pred_leaf:
-            forest_dev = Tree(*[jnp.asarray(f) for f in self.forest])
+            booster = self
+            if iteration_range is not None and iteration_range != (0, 0):
+                booster = self.slice_rounds(iteration_range[0], iteration_range[1])
+            forest_dev = Tree(*[jnp.asarray(f) for f in booster.forest])
             return np.asarray(
                 predict_ops.predict_leaf_index(
-                    forest_dev, jnp.asarray(x), self.max_depth,
-                    cat_features=self.cat_features,
+                    forest_dev, jnp.asarray(x), booster.max_depth,
+                    cat_features=booster.cat_features,
                 )
             )
         booster = self

@@ -319,13 +319,7 @@ class RayXGBMixin:
         )
         if ntree_limit:
             kwargs["ntree_limit"] = ntree_limit
-        if iteration_range is None and not ntree_limit:
-            # early stopping: predict with the best model by default, the
-            # xgboost sklearn contract (reference's ported suite checks
-            # best_iteration feeding predict, ``tests/test_sklearn.py``)
-            best_it = getattr(self, "best_iteration", None)
-            if best_it is not None:
-                iteration_range = (0, int(best_it) + 1)
+        iteration_range = self._resolve_iteration_range(ntree_limit, iteration_range)
         if iteration_range is not None:
             kwargs["iteration_range"] = iteration_range
         if isinstance(X, RayDMatrix):
@@ -342,11 +336,28 @@ class RayXGBMixin:
             _remote=_remote, **kwargs,
         )
 
-    def apply(self, X, ntree_limit: int = 0) -> np.ndarray:
-        """Per-tree leaf heap index for each sample (xgboost ``apply`` analog)."""
+    def _resolve_iteration_range(self, ntree_limit, iteration_range):
+        """The xgboost sklearn early-stopping contract, in ONE place: when
+        neither ntree_limit nor an explicit range is given, default to the
+        best model (reference's ported suite checks best_iteration feeding
+        predict, ``tests/test_sklearn.py``)."""
+        if iteration_range is None and not ntree_limit:
+            best_it = getattr(self, "best_iteration", None)
+            if best_it is not None:
+                return (0, int(best_it) + 1)
+        return iteration_range
+
+    def apply(self, X, ntree_limit: int = 0, iteration_range=None) -> np.ndarray:
+        """Per-tree leaf heap index for each sample (xgboost ``apply``
+        analog, incl. the >=1.6 ``iteration_range`` and best-model default
+        after early stopping)."""
         booster = self.get_booster()
+        iteration_range = self._resolve_iteration_range(ntree_limit, iteration_range)
         x = booster._coerce_features(X)
-        leaves = booster.predict(x, pred_leaf=True, validate_features=False)
+        leaves = booster.predict(
+            x, pred_leaf=True, validate_features=False,
+            iteration_range=iteration_range,
+        )
         if ntree_limit:
             leaves = leaves[:, :ntree_limit]
         return leaves
