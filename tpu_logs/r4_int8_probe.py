@@ -1,0 +1,89 @@
+"""Probe: would int8 quantized histogram matmuls beat the bf16 one-hot path?
+
+Motivated by 'Quantized Training of GBDT' (arxiv 2207.09682, PAPERS.md):
+low-bit gradient histograms. STATUS r2 measured the v5e hist build as
+DMA/step-bound rather than MXU-pass-bound, so the expected win (if any) is
+from halving the one-hot operand's HBM traffic (bf16 -> int8), not FLOPs.
+This times the EXACT contraction shape hist_onehot issues — [chunk, nb] x
+[chunk, 2] — in bf16 vs int8 (int32 accumulate), at the 1M x 28 x 256
+depth-6 worst level. Decision rule: int8 must win by >15% per build before
+a product quantized path (with stochastic rounding + accuracy validation)
+is worth building; otherwise record the negative result and close the idea.
+
+Run serialized on the tunnel (r4_queue.sh).
+"""
+
+import os
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def time_build(dtype_name: str, chunk=8192, n=1_000_000, nodes=32, nb_reg=256,
+               reps=3):
+    nb = nodes * nb_reg
+    n_chunks = n // chunk
+    rng = np.random.RandomState(0)
+    idx = rng.randint(0, nb, size=(n_chunks, chunk)).astype(np.int32)
+    gh = rng.randn(n_chunks, chunk, 2).astype(np.float32)
+
+    if dtype_name == "bf16":
+        oh_dtype, gh_dtype, acc_dtype = jnp.bfloat16, jnp.bfloat16, jnp.float32
+    else:  # int8: one-hot is exactly representable; gh quantized per chunk
+        oh_dtype, gh_dtype, acc_dtype = jnp.int8, jnp.int8, jnp.int32
+
+    def build(idx_a, gh_a):
+        def step(acc, args):
+            ix, ghk = args
+            oh = jax.nn.one_hot(ix, nb, dtype=oh_dtype)
+            if dtype_name == "int8":
+                scale = jnp.max(jnp.abs(ghk)) / 127.0 + 1e-12
+                ghq = jnp.clip(jnp.round(ghk / scale), -127, 127).astype(jnp.int8)
+                contrib = jax.lax.dot_general(
+                    oh, ghq, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32,
+                )
+                acc = acc + contrib.astype(jnp.float32) * scale
+            else:
+                ghk = ghk.astype(gh_dtype)
+                contrib = jax.lax.dot_general(
+                    oh, ghk, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                acc = acc + contrib
+            return acc, None
+        acc0 = jnp.zeros((nb, 2), jnp.float32)
+        acc, _ = jax.lax.scan(step, acc0, (idx_a, gh_a))
+        return acc
+
+    fn = jax.jit(build)
+    idx_d, gh_d = jnp.asarray(idx), jnp.asarray(gh)
+    out = fn(idx_d, gh_d)
+    _ = np.asarray(out[:1, :1])  # force compile + run
+    times = []
+    for _r in range(reps):
+        t0 = time.time()
+        out = fn(idx_d, gh_d)
+        _ = np.asarray(out[:1, :1])  # host read = sync (relay-safe)
+        times.append(time.time() - t0)
+    return min(times), np.asarray(out)
+
+
+def main():
+    print(f"backend={jax.default_backend()}", flush=True)
+    t_bf16, h_bf16 = time_build("bf16")
+    print(f"bf16 one-hot build: {t_bf16*1e3:.1f} ms / 1M rows", flush=True)
+    t_int8, h_int8 = time_build("int8")
+    print(f"int8 one-hot build: {t_int8*1e3:.1f} ms / 1M rows", flush=True)
+    rel = np.abs(h_int8 - h_bf16).max() / (np.abs(h_bf16).max() + 1e-9)
+    print(f"speedup: {t_bf16 / t_int8:.2f}x  max-rel-diff: {rel:.2e}", flush=True)
+    print("DECISION: build quantized product path" if t_bf16 / t_int8 > 1.15
+          else "DECISION: keep bf16 (int8 not worth it here)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,7 @@ run overhead 3600 python tpu_logs/r4_overhead.py
 run predict_bench 2400 python tests/release/benchmark_predict.py 1 1000000
 run mslr 3600 python tests/release/benchmark_ranking.py 1 100
 run pallas 2400 python tpu_logs/r3_pallas.py
+run int8_probe 1200 python tpu_logs/r4_int8_probe.py
 run quality 1800 python tpu_logs/quality_fast.py
 echo "R4 QUEUE ALL DONE $(date +%T)" >> $L/r4.log
 git add tpu_logs/r4.log tpu_logs/r4_bench_line.json tpu_logs/r4_probe.log 2>/dev/null
