@@ -228,3 +228,91 @@ def test_multiclass_num_class_inferred():
     assert proba.shape == (x.shape[0], 3)
     np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
     assert (clf.predict(x) == y).mean() > 0.9
+
+
+def _custom_squared_error(y_true, y_pred):
+    """sklearn-level custom objective signature: fn(y_true, y_pred)."""
+    grad = (y_pred - y_true).astype(np.float32)
+    hess = np.ones_like(grad)
+    return grad, hess
+
+
+def _custom_logistic(y_true, y_pred):
+    p = 1.0 / (1.0 + np.exp(-y_pred))
+    return (p - y_true).astype(np.float32), (p * (1 - p)).astype(np.float32)
+
+
+def test_regression_with_custom_objective():
+    """Reference: test_regression_with_custom_objective — a callable
+    objective uses xgboost's sklearn fn(y_true, y_pred) convention and must
+    match the built-in objective's model."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(300, 4).astype(np.float32)
+    y = (x[:, 0] * 2 + 0.1 * rng.randn(300)).astype(np.float32)
+    reg_custom = RayXGBRegressor(n_estimators=8, max_depth=3, random_state=0,
+                                 objective=_custom_squared_error)
+    reg_custom.fit(x, y, ray_params=_RP)
+    reg_builtin = RayXGBRegressor(n_estimators=8, max_depth=3, random_state=0)
+    reg_builtin.fit(x, y, ray_params=_RP)
+    np.testing.assert_allclose(
+        reg_custom.predict(x, ray_params=_RP),
+        reg_builtin.predict(x, ray_params=_RP), atol=1e-4,
+    )
+
+
+def test_classification_with_custom_objective():
+    """Reference: test_classification_with_custom_objective — custom
+    logistic gradients; predict_proba keeps the class-default transform."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(300, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    clf = RayXGBClassifier(n_estimators=10, max_depth=3, random_state=0,
+                           objective=_custom_logistic)
+    clf.fit(x, y, ray_params=_RP)
+    proba = clf.predict_proba(x, ray_params=_RP)
+    assert proba.shape == (300, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+    assert ((proba[:, 1] > 0.5) == (y > 0.5)).mean() > 0.95
+
+
+def test_n_jobs_maps_to_num_actors():
+    """Reference: test_sklearn_n_jobs — n_jobs is the actor count when no
+    ray_params is given."""
+    clf = RayXGBClassifier(n_estimators=3, max_depth=2, n_jobs=3)
+    assert clf._get_ray_params(None).num_actors == 3
+    rng = np.random.RandomState(2)
+    x = rng.randn(120, 3).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    clf.fit(x, y)  # derives RayParams(num_actors=3) internally
+    assert clf.get_booster().num_boosted_rounds() == 3
+
+
+def test_feature_weights_zero_excludes_features():
+    """Reference: test_feature_weights — zero-weighted features are never
+    split on (colsample draws skip them)."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(400, 6).astype(np.float32)
+    y = (x[:, 0] + x[:, 5] > 0).astype(np.float32)
+    fw = np.array([1, 1, 1, 1, 1, 0], np.float32)  # exclude the informative f5
+    clf = RayXGBClassifier(n_estimators=8, max_depth=3, random_state=0,
+                           colsample_bytree=0.8)
+    clf.fit(x, y, feature_weights=fw, ray_params=_RP)
+    score = clf.get_booster().get_score(importance_type="weight")
+    assert "f5" not in score  # never chosen
+    assert "f0" in score
+
+
+def test_rfecv_integration():
+    """Reference: test_zzzzzzz_RFECV — recursive feature elimination drives
+    clone/fit/importances repeatedly through the estimator."""
+    from sklearn.feature_selection import RFECV
+
+    rng = np.random.RandomState(4)
+    x = rng.randn(160, 5).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    reg = RayXGBRegressor(n_estimators=4, max_depth=2, random_state=0, n_jobs=2)
+    sel = RFECV(reg, step=1, cv=2, min_features_to_select=2)
+    sel.fit(x, y)
+    assert sel.n_features_ >= 2
+    # the informative features survive elimination
+    assert sel.support_[0] and sel.support_[1]

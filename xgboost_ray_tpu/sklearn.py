@@ -98,27 +98,48 @@ def _check_if_params_are_ray_dmatrix(X, sample_weight, base_margin, eval_set,
     return train_dmatrix, evals
 
 
+class _SklearnObjectiveAdapter:
+    """xgboost's sklearn estimators take ``objective(y_true, y_pred) ->
+    (grad, hess)`` and wrap it into the Booster-level ``obj(preds, dmatrix)``
+    convention (xgboost ``_objective_decorator``). Module-level class so it
+    survives ``_remote=True`` spawn pickling."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, preds, dmat):
+        return self.fn(dmat.get_label(), preds)
+
+
 class _SklearnMetricAdapter:
     """Picklable wrapper turning a sklearn-style ``metric(y_true, y_pred)``
     into the train() custom-metric contract ``(preds, dmat) -> (name, value)``
     with the objective's prediction transform applied first. Module-level (a
     class, not a closure) so it survives the ``_remote=True`` spawn pickling."""
 
-    def __init__(self, fn, obj_name: str, num_class: int):
+    def __init__(self, fn, obj_name: str, num_class: int, raw: bool = False):
         self.fn = fn
         self.obj_name = obj_name
         self.num_class = num_class
+        # xgboost contract: with a CUSTOM objective the metric receives raw
+        # margins (the metric applies the inverse link itself)
+        self.raw = raw
 
     def __call__(self, preds, dmat):
-        import jax.numpy as jnp
-
-        from xgboost_ray_tpu.ops.objectives import get_objective
-
         y = dmat.get_label()
-        o = get_objective(self.obj_name, self.num_class, 1.0)
-        yp = np.asarray(
-            o.transform(jnp.asarray(np.asarray(preds).reshape(len(y), -1)))
-        )
+        if self.raw:
+            yp = np.asarray(preds).reshape(len(y), -1)
+            if yp.shape[1] == 1:
+                yp = yp[:, 0]
+        else:
+            import jax.numpy as jnp
+
+            from xgboost_ray_tpu.ops.objectives import get_objective
+
+            o = get_objective(self.obj_name, self.num_class, 1.0)
+            yp = np.asarray(
+                o.transform(jnp.asarray(np.asarray(preds).reshape(len(y), -1)))
+            )
         w = dmat.get_weight()
         if w is not None and np.asarray(w).size:
             # xgboost's _metric_decorator passes eval-set weights through
@@ -227,8 +248,9 @@ class RayXGBMixin:
         extra = {}
         obj = None
         if callable(params.get("objective")):
-            obj = params.pop("objective")
-            params["objective"] = "reg:squarederror"
+            # sklearn-level custom objective: fn(y_true, y_pred) semantics
+            obj = _SklearnObjectiveAdapter(params.pop("objective"))
+            params["objective"] = self._default_objective_for_custom()
         if obj is not None:
             extra["obj"] = obj
 
@@ -258,6 +280,7 @@ class RayXGBMixin:
                 metric_fn,
                 params.get("objective", "reg:squarederror"),
                 int(params.get("num_class", 0) or 0),
+                raw=obj is not None,
             )
         esr = early_stopping_rounds
         if esr is None:
@@ -335,6 +358,15 @@ class RayXGBMixin:
             booster, data, ray_params=self._get_ray_params(ray_params),
             _remote=_remote, **kwargs,
         )
+
+    def _default_objective_for_custom(self) -> str:
+        """The objective whose transform/base-score semantics apply when the
+        user supplies a callable objective: the estimator family's default
+        (keeps predict_proba meaningful, xgboost's behavior of retaining the
+        class default)."""
+        if getattr(self, "n_classes_", 0) > 2:
+            return "multi:softprob"
+        return getattr(self, "_default_objective", "reg:squarederror")
 
     def _resolve_iteration_range(self, ntree_limit, iteration_range):
         """The xgboost sklearn early-stopping contract, in ONE place: when
@@ -564,9 +596,13 @@ class RayXGBClassifier(ClassifierMixin, _RayXGBEstimator):
             y_enc = np.asarray([class_to_idx[v] for v in y_arr], dtype=np.float32)
 
         if self.n_classes_ > 2:
-            params.setdefault("objective", "multi:softprob")
-            if params["objective"].startswith("multi"):
+            if callable(params.get("objective")):
+                # custom objective: transforms fall back to softprob semantics
                 params["num_class"] = self.n_classes_
+            else:
+                params.setdefault("objective", "multi:softprob")
+                if params["objective"].startswith("multi"):
+                    params["num_class"] = self.n_classes_
         else:
             params.setdefault("objective", self._default_objective)
 
