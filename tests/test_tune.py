@@ -207,3 +207,74 @@ def test_tuner_concurrent_trials(tmp_path, xy):
     a, b = sorted(set(seen_devices), key=lambda ds: ds[0].id)
     assert not (set(a) & set(b))
     assert len(a) == len(jax.devices()) // 2
+
+
+def test_asha_scheduler_unit():
+    """ASHA rung logic: at rung r, values outside the top 1/eta stop."""
+    from xgboost_ray_tpu.tuner import ASHAScheduler
+
+    s = ASHAScheduler(metric="loss", mode="min", grace_rounds=2, eta=2)
+    assert s.rungs[:3] == [2, 4, 8]
+    # non-rung iterations never stop
+    assert not s.on_report("a", 1, {"loss": 9.0})
+    # first value at a rung is the cutoff itself -> continues
+    assert not s.on_report("a", 2, {"loss": 1.0})
+    # clearly worse at the same rung -> stopped
+    assert s.on_report("b", 2, {"loss": 5.0})
+    # better than the cutoff -> continues
+    assert not s.on_report("c", 2, {"loss": 0.5})
+    # mode="max" flips the comparison
+    smax = ASHAScheduler(metric="auc", mode="max", grace_rounds=2, eta=2)
+    assert not smax.on_report("a", 2, {"auc": 0.9})
+    assert smax.on_report("b", 2, {"auc": 0.2})
+
+
+def test_median_stopping_rule_unit():
+    from xgboost_ray_tpu.tuner import MedianStoppingRule
+
+    s = MedianStoppingRule(metric="loss", mode="min", grace_rounds=3,
+                           min_trials=2)
+    # trial a: good curve, full history
+    for i, v in enumerate([1.0, 0.8, 0.6, 0.5], start=1):
+        assert not s.on_report("a", i, {"loss": v})
+    # trial b: within grace -> never stopped, even though it's worse
+    assert not s.on_report("b", 1, {"loss": 2.0})
+    assert not s.on_report("b", 2, {"loss": 1.9})
+    # past grace and worse than a's running best median -> stopped
+    assert s.on_report("b", 3, {"loss": 1.8})
+
+
+def test_tuner_asha_stops_bad_trial_early(tmp_path, xy):
+    """End-to-end: a clearly-worse config is terminated at a rung while the
+    good config runs to completion (the Ray-Tune-scheduler capability,
+    standalone)."""
+    from xgboost_ray_tpu.tuner import ASHAScheduler
+
+    x, y = xy
+    rounds = 12
+
+    def trainable(config):
+        train(
+            {"objective": "binary:logistic", "eval_metric": ["logloss"],
+             "max_depth": 3, "eta": config["eta"], "seed": 0},
+            RayDMatrix(x, y), rounds,
+            evals=[(RayDMatrix(x, y), "train")],
+            ray_params=RayParams(num_actors=2, checkpoint_frequency=0),
+        )
+
+    tuner = Tuner(
+        trainable,
+        {"eta": grid_search([0.5, 1e-6])},  # good, then hopeless
+        metric="train-logloss", mode="min",
+        experiment_dir=str(tmp_path),
+        scheduler=ASHAScheduler(metric="train-logloss", mode="min",
+                                grace_rounds=3, eta=2),
+    )
+    result = tuner.fit()
+    good, bad = result.trials
+    assert not good.stopped_early
+    assert len(good.results) == rounds
+    assert bad.stopped_early
+    assert len(bad.results) < rounds
+    best = result.get_best_trial()
+    assert best.config["eta"] == 0.5

@@ -55,11 +55,31 @@ class TuneSession:
         # the driver hands it to TpuEngine so concurrent trials map onto
         # disjoint mesh slices
         self.devices = list(devices) if devices is not None else None
+        # trial scheduler hook (tuner.ASHAScheduler / MedianStoppingRule):
+        # consulted on every report; True stops the trial's training loop
+        # (the Ray Tune scheduler role, which the reference delegates to Ray)
+        self.scheduler = None
+        self.trial_id: Optional[str] = None
+        self.stopped_by_scheduler = False
 
-    def report(self, metrics: Dict[str, Any], checkpoint_path: Optional[str] = None):
+    def report(self, metrics: Dict[str, Any], checkpoint_path: Optional[str] = None) -> bool:
+        """Record a result; returns True when the attached scheduler decides
+        the trial should stop early."""
         self.results.append(dict(metrics))
         if checkpoint_path:
             self.last_checkpoint_path = checkpoint_path
+        if self.scheduler is not None:
+            stop = bool(
+                self.scheduler.on_report(
+                    self.trial_id or "trial",
+                    int(metrics.get("training_iteration", len(self.results))),
+                    metrics,
+                )
+            )
+            if stop:
+                self.stopped_by_scheduler = True
+            return stop
+        return False
 
 
 def init_session(trial_dir: Optional[str] = None, devices=None) -> TuneSession:
@@ -141,8 +161,9 @@ class TuneReportCheckpointCallback(TrainingCallback):
             )
             os.makedirs(checkpoint_path, exist_ok=True)
             model.save_model(os.path.join(checkpoint_path, self._filename))
-        session.report(report, checkpoint_path=checkpoint_path)
-        return False
+        # the report's return is the scheduler's stop decision: returning
+        # True from after_iteration stops this trial's training loop
+        return session.report(report, checkpoint_path=checkpoint_path)
 
 
 # legacy alias (reference exports TuneReportCallback too)

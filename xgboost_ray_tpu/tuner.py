@@ -83,6 +83,102 @@ def _expand_space(space: Dict[str, Any], num_samples: int, seed: int) -> List[Di
     return configs
 
 
+# --- trial schedulers --------------------------------------------------------
+# The reference gets early trial termination from Ray Tune's schedulers
+# (ASHAScheduler / MedianStoppingRule); these are the standalone equivalents.
+# A scheduler's on_report(trial_id, iteration, metrics) is consulted at every
+# per-round report (tune.TuneSession.report) and returning True stops that
+# trial's training loop. Thread-safe: concurrent trials share one instance.
+
+
+class ASHAScheduler:
+    """Asynchronous Successive Halving: at rungs ``grace * eta^k`` a trial
+    continues only if its metric is in the top ``1/eta`` of values recorded
+    at that rung so far (async — no waiting for full brackets)."""
+
+    def __init__(self, metric: str, mode: str = "min", grace_rounds: int = 5,
+                 eta: int = 3, max_rounds: int = 10_000):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")
+        self.metric = metric
+        self.mode = mode
+        self.eta = max(2, int(eta))
+        self.rungs: List[int] = []
+        r = max(1, int(grace_rounds))
+        while r <= max_rounds:
+            self.rungs.append(r)
+            r *= self.eta
+        import threading
+
+        self._lock = threading.Lock()
+        self._rung_values: Dict[int, List[float]] = {r: [] for r in self.rungs}
+
+    def on_report(self, trial_id: str, iteration: int, metrics: Dict[str, Any]) -> bool:
+        import math
+
+        value = metrics.get(self.metric)
+        if value is None or iteration not in self._rung_values:
+            return False
+        if math.isnan(float(value)):
+            # a diverged trial is the scheduler's primary target: stop it,
+            # and keep NaN out of the rung statistics
+            return True
+        v = float(value) if self.mode == "min" else -float(value)
+        with self._lock:
+            vals = self._rung_values[iteration]
+            vals.append(v)
+            vals.sort()
+            k = max(1, len(vals) // self.eta)
+            cutoff = vals[k - 1]
+        return v > cutoff  # outside the top 1/eta at this rung -> stop
+
+
+class MedianStoppingRule:
+    """Stop a trial whose best-so-far is worse than the median of the other
+    trials' best-so-far at the same iteration (after a grace period)."""
+
+    def __init__(self, metric: str, mode: str = "min", grace_rounds: int = 5,
+                 min_trials: int = 3):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")
+        self.metric = metric
+        self.mode = mode
+        self.grace_rounds = max(1, int(grace_rounds))
+        self.min_trials = max(2, int(min_trials))
+        import threading
+
+        self._lock = threading.Lock()
+        # trial_id -> {iteration: value} (keyed by the REPORTED iteration, so
+        # extra/skipped manual reports cannot misalign the comparison)
+        self._histories: Dict[str, Dict[int, float]] = {}
+
+    def on_report(self, trial_id: str, iteration: int, metrics: Dict[str, Any]) -> bool:
+        import math
+        import statistics
+
+        value = metrics.get(self.metric)
+        if value is None:
+            return False
+        if math.isnan(float(value)):
+            return iteration >= self.grace_rounds  # diverged -> stop past grace
+        v = float(value) if self.mode == "min" else -float(value)
+        with self._lock:
+            hist = self._histories.setdefault(trial_id, {})
+            hist[iteration] = v
+            if iteration < self.grace_rounds:
+                return False
+            others = [
+                min(val for it, val in h.items() if it <= iteration)
+                for tid, h in self._histories.items()
+                if tid != trial_id and any(it >= iteration for it in h)
+            ]
+            if len(others) + 1 < self.min_trials:
+                return False
+            med = statistics.median(others)
+            best = min(hist.values())
+        return best > med
+
+
 # --- trial execution ---------------------------------------------------------
 
 
@@ -95,6 +191,7 @@ class Trial:
     checkpoint_path: Optional[str] = None
     error: Optional[str] = None
     trial_dir: str = ""
+    stopped_early: bool = False  # terminated by the trial scheduler
 
 
 @dataclasses.dataclass
@@ -150,6 +247,7 @@ class Tuner:
         experiment_dir: Optional[str] = None,
         raise_on_failed_trial: bool = False,
         max_concurrent_trials: int = 1,
+        scheduler=None,
     ):
         if mode not in ("min", "max"):
             raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
@@ -162,6 +260,9 @@ class Tuner:
         self.experiment_dir = experiment_dir or tempfile.mkdtemp(prefix="rxgb_exp_")
         self.raise_on_failed_trial = raise_on_failed_trial
         self.max_concurrent_trials = max(1, int(max_concurrent_trials))
+        # ASHAScheduler / MedianStoppingRule (or any on_report duck type):
+        # early-terminates unpromising trials — the Ray Tune scheduler role
+        self.scheduler = scheduler
 
     def _run_trial(self, i: int, config: Dict[str, Any], devices=None) -> Trial:
         trial_id = f"trial_{i:05d}"
@@ -169,11 +270,14 @@ class Tuner:
         os.makedirs(trial_dir, exist_ok=True)
         trial = Trial(trial_id=trial_id, config=config, trial_dir=trial_dir)
         session = tune_mod.init_session(trial_dir, devices=devices)
+        session.scheduler = self.scheduler
+        session.trial_id = trial_id
         try:
             self.trainable(config)
             trial.results = session.results
             trial.last_result = session.results[-1] if session.results else None
             trial.checkpoint_path = session.last_checkpoint_path
+            trial.stopped_early = session.stopped_by_scheduler
         except Exception as exc:  # noqa: BLE001 - trial isolation
             trial.error = f"{type(exc).__name__}: {exc}"
             logger.warning(f"[Tuner] {trial_id} failed: {trial.error}")
