@@ -34,12 +34,18 @@ def main():
         "subsample": 0.8,
         "max_depth": grid_search([3, 4, 5]),
     }
+    from xgboost_ray_tpu.tuner import ASHAScheduler
+
     tuner = Tuner(
         train_model,
         search_space,
         metric="train-error",
         mode="min",
         num_samples=2,
+        # terminate unpromising trials at successive-halving rungs (the Ray
+        # Tune ASHAScheduler role, standalone)
+        scheduler=ASHAScheduler(metric="train-error", mode="min",
+                                grace_rounds=4),
     )
     result = tuner.fit()
     best = result.get_best_trial()
