@@ -316,3 +316,30 @@ def test_rfecv_integration():
     assert sel.n_features_ >= 2
     # the informative features survive elimination
     assert sel.support_[0] and sel.support_[1]
+
+
+def test_dmatrix_params_through_fit():
+    """Reference: test_binary_classification_dmatrix_params — RayDMatrix
+    construction args (sharding mode, missing sentinel) flow through
+    fit(ray_dmatrix_params=...)."""
+    from xgboost_ray_tpu.matrix import RayShardingMode
+
+    rng = np.random.RandomState(5)
+    x = rng.randn(200, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    # encode some values with a -999 missing sentinel
+    x_sent = x.copy()
+    x_sent[x_sent[:, 1] > 1.2, 1] = -999.0
+    clf = RayXGBClassifier(n_estimators=6, max_depth=3, random_state=0)
+    clf.fit(x_sent, y, ray_params=_RP,
+            ray_dmatrix_params={"sharding": RayShardingMode.BATCH,
+                                "missing": -999.0})
+    # equivalent to NaN-encoded missing under default sharding
+    x_nan = x.copy()
+    x_nan[x[:, 1] > 1.2, 1] = np.nan
+    clf2 = RayXGBClassifier(n_estimators=6, max_depth=3, random_state=0)
+    clf2.fit(x_nan, y, ray_params=_RP)
+    np.testing.assert_allclose(
+        clf.predict_proba(x_nan, ray_params=_RP),
+        clf2.predict_proba(x_nan, ray_params=_RP), atol=1e-5,
+    )
