@@ -278,3 +278,22 @@ def test_tuner_asha_stops_bad_trial_early(tmp_path, xy):
     assert len(bad.results) < rounds
     best = result.get_best_trial()
     assert best.config["eta"] == 0.5
+
+
+def test_median_stopping_rule_sparse_peer_histories():
+    """ADVICE r4: a peer whose history holds only LATER iterations than the
+    current report (manual/skipped-report pattern) must not crash the inner
+    min() — it is simply not comparable at this iteration."""
+    from xgboost_ray_tpu.tuner import MedianStoppingRule
+
+    s = MedianStoppingRule(metric="loss", mode="min", grace_rounds=1,
+                           min_trials=2)
+    # peer 'a' reports ONLY at iteration 10 (manual reporting)
+    assert not s.on_report("a", 10, {"loss": 0.1})
+    # trial 'b' reports at iteration 5: 'a' has entries >= 5 but none <= 5;
+    # previously this raised ValueError (min of empty sequence) out of
+    # session.report and failed the trial
+    assert not s.on_report("b", 5, {"loss": 9.9})
+    # once 'a' has a comparable early entry, the rule stops 'b' again
+    assert not s.on_report("a", 3, {"loss": 0.2})
+    assert s.on_report("b", 6, {"loss": 9.8})

@@ -230,3 +230,56 @@ def test_get_dump_json_format():
             assert leaves >= 2
     with pytest.raises(ValueError, match="dump_format"):
         bst.get_dump(dump_format="dot")
+
+
+def _xgb_core_margin(doc, x):
+    """Emulate real xgboost core prediction on an exported JSON doc: walk
+    every tree (go left iff x < split_condition, missing -> default_left)
+    and SUM all leaf values — the sum convention of xgboost's predictor,
+    which does not divide by num_parallel_tree. Used to pin the interop
+    leaf-scaling convention without xgboost in the image."""
+    model = doc["learner"]["gradient_booster"]["model"]
+    out = np.zeros(len(x), np.float64)
+    for t in model["trees"]:
+        left, right = t["left_children"], t["right_children"]
+        cond, feat = t["split_conditions"], t["split_indices"]
+        dleft = t["default_left"]
+        for r, row in enumerate(x):
+            nid = 0
+            while left[nid] != -1:
+                v = row[feat[nid]]
+                if np.isnan(v):
+                    nid = left[nid] if dleft[nid] else right[nid]
+                else:
+                    nid = left[nid] if v < cond[nid] else right[nid]
+            out[r] += cond[nid]
+    return out
+
+
+def test_num_parallel_tree_sum_convention_parity(tmp_path):
+    """npt>1 interop (ADVICE r4): our predictor AVERAGES each round's
+    num_parallel_tree trees while xgboost core SUMS every tree, so export
+    must fold 1/npt into the stored leaves (and import must multiply back).
+    Checked against a hand-rolled sum-convention walker standing in for the
+    real xgboost predictor."""
+    rng = np.random.RandomState(7)
+    x = rng.randn(200, 4).astype(np.float32)
+    y = (x[:, 0] - 0.5 * x[:, 2] > 0).astype(np.float32)
+    bst = train({"objective": "reg:squarederror", "max_depth": 3, "eta": 0.3,
+                 "num_parallel_tree": 3, "subsample": 0.8, "seed": 0},
+                RayDMatrix(x, y), 4, ray_params=RP)
+    doc = json.loads(bst.export_xgboost_json())
+    model = doc["learner"]["gradient_booster"]["model"]
+    assert int(model["gbtree_model_param"]["num_parallel_tree"]) == 3
+    assert len(model["trees"]) == 12
+    # what real xgboost would predict from the file == our margin
+    ours = bst.predict(x, output_margin=True)
+    theirs = _xgb_core_margin(doc, x) + bst.base_score_margin_np()
+    np.testing.assert_allclose(theirs, ours, atol=1e-4)
+    # and the round trip through the file preserves our predictions
+    path = str(tmp_path / "npt.xgb.json")
+    bst.export_xgboost_json(path)
+    back = RayXGBoostBooster.import_xgboost_json(path)
+    assert back.params.num_parallel_tree == 3
+    np.testing.assert_allclose(
+        back.predict(x, output_margin=True), ours, atol=1e-4)

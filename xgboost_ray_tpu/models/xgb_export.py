@@ -25,12 +25,21 @@ _INT_MAX = 2147483647
 
 
 def _tree_to_xgb(tree_np, t_id: int, num_feature: int,
-                 learning_rate: float = 1.0) -> Dict[str, Any]:
+                 learning_rate: float = 1.0,
+                 leaf_scale: float = 1.0) -> Dict[str, Any]:
     """One padded-heap tree -> xgboost compact node-array dict (BFS ids).
 
     ``base_weights`` convention: xgboost stores PRE-learning-rate node
     weights (leaf value = eta * base_weight); this repo's Tree.base_weight is
-    lr-scaled, so export divides by ``learning_rate``."""
+    lr-scaled, so export divides by ``learning_rate``.
+
+    ``leaf_scale`` folds the num_parallel_tree averaging into the stored
+    values: xgboost core SUMS every tree's leaf, while this repo's predictor
+    averages the ``num_parallel_tree`` trees of a round
+    (``ops/predict.py``), so export writes ``value / npt`` (and import
+    multiplies back). Scaling value and base_weight together keeps the
+    leaf value/weight ratio — and hence the importer's eta recovery —
+    intact."""
     feature = np.asarray(tree_np.feature)
     threshold = np.asarray(tree_np.threshold)
     default_left = np.asarray(tree_np.default_left)
@@ -72,11 +81,11 @@ def _tree_to_xgb(tree_np, t_id: int, num_feature: int,
             left.append(-1)
             right.append(-1)
             split_idx.append(0)
-            split_cond.append(float(value[h]))  # leaf value lives here
+            split_cond.append(float(value[h]) * leaf_scale)  # leaf value lives here
             dleft.append(0)
             losses.append(0.0)
         hess.append(float(cover[h]))
-        bw.append(float(base_weight[h]) / max(learning_rate, 1e-12))
+        bw.append(float(base_weight[h]) * leaf_scale / max(learning_rate, 1e-12))
         if h == 0:
             parents.append(_INT_MAX)
         else:
@@ -142,7 +151,8 @@ def export_xgboost_json(booster, fname: Optional[str] = None) -> str:
     tree_info = []
     for t in range(n_trees):
         tree_np = type(forest)(*[np.asarray(f)[t] for f in forest])
-        trees.append(_tree_to_xgb(tree_np, t, num_feature, learning_rate=lr))
+        trees.append(_tree_to_xgb(tree_np, t, num_feature, learning_rate=lr,
+                                  leaf_scale=1.0 / npt))
         tree_info.append((t % per_round) // npt if k > 1 else 0)
 
     rounds = max(1, n_trees // per_round)
@@ -205,8 +215,14 @@ def export_xgboost_json(booster, fname: Optional[str] = None) -> str:
     return out
 
 
-def _xgb_tree_to_heap(t: Dict[str, Any]) -> Tuple[Dict[str, np.ndarray], int]:
-    """One xgboost node-array tree -> padded-heap field dict + depth."""
+def _xgb_tree_to_heap(t: Dict[str, Any],
+                      leaf_scale: float = 1.0) -> Tuple[Dict[str, np.ndarray], int]:
+    """One xgboost node-array tree -> padded-heap field dict + depth.
+
+    ``leaf_scale`` is ``num_parallel_tree`` on import: xgboost files store
+    sum-convention leaves (core sums all trees), while this repo's predictor
+    divides each round's trees by npt — multiplying the stored values back
+    up makes both conventions produce the same margin."""
     left = t["left_children"]
     right = t["right_children"]
     n = len(left)
@@ -268,12 +284,12 @@ def _xgb_tree_to_heap(t: Dict[str, Any]) -> Tuple[Dict[str, np.ndarray], int]:
     while stack:
         nid, h = stack.pop()
         fields["cover"][h] = sh[nid]
-        fields["base_weight"][h] = bw[nid] * eta_scale
+        fields["base_weight"][h] = bw[nid] * eta_scale * leaf_scale
         if left[nid] == -1:
             fields["is_leaf"][h] = True
-            fields["value"][h] = sc[nid]
+            fields["value"][h] = sc[nid] * leaf_scale
             # exact convention: base_weight equals the leaf value at leaves
-            fields["base_weight"][h] = sc[nid]
+            fields["base_weight"][h] = sc[nid] * leaf_scale
         else:
             fields["feature"][h] = si[nid]
             fields["threshold"][h] = sc[nid]
@@ -316,7 +332,9 @@ def import_xgboost_json(data) -> "RayXGBoostBooster":
             "splits are supported by the importer."
         )
 
-    per_tree = [_xgb_tree_to_heap(t) for t in trees_json]
+    npt = max(1, int(
+        model.get("gbtree_model_param", {}).get("num_parallel_tree", "1") or 1))
+    per_tree = [_xgb_tree_to_heap(t, leaf_scale=float(npt)) for t in trees_json]
     max_depth = max((d for _, d in per_tree), default=1)
     max_depth = max(max_depth, 1)
     heap = (1 << (max_depth + 1)) - 1
@@ -351,7 +369,6 @@ def import_xgboost_json(data) -> "RayXGBoostBooster":
     params.objective = obj
     params.num_class = int(lmp.get("num_class", "0") or 0)
     params.max_depth = max_depth
-    npt = int(model.get("gbtree_model_param", {}).get("num_parallel_tree", "1") or 1)
     params.num_parallel_tree = npt
     if weight_drop is not None:
         params.booster = "dart"

@@ -167,15 +167,23 @@ class MedianStoppingRule:
             hist[iteration] = v
             if iteration < self.grace_rounds:
                 return False
-            others = [
-                min(val for it, val in h.items() if it <= iteration)
-                for tid, h in self._histories.items()
-                if tid != trial_id and any(it >= iteration for it in h)
-            ]
+            # peers must have progressed at least this far (>=) AND have at
+            # least one report at it <= iteration — a manual/skipped report
+            # pattern can otherwise leave the inner min() with no entries
+            others = []
+            for tid, h in self._histories.items():
+                if tid == trial_id or not any(it >= iteration for it in h):
+                    continue
+                vals = [val for it, val in h.items() if it <= iteration]
+                if vals:
+                    others.append(min(vals))
             if len(others) + 1 < self.min_trials:
                 return False
             med = statistics.median(others)
-            best = min(hist.values())
+            # symmetric window: judge the trial on the same it <= iteration
+            # range its peers are reduced over (manual reports can arrive
+            # out of order)
+            best = min(val for it, val in hist.items() if it <= iteration)
         return best > med
 
 
