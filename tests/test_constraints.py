@@ -1,0 +1,213 @@
+"""Monotone and interaction constraint tests.
+
+The reference gets both constraints by forwarding the params dict to
+xgboost's hist updater untouched (``xgboost_ray/main.py:745-752``); here they
+are re-implemented inside the split scan (``ops/split.py`` bound-clamped
+gains, ``ops/grow.py`` bound/allowed-set propagation), so these tests pin
+the SEMANTICS: constrained models are actually monotone on adversarial
+data, interaction-constrained trees never mix features across groups, and
+the multi-actor model identity the engine guarantees elsewhere still holds.
+"""
+
+import numpy as np
+import pytest
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+RP1 = RayParams(num_actors=1)
+RP2 = RayParams(num_actors=2)
+
+
+def _wiggle_data(seed=0, n=600):
+    """y rises with x0 overall but has a strong LOCAL DIP (adversarial
+    non-monotone signal) + a second informative feature."""
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(-2, 2, size=(n, 3)).astype(np.float32)
+    dip = -1.6 * np.exp(-4.0 * (x[:, 0] - 0.5) ** 2)  # local reversal
+    y = (0.8 * x[:, 0] + dip + 0.5 * x[:, 1]
+         + 0.05 * rng.randn(n)).astype(np.float32)
+    return x, y
+
+
+def _grid_margins(bst, f, lo=-2, hi=2, k=64, bases=3, seed=1):
+    """Margins along a grid in feature f with the other features frozen at a
+    few random base rows -> [bases, k]."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(bases):
+        base = rng.uniform(-2, 2, size=(3,)).astype(np.float32)
+        g = np.tile(base, (k, 1))
+        g[:, f] = np.linspace(lo, hi, k, dtype=np.float32)
+        out.append(bst.predict(g, output_margin=True))
+    return np.stack(out)
+
+
+def _path_feature_sets(bst):
+    """Distinct feature sets along every root->leaf path of every tree."""
+    feat = np.asarray(bst.forest.feature)
+    leaf = np.asarray(bst.forest.is_leaf)
+    heap = feat.shape[1]
+    sets = []
+    for t in range(feat.shape[0]):
+        stack = [(0, frozenset())]
+        while stack:
+            h, used = stack.pop()
+            if leaf[t, h] or feat[t, h] < 0 or 2 * h + 2 >= heap:
+                if used:
+                    sets.append(used)
+                continue
+            u2 = used | {int(feat[t, h])}
+            stack.append((2 * h + 1, u2))
+            stack.append((2 * h + 2, u2))
+    return sets
+
+
+def test_unconstrained_model_is_not_monotone():
+    """Sanity: the dip is strong enough that a free model learns it."""
+    x, y = _wiggle_data()
+    bst = train({"objective": "reg:squarederror", "max_depth": 4, "eta": 0.3,
+                 "seed": 0}, RayDMatrix(x, y), 20, ray_params=RP1)
+    grids = _grid_margins(bst, 0)
+    diffs = np.diff(grids, axis=1)
+    assert diffs.min() < -0.05  # clearly decreasing somewhere
+
+
+@pytest.mark.parametrize("sign", [1, -1])
+def test_monotone_constraint_enforced(sign):
+    x, y = _wiggle_data()
+    if sign < 0:
+        y = -y
+    bst = train({"objective": "reg:squarederror", "max_depth": 4, "eta": 0.3,
+                 "monotone_constraints": f"({sign},0,0)", "seed": 0},
+                RayDMatrix(x, y), 20, ray_params=RP2)
+    grids = _grid_margins(bst, 0)
+    diffs = np.diff(grids, axis=1) * sign
+    assert diffs.min() >= -1e-4, diffs.min()
+    # the constrained model still learns the global trend + free features
+    pred = bst.predict(x)
+    base = np.full_like(y, y.mean())
+    assert np.mean((pred - y) ** 2) < 0.5 * np.mean((base - y) ** 2)
+
+
+def test_monotone_string_and_tuple_forms_agree():
+    x, y = _wiggle_data(seed=3)
+    kw = {"objective": "reg:squarederror", "max_depth": 3, "eta": 0.4,
+          "seed": 0}
+    a = train(dict(kw, monotone_constraints="(1,0,0)"), RayDMatrix(x, y), 6,
+              ray_params=RP1)
+    b = train(dict(kw, monotone_constraints=[1, 0, 0]), RayDMatrix(x, y), 6,
+              ray_params=RP1)
+    np.testing.assert_allclose(a.predict(x), b.predict(x), atol=0)
+    # short tuples pad with 0 (xgboost behavior)
+    c = train(dict(kw, monotone_constraints=(1,)), RayDMatrix(x, y), 6,
+              ray_params=RP1)
+    np.testing.assert_allclose(a.predict(x), c.predict(x), atol=0)
+
+
+def test_monotone_multi_actor_model_identity():
+    """Bound propagation rides allreduced histograms only -> sharding must
+    not change the model (the engine's world-size invariance)."""
+    x, y = _wiggle_data(seed=4)
+    kw = {"objective": "reg:squarederror", "max_depth": 4, "eta": 0.3,
+          "monotone_constraints": "(1,-1,0)", "seed": 0}
+    a = train(kw, RayDMatrix(x, y), 8, ray_params=RP1)
+    b = train(kw, RayDMatrix(x, y), 8, ray_params=RP2)
+    # STRUCTURE is bit-identical across shardings; the float stat fields
+    # (gain/cover) carry psum merge-order float32 noise, so they get rtol
+    fa, fb = a.forest, b.forest
+    for field in ("feature", "split_bin", "is_leaf", "default_left"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fa, field)), np.asarray(getattr(fb, field)),
+            err_msg=field,
+        )
+    for field in ("threshold", "value", "base_weight"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(fa, field)), np.asarray(getattr(fb, field)),
+            atol=1e-5, err_msg=field,
+        )
+    for field in ("gain", "cover"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(fa, field)), np.asarray(getattr(fb, field)),
+            rtol=1e-4, atol=1e-4, err_msg=field,
+        )
+    np.testing.assert_allclose(a.predict(x), b.predict(x), atol=1e-5)
+
+
+def test_interaction_constraints_respected():
+    """Groups ((0,1),(2,3),(4,)): every root->leaf path must keep its
+    features inside ONE group (xgboost's cumulative active-set semantics)."""
+    rng = np.random.RandomState(5)
+    n = 800
+    x = rng.uniform(-1, 1, size=(n, 5)).astype(np.float32)
+    # cross-group products make violations profitable for a free model
+    y = (x[:, 0] * x[:, 2] + x[:, 1] * x[:, 4] + 0.5 * x[:, 3]
+         + 0.02 * rng.randn(n)).astype(np.float32)
+    groups = [[0, 1], [2, 3], [4]]
+    bst = train({"objective": "reg:squarederror", "max_depth": 5, "eta": 0.3,
+                 "interaction_constraints": groups, "seed": 0},
+                RayDMatrix(x, y), 15, ray_params=RP2)
+    gsets = [frozenset(g) for g in groups]
+    for path in _path_feature_sets(bst):
+        assert any(path <= g for g in gsets), f"path {set(path)} crosses groups"
+    # sanity: the free model DOES cross groups on this signal
+    free = train({"objective": "reg:squarederror", "max_depth": 5,
+                  "eta": 0.3, "seed": 0}, RayDMatrix(x, y), 15,
+                 ray_params=RP2)
+    assert any(
+        not any(path <= g for g in gsets) for path in _path_feature_sets(free)
+    )
+
+
+def test_interaction_string_form_and_identity():
+    rng = np.random.RandomState(6)
+    x = rng.uniform(-1, 1, size=(400, 4)).astype(np.float32)
+    y = (x[:, 0] * x[:, 1] + x[:, 2] + 0.02 * rng.randn(400)).astype(np.float32)
+    kw = {"objective": "reg:squarederror", "max_depth": 4, "eta": 0.4,
+          "seed": 0}
+    a = train(dict(kw, interaction_constraints="[[0, 1], [2, 3]]"),
+              RayDMatrix(x, y), 6, ray_params=RP1)
+    b = train(dict(kw, interaction_constraints=((0, 1), (2, 3))),
+              RayDMatrix(x, y), 6, ray_params=RP2)
+    for fa, fb in zip(a.forest, b.forest):
+        np.testing.assert_allclose(np.asarray(fa), np.asarray(fb), atol=1e-5)
+
+
+def test_monotone_and_interaction_combined():
+    x, y = _wiggle_data(seed=7)
+    bst = train({"objective": "reg:squarederror", "max_depth": 4, "eta": 0.3,
+                 "monotone_constraints": "(1,0,0)",
+                 "interaction_constraints": [[0, 1], [2]], "seed": 0},
+                RayDMatrix(x, y), 12, ray_params=RP2)
+    diffs = np.diff(_grid_margins(bst, 0), axis=1)
+    assert diffs.min() >= -1e-4
+    gsets = [frozenset(g) for g in [[0, 1], [2]]]
+    for path in _path_feature_sets(bst):
+        assert any(path <= g for g in gsets)
+
+
+def test_constraint_validation_errors():
+    x = np.random.RandomState(0).randn(50, 3).astype(np.float32)
+    y = x[:, 0].astype(np.float32)
+    with pytest.raises(ValueError, match="-1, 0, or"):
+        train({"objective": "reg:squarederror",
+               "monotone_constraints": "(2,0)"}, RayDMatrix(x, y), 1,
+              ray_params=RP1)
+    with pytest.raises(ValueError, match="dict-form"):
+        train({"objective": "reg:squarederror",
+               "monotone_constraints": {"f0": 1}}, RayDMatrix(x, y), 1,
+              ray_params=RP1)
+    with pytest.raises(ValueError, match="entries but the data"):
+        train({"objective": "reg:squarederror",
+               "monotone_constraints": "(1,0,0,0)"}, RayDMatrix(x, y), 1,
+              ray_params=RP1)
+    with pytest.raises(ValueError, match="feature indices"):
+        train({"objective": "reg:squarederror",
+               "interaction_constraints": [[0, 7]]}, RayDMatrix(x, y), 1,
+              ray_params=RP1)
+    xc = x.copy()
+    xc[:, 2] = np.random.RandomState(1).randint(0, 4, 50)  # valid cat codes
+    with pytest.raises(ValueError, match="no order to be monotone"):
+        train({"objective": "reg:squarederror",
+               "monotone_constraints": "(0,0,1)"},
+              RayDMatrix(xc, y, feature_types=["q", "q", "c"]), 1,
+              ray_params=RP1)

@@ -211,6 +211,15 @@ class TpuEngine:
             sibling_subtract=params.sibling_subtract,
             cat_features=self._cat_features,
             shards_may_skew=self.n_devices > 1 or jax.process_count() > 1,
+            grow_policy=params.grow_policy,
+            # leaf budget: 0 means depth-bounded only; a budget beyond
+            # 2^max_depth is unreachable, so cap it (keeps the frontier
+            # table minimal)
+            max_leaves=(
+                min(params.max_leaves or (1 << params.max_depth),
+                    1 << params.max_depth)
+                if params.grow_policy == "lossguide" else 0
+            ),
         )
 
         # metrics (device/host split happens after eval sets exist — ndcg/map
@@ -243,6 +252,41 @@ class TpuEngine:
                     f"[0, {params.max_bin - 2}] (max_bin={params.max_bin}); "
                     f"raise max_bin or re-encode the column."
                 )
+
+        # monotone / interaction constraints: validated against the real
+        # feature count, then attached to the (jit-static) grow config.
+        # Reference surface: xgboost_ray/main.py:745-752 forwards both to
+        # xgboost's hist updater untouched.
+        if params.monotone_constraints or params.interaction_constraints:
+            import dataclasses as _dc
+
+            mono = tuple(int(c) for c in params.monotone_constraints)
+            if len(mono) > self.n_features:
+                raise ValueError(
+                    f"monotone_constraints has {len(mono)} entries but the "
+                    f"data has {self.n_features} features."
+                )
+            mono = mono + (0,) * (self.n_features - len(mono))
+            for fi in self._cat_features:
+                if mono and mono[fi] != 0:
+                    raise ValueError(
+                        f"monotone constraint on categorical feature {fi} is "
+                        f"not supported (one-vs-rest category splits have no "
+                        f"order to be monotone in)."
+                    )
+            ic = params.interaction_constraints
+            bad = [i for grp in ic for i in grp if i >= self.n_features]
+            if bad:
+                raise ValueError(
+                    f"interaction_constraints reference feature indices "
+                    f"{sorted(set(bad))} but the data has "
+                    f"{self.n_features} features."
+                )
+            self.cfg = _dc.replace(
+                self.cfg,
+                monotone_constraints=mono if any(mono) else (),
+                interaction_constraints=ic,
+            )
 
         # feature_weights bias the colsample_* draws (Gumbel-top-k weighted
         # sampling without replacement; xgboost set_info(feature_weights=...))

@@ -30,7 +30,12 @@ from xgboost_ray_tpu.ops.histogram import (
     node_sums,
     update_partition_order,
 )
-from xgboost_ray_tpu.ops.split import SplitParams, find_splits, leaf_weight
+from xgboost_ray_tpu.ops.split import (
+    SplitParams,
+    bounded_weight,
+    find_splits,
+    leaf_weight,
+)
 
 # Disjoint fold_in domains for the per-tree sampling mechanisms, so row
 # subsampling and the three column-sampling masks never draw from overlapping
@@ -110,6 +115,19 @@ class GrowConfig:
     # training sets False — the selection provably fits, and skipping the
     # cond halves the per-level histogram code to compile.
     shards_may_skew: bool = True
+    # per-feature monotone constraints (len == F, values -1/0/+1) or () —
+    # xgboost's monotone_constraints via per-node weight-bound propagation
+    # (reference passthrough surface: xgboost_ray/main.py:745-752)
+    monotone_constraints: tuple = ()
+    # tuple of feature-index groups; a node may only split on features that
+    # share a constraint set with every feature used on its root path
+    # (xgboost's interaction_constraints semantics)
+    interaction_constraints: tuple = ()
+    # "depthwise" (level-wise, this module) or "lossguide" (best-first,
+    # ops/grow_lossguide.py — the LightGBM growth strategy)
+    grow_policy: str = "depthwise"
+    # leaf budget for lossguide (resolved by the engine: 0 -> 2^max_depth)
+    max_leaves: int = 0
 
     @property
     def heap_size(self) -> int:
@@ -162,6 +180,17 @@ def build_tree(
     """Grow one tree. Returns (Tree, row_value[N]) — row_value is the leaf
     value each row receives (learning-rate scaled), used to update margins
     without re-walking the tree."""
+    if cfg.grow_policy == "lossguide":
+        from xgboost_ray_tpu.ops.grow_lossguide import build_tree_lossguide
+
+        # engine validation guarantees the unsupported-combination params
+        # (bylevel/bynode sampling, constraints) never reach this point
+        return build_tree_lossguide(
+            bins, gh, cuts, cfg,
+            feature_mask=feature_mask,
+            allreduce=allreduce,
+            feat_has_missing=feat_has_missing,
+        )
     n, num_features = bins.shape
     nbt = cfg.max_bin + 1
     lr = cfg.split.learning_rate
@@ -174,6 +203,36 @@ def build_tree(
     done = jnp.zeros((n,), bool)
     row_value = jnp.zeros((n,), jnp.float32)
     active = jnp.ones((1,), bool)
+
+    # monotone constraints: per-node feasible weight interval, narrowed at
+    # every constrained-feature split by the children's weight midpoint
+    # (xgboost hist's MonotonicConstraint propagation)
+    mono_on = any(int(c) != 0 for c in cfg.monotone_constraints)
+    mono_arr = lower = upper = None
+    if mono_on:
+        mc = list(cfg.monotone_constraints)[:num_features]
+        mc += [0] * (num_features - len(mc))
+        mono_arr = jnp.asarray(mc, jnp.float32)
+        lower = jnp.full((1,), -jnp.inf, jnp.float32)
+        upper = jnp.full((1,), jnp.inf, jnp.float32)
+
+    # interaction constraints: per-node set of still-active constraint
+    # groups (those containing every feature used on the root path); the
+    # allowed features are their union plus the path's own features
+    ic_on = len(cfg.interaction_constraints) > 0
+    if ic_on:
+        import numpy as _np
+
+        n_sets = len(cfg.interaction_constraints)
+        mem_np = _np.zeros((n_sets, num_features), bool)
+        for s, grp in enumerate(cfg.interaction_constraints):
+            for fi in grp:
+                if fi < num_features:
+                    mem_np[s, fi] = True
+        ic_membership = jnp.asarray(mem_np)  # [S, F]
+        ic_active = jnp.ones((1, n_sets), bool)
+        ic_used = jnp.zeros((1, num_features), bool)
+        ic_has_used = jnp.zeros((1,), bool)
 
     # partition-based impls keep rows sorted by node across levels with an
     # O(N) stable segment split (no per-level argsort)
@@ -378,10 +437,32 @@ def build_tree(
             )
             fmask = nmask if fmask is None else (nmask & fmask[None, :])
 
+        if ic_on:
+            # allowed = union of still-active groups + the path's features;
+            # a node that has not split yet (root) may use any feature
+            union_active = jnp.any(
+                ic_active[:, :, None] & ic_membership[None, :, :], axis=1
+            )  # [n_nodes, F]
+            allowed = jnp.where(
+                ic_has_used[:, None], union_active | ic_used, True
+            )
+            if fmask is None:
+                fmask = allowed
+            else:
+                fmask = (fmask[None, :] if fmask.ndim == 1 else fmask) & allowed
+
         sp = find_splits(hist, node_gh, cfg.split, feature_mask=fmask,
-                         cat_mask=cat_mask)
+                         cat_mask=cat_mask, monotone=mono_arr,
+                         node_lower=lower, node_upper=upper)
         valid_split = sp.valid & active
-        node_value = lr * leaf_weight(node_gh[:, 0], node_gh[:, 1], cfg.split)
+        if mono_on:
+            node_value = lr * bounded_weight(
+                node_gh[:, 0], node_gh[:, 1], cfg.split, lower, upper
+            )
+        else:
+            node_value = lr * leaf_weight(
+                node_gh[:, 0], node_gh[:, 1], cfg.split
+            )
         is_new_leaf = active & ~valid_split
 
         fsafe = jnp.clip(sp.feature, 0, num_features - 1)
@@ -420,11 +501,68 @@ def build_tree(
         if track_order:
             order, counts = update_partition_order(order, counts, effective_right)
 
+        if mono_on:
+            # Recompute the CHOSEN split's child weights (same clamped
+            # formula find_splits scored with) to narrow the children's
+            # feasible interval at the midpoint — xgboost's monotone bound
+            # propagation. O(n_nodes * bins), negligible next to the build.
+            hist_f = jnp.take_along_axis(
+                hist, fsafe[:, None, None, None], axis=1
+            )[:, 0]  # [n_nodes, nbt, 2]
+            gf, hf = hist_f[..., 0], hist_f[..., 1]
+            sbin_c = jnp.clip(sp.split_bin, 0, cfg.max_bin - 2)[:, None]
+            gl_c = jnp.take_along_axis(
+                jnp.cumsum(gf[:, : cfg.max_bin], axis=-1), sbin_c, axis=1
+            )[:, 0]
+            hl_c = jnp.take_along_axis(
+                jnp.cumsum(hf[:, : cfg.max_bin], axis=-1), sbin_c, axis=1
+            )[:, 0]
+            if cat_mask is not None:
+                is_cat = cat_mask[fsafe]
+                gl_c = jnp.where(
+                    is_cat, jnp.take_along_axis(gf, sbin_c, axis=1)[:, 0], gl_c
+                )
+                hl_c = jnp.where(
+                    is_cat, jnp.take_along_axis(hf, sbin_c, axis=1)[:, 0], hl_c
+                )
+            gl_c = jnp.where(sp.default_left, gl_c + gf[:, cfg.max_bin], gl_c)
+            hl_c = jnp.where(sp.default_left, hl_c + hf[:, cfg.max_bin], hl_c)
+            wl = bounded_weight(gl_c, hl_c, cfg.split, lower, upper)
+            wr = bounded_weight(
+                node_gh[:, 0] - gl_c, node_gh[:, 1] - hl_c, cfg.split,
+                lower, upper,
+            )
+            mid = 0.5 * (wl + wr)
+            c = jnp.where(valid_split, mono_arr[fsafe], 0.0)
+            lower_l = jnp.where(c < 0, jnp.maximum(lower, mid), lower)
+            upper_l = jnp.where(c > 0, jnp.minimum(upper, mid), upper)
+            lower_r = jnp.where(c > 0, jnp.maximum(lower, mid), lower)
+            upper_r = jnp.where(c < 0, jnp.minimum(upper, mid), upper)
+            lower = jnp.stack([lower_l, lower_r], axis=1).reshape(-1)
+            upper = jnp.stack([upper_l, upper_r], axis=1).reshape(-1)
+
+        if ic_on:
+            contains_f = ic_membership.T[fsafe]  # [n_nodes, S]
+            ic_active = jnp.where(
+                valid_split[:, None], ic_active & contains_f, ic_active
+            )
+            f_onehot = jnp.arange(num_features)[None, :] == fsafe[:, None]
+            ic_used = ic_used | (valid_split[:, None] & f_onehot)
+            ic_has_used = ic_has_used | valid_split
+            ic_active = jnp.repeat(ic_active, 2, axis=0)
+            ic_used = jnp.repeat(ic_used, 2, axis=0)
+            ic_has_used = jnp.repeat(ic_has_used, 2)
+
     # Final level: every still-active node is a leaf.
     n_nodes = 1 << cfg.max_depth
     base = n_nodes - 1
     node_gh = allreduce(node_sums(jnp.where(done[:, None], 0.0, gh), pos, n_nodes))
-    node_value = lr * leaf_weight(node_gh[:, 0], node_gh[:, 1], cfg.split)
+    if mono_on:
+        node_value = lr * bounded_weight(
+            node_gh[:, 0], node_gh[:, 1], cfg.split, lower, upper
+        )
+    else:
+        node_value = lr * leaf_weight(node_gh[:, 0], node_gh[:, 1], cfg.split)
     sl = slice(base, base + n_nodes)
     tree = tree._replace(
         is_leaf=tree.is_leaf.at[sl].set(active),

@@ -61,18 +61,42 @@ def leaf_weight(g, h, p: SplitParams):
     return w
 
 
+def bounded_weight(g, h, p: SplitParams, lower, upper):
+    """Leaf weight clamped to a node's feasible interval (monotone bounds)."""
+    return jnp.clip(leaf_weight(g, h, p), lower, upper)
+
+
+def score_given_weight(g, h, p: SplitParams, w):
+    """Objective reduction achieved by a (possibly bound-clamped) weight w:
+    -(2*T(g)*w + (h+lambda)*w^2). At the unclamped optimum w* = -T(g)/(h+lambda)
+    this equals T(g)^2/(h+lambda) == score(), so the constrained evaluator is
+    a strict generalization of the unconstrained one (xgboost's
+    CalcGainGivenWeight, with our L1 soft-threshold convention)."""
+    t = _soft_threshold(g, p.reg_alpha)
+    return -(2.0 * t * w + (h + p.reg_lambda) * w * w)
+
+
 def find_splits(
     hist: jnp.ndarray,  # [n_nodes, F, n_bins+1, 2]; last bucket = missing
     node_gh: jnp.ndarray,  # [n_nodes, 2] parent totals (includes missing)
     p: SplitParams,
     feature_mask: jnp.ndarray = None,  # [F] bool; False = column sampled out
     cat_mask: jnp.ndarray = None,  # [F] bool; True = categorical feature
+    monotone: jnp.ndarray = None,  # [F] float32 in {-1, 0, +1}
+    node_lower: jnp.ndarray = None,  # [n_nodes] weight lower bounds
+    node_upper: jnp.ndarray = None,  # [n_nodes] weight upper bounds
 ) -> LevelSplits:
     """For numeric features, candidate s means "bins <= s go left" (prefix
     scan). For categorical features (``cat_mask``), candidate s means the
     one-vs-rest partition "category s goes left" — bins ARE category codes,
     so the left child stats are a single histogram slot (xgboost's one-hot
-    categorical splits behind ``enable_categorical``)."""
+    categorical splits behind ``enable_categorical``).
+
+    With ``monotone`` (xgboost ``monotone_constraints``, the hist updater's
+    MonotonicConstraint evaluator): child weights are clamped to the node's
+    inherited ``[node_lower, node_upper]`` interval, candidate gains are
+    computed from the clamped weights, and candidates whose child-weight
+    ordering violates the sign (+1 requires w_left <= w_right) score -inf."""
     n_nodes, num_features, nbt, _ = hist.shape
     n_bins = nbt - 1
     g = hist[..., 0]  # [n, F, nbt]
@@ -88,13 +112,34 @@ def find_splits(
         hl = jnp.where(cm, h[..., : n_bins - 1], hl)
     gp = node_gh[:, 0][:, None, None]
     hp = node_gh[:, 1][:, None, None]
-    parent_score = score(node_gh[:, 0], node_gh[:, 1], p)[:, None, None]
 
-    def gain_for(gl_, hl_):
-        gr_, hr_ = gp - gl_, hp - hl_
-        ok = (hl_ >= p.min_child_weight) & (hr_ >= p.min_child_weight)
-        gain = score(gl_, hl_, p) + score(gr_, hr_, p) - parent_score
-        return jnp.where(ok, gain, -jnp.inf)
+    if monotone is not None:
+        lo = (jnp.full((n_nodes,), -jnp.inf) if node_lower is None
+              else node_lower)[:, None, None]
+        hi = (jnp.full((n_nodes,), jnp.inf) if node_upper is None
+              else node_upper)[:, None, None]
+        mono = monotone[None, :, None]
+        parent_score = score_given_weight(
+            gp, hp, p, bounded_weight(gp, hp, p, lo, hi)
+        )
+
+        def gain_for(gl_, hl_):
+            gr_, hr_ = gp - gl_, hp - hl_
+            ok = (hl_ >= p.min_child_weight) & (hr_ >= p.min_child_weight)
+            wl = bounded_weight(gl_, hl_, p, lo, hi)
+            wr = bounded_weight(gr_, hr_, p, lo, hi)
+            viol = ((mono > 0) & (wl > wr)) | ((mono < 0) & (wl < wr))
+            gain = (score_given_weight(gl_, hl_, p, wl)
+                    + score_given_weight(gr_, hr_, p, wr) - parent_score)
+            return jnp.where(ok & ~viol, gain, -jnp.inf)
+    else:
+        parent_score = score(node_gh[:, 0], node_gh[:, 1], p)[:, None, None]
+
+        def gain_for(gl_, hl_):
+            gr_, hr_ = gp - gl_, hp - hl_
+            ok = (hl_ >= p.min_child_weight) & (hr_ >= p.min_child_weight)
+            gain = score(gl_, hl_, p) + score(gr_, hr_, p) - parent_score
+            return jnp.where(ok, gain, -jnp.inf)
 
     gain_missing_left = gain_for(gl + gm[..., None], hl + hm[..., None])
     gain_missing_right = gain_for(gl, hl)

@@ -29,8 +29,6 @@ _IGNORED = {
     "gpu_id",
     "predictor",
     "sampling_method",
-    "max_leaves",
-    "grow_policy",
     "validate_parameters",
     "single_precision_histogram",
     "use_label_encoder",
@@ -90,6 +88,17 @@ class TrainParams:
     # build only the smaller child's histogram per parent, derive the sibling
     # by subtraction (xgboost hist-core behavior); disable for A/B debugging
     sibling_subtract: bool = True
+    # depthwise (level-wise) or lossguide (leaf-wise best-first growth)
+    grow_policy: str = "depthwise"
+    # lossguide leaf budget; 0 = bounded only by max_depth (2^max_depth)
+    max_leaves: int = 0
+    # per-feature monotone constraints (-1/0/+1), padded with 0 to the
+    # feature count at engine time; xgboost accepts "(1,-1)" strings too
+    monotone_constraints: tuple = ()
+    # interaction constraints: tuple of tuples of feature indices; a node may
+    # only split on features sharing a constraint set with EVERY feature
+    # already used on its root path (xgboost semantics)
+    interaction_constraints: tuple = ()
 
 
 def cat_feature_indices(feature_types: Optional[Sequence[Any]]) -> tuple:
@@ -99,6 +108,56 @@ def cat_feature_indices(feature_types: Optional[Sequence[Any]]) -> tuple:
         for i, t in enumerate(feature_types or [])
         if str(t).lower() in ("c", "categorical")
     )
+
+
+def _parse_monotone_constraints(val: Any) -> tuple:
+    """xgboost formats: "(1,-1,0)" string, or a sequence of -1/0/+1 ints.
+    Length may be shorter than the feature count; the engine pads with 0
+    (unconstrained), matching xgboost."""
+    if isinstance(val, str):
+        items = [s for s in val.strip().strip("()").split(",") if s.strip()]
+    elif isinstance(val, dict):
+        raise ValueError(
+            "dict-form monotone_constraints (by feature name) are not "
+            "supported; pass a tuple/list indexed by feature position."
+        )
+    else:
+        items = list(val)
+    try:
+        out = tuple(int(v) for v in items)
+    except (TypeError, ValueError):
+        raise ValueError(f"could not parse monotone_constraints: {val!r}")
+    if any(c not in (-1, 0, 1) for c in out):
+        raise ValueError(
+            f"monotone_constraints entries must be -1, 0, or +1; got {out}"
+        )
+    return out
+
+
+def _parse_interaction_constraints(val: Any) -> tuple:
+    """xgboost format: "[[0, 1], [2, 3, 4]]" string or a nested sequence of
+    feature indices. Feature names are not supported (index positions only)."""
+    if isinstance(val, str):
+        import ast
+
+        try:
+            val = ast.literal_eval(val)
+        except (SyntaxError, ValueError):
+            raise ValueError(
+                f"could not parse interaction_constraints string: {val!r}"
+            )
+    try:
+        groups = tuple(
+            tuple(sorted({int(i) for i in grp})) for grp in val
+        )
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"interaction_constraints must be a sequence of index groups "
+            f"(feature names are not supported); got {val!r}"
+        )
+    if any(i < 0 for grp in groups for i in grp):
+        raise ValueError("interaction_constraints indices must be >= 0")
+    return tuple(g for g in groups if g)
 
 
 def parse_params(params: Optional[Dict[str, Any]]) -> TrainParams:
@@ -123,14 +182,23 @@ def parse_params(params: Optional[Dict[str, Any]]) -> TrainParams:
         raise ValueError(f"Unsupported tree_method: {tree_method!r}")
     out.tree_method = tree_method
 
-    for constraint in ("monotone_constraints", "interaction_constraints"):
-        val = params.pop(constraint, None)
-        if val not in (None, "", "()", {}, []):
-            raise NotImplementedError(
-                f"{constraint} are not supported by tpu_hist yet; remove the "
-                f"parameter (silently ignoring a constraint would change "
-                f"model semantics)."
-            )
+    def _empty_constraint(val, empty_strs):
+        # explicit checks — numpy arrays reject bool()/== against strings
+        if val is None:
+            return True
+        if isinstance(val, str):
+            return val.strip() in empty_strs
+        try:
+            return len(val) == 0
+        except TypeError:
+            return False
+
+    mono = params.pop("monotone_constraints", None)
+    if not _empty_constraint(mono, ("", "()")):
+        out.monotone_constraints = _parse_monotone_constraints(mono)
+    ic = params.pop("interaction_constraints", None)
+    if not _empty_constraint(ic, ("", "()", "[]")):
+        out.interaction_constraints = _parse_interaction_constraints(ic)
 
     updater = params.pop("updater", None)
     if updater and "grow_colmaker" in str(updater):
@@ -195,6 +263,34 @@ def parse_params(params: Optional[Dict[str, Any]]) -> TrainParams:
                 "RXGB_DISABLE_PALLAS set); use hist_impl='auto'."
             )
 
+    if out.grow_policy not in ("depthwise", "lossguide"):
+        raise ValueError(
+            f"grow_policy must be 'depthwise' or 'lossguide'; got "
+            f"{out.grow_policy!r}"
+        )
+    if out.max_leaves < 0:
+        raise ValueError("max_leaves must be >= 0")
+    if out.grow_policy == "depthwise" and out.max_leaves > 0:
+        raise NotImplementedError(
+            "max_leaves with grow_policy='depthwise' (leaf-budget pruning of "
+            "level-wise growth) is not supported; use "
+            "grow_policy='lossguide' for a leaf budget, or drop max_leaves. "
+            "Silently ignoring it would change model semantics."
+        )
+    if out.grow_policy == "lossguide":
+        for bad, name in (
+            (out.colsample_bylevel < 1.0, "colsample_bylevel"),
+            (out.colsample_bynode < 1.0, "colsample_bynode"),
+            (bool(out.monotone_constraints)
+             and any(out.monotone_constraints), "monotone_constraints"),
+            (bool(out.interaction_constraints), "interaction_constraints"),
+        ):
+            if bad:
+                raise NotImplementedError(
+                    f"{name} is not supported with grow_policy='lossguide' "
+                    f"yet (level-wise only); silently ignoring it would "
+                    f"change model semantics."
+                )
     if out.max_depth < 1:
         raise ValueError("max_depth must be >= 1 for tpu_hist")
     if out.max_depth > 14:
