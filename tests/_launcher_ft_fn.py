@@ -1,0 +1,70 @@
+"""Module-level worker fn for the launcher fault-tolerance test.
+
+``launch_distributed`` pickles worker fns by reference, so the training
+worker the kill test uses lives here. It is the canonical pod-training
+pattern from ``launcher.py``'s docstring: resume from the newest checkpoint,
+train the remaining rounds, checkpoint (rank 0) every completed round —
+plus the test's fault injection: process 1 SIGKILLs itself at the start of
+round MH_KILL_ROUND on attempt 0 (a REAL OS-level death; the reference's
+kill-actor injection, ``xgboost_ray/tests/utils.py:110-180``).
+"""
+
+import os
+import signal
+import threading
+
+
+def train_worker(ctx, data_path):
+    import numpy as np
+
+    from xgboost_ray_tpu.engine import TpuEngine
+    from xgboost_ray_tpu.launcher import (
+        load_round_checkpoint,
+        save_round_checkpoint,
+    )
+    from xgboost_ray_tpu.matrix import RayShardingMode, _get_sharding_indices
+    from xgboost_ray_tpu.params import parse_params
+
+    exp = np.load(data_path)
+    x, y = exp["x"], exp["y"]
+    n, num_actors, rounds = x.shape[0], 8, int(exp["rounds"])
+    kill_round = int(os.environ.get("MH_KILL_ROUND", "-1"))
+
+    booster, done = load_round_checkpoint(ctx.checkpoint_path)
+
+    per_proc = num_actors // ctx.num_processes
+    shards = []
+    for rank in range(ctx.process_id * per_proc,
+                      (ctx.process_id + 1) * per_proc):
+        idx = _get_sharding_indices(
+            RayShardingMode.INTERLEAVED, rank, num_actors, n
+        )
+        shards.append({
+            "data": x[idx], "label": y[idx], "weight": None,
+            "base_margin": None, "label_lower_bound": None,
+            "label_upper_bound": None, "qid": None,
+        })
+    params = parse_params({"objective": "binary:logistic",
+                           "eval_metric": ["logloss"], "max_depth": 3})
+    eng = TpuEngine(shards, params, num_actors=num_actors,
+                    evals=[(shards, "train")], init_booster=booster)
+
+    for i in range(rounds - done):
+        if (ctx.process_id == 1 and ctx.attempt == 0
+                and done + i == kill_round):
+            # REAL process death, mid-training, no cleanup
+            os.kill(os.getpid(), signal.SIGKILL)
+        # watchdog: a step blocking >180 s means the peer death was NOT
+        # surfaced by the coordination service — exit distinctly
+        timer = threading.Timer(180.0, lambda: os._exit(3))
+        timer.daemon = True
+        timer.start()
+        try:
+            eng.step(i)
+        finally:
+            timer.cancel()
+        if ctx.process_id == 0 and ctx.checkpoint_path:
+            save_round_checkpoint(
+                eng.get_booster(), ctx.checkpoint_path, done + i
+            )
+    return eng.get_booster().predict(x, output_margin=True)

@@ -173,6 +173,25 @@ def main() -> int:
         exp["c_margins"], atol=1e-4,
     )
 
+    # --- multi-process SPMD predict (VERDICT r4 #4) -------------------------
+    # Each process predicts its own (UNEVEN — exercises the allgathered
+    # block layout + per-device padding) local rows through the public
+    # predict() path; the global mesh walks all rows in one lockstep program.
+    from xgboost_ray_tpu import RayDMatrix, RayParams
+    from xgboost_ray_tpu import main as rxgb_main
+
+    cut = 300
+    local_x = x[:cut] if pid == 0 else x[cut:]
+    expect = exp["margins"][:cut] if pid == 0 else exp["margins"][cut:]
+    pm = rxgb_main.predict(
+        bst, RayDMatrix(local_x),
+        ray_params=RayParams(num_actors=2), output_margin=True,
+    )
+    np.testing.assert_allclose(np.asarray(pm).ravel(), expect, atol=1e-4)
+    # booster-level entry with explicit devices agrees
+    pm2 = bst.predict_margin_spmd(local_x, list(jax.devices()))[:, 0]
+    np.testing.assert_allclose(pm2, expect, atol=1e-4)
+
     print(f"CHILD{pid} OK", flush=True)
     return 0
 

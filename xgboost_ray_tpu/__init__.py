@@ -39,6 +39,13 @@ from xgboost_ray_tpu.matrix import (
 from xgboost_ray_tpu.data_sources import RayFileType
 from xgboost_ray_tpu.models.booster import Booster, RayXGBoostBooster
 from xgboost_ray_tpu.callback import DistributedCallback, TrainingCallback
+from xgboost_ray_tpu.launcher import (
+    LaunchContext,
+    LaunchResult,
+    launch_distributed,
+    load_round_checkpoint,
+    save_round_checkpoint,
+)
 
 __version__ = "0.1.0"
 
@@ -59,6 +66,11 @@ __all__ = [
     "RayXGBoostActor",
     "DistributedCallback",
     "TrainingCallback",
+    "LaunchContext",
+    "LaunchResult",
+    "launch_distributed",
+    "load_round_checkpoint",
+    "save_round_checkpoint",
 ]
 
 try:

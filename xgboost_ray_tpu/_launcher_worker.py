@@ -1,0 +1,57 @@
+"""Per-process bootstrap spawned by ``launcher.launch_distributed``.
+
+Order matters: the hermeticity trick (drop non-CPU PJRT factories when
+``RXGB_FORCE_CPU_MESH`` is set — same as tests/conftest.py) must run before
+ANY jax-touching import, including the unpickle of the worker fn's module;
+then the process joins the ``jax.distributed`` world and runs the fn.
+
+Usage (internal): python -m xgboost_ray_tpu._launcher_worker <payload> <result>
+"""
+
+import os
+import pickle
+import sys
+
+
+def main() -> int:
+    payload_path, result_path = sys.argv[1], sys.argv[2]
+
+    if os.environ.get("RXGB_FORCE_CPU_MESH"):
+        import jax
+        from jax._src import xla_bridge as _xb
+
+        jax.config.update("jax_platforms", "cpu")
+        for _name in list(_xb._backend_factories):
+            if _name not in ("cpu",):
+                _xb._backend_factories.pop(_name, None)
+
+    with open(payload_path, "rb") as f:
+        payload = pickle.load(f)
+    ctx = payload["ctx"]
+    fn, args = pickle.loads(payload["fn_args"])
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=ctx.coordinator_address,
+        num_processes=ctx.num_processes,
+        process_id=ctx.process_id,
+    )
+
+    result = fn(ctx, *args)
+
+    tmp = f"{result_path}.tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(result, f)
+    os.replace(tmp, result_path)
+    try:
+        # orderly disconnect; the result file is already committed, so a
+        # teardown-time error must not fail the worker
+        jax.distributed.shutdown()
+    except Exception:  # noqa: BLE001
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

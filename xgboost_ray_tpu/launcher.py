@@ -1,0 +1,353 @@
+"""Driver-level multi-process launcher with automatic restart-from-checkpoint.
+
+The SPMD failure model (SURVEY §5.8): when any process of a
+``jax.distributed`` world dies, the coordination service TERMINATES the
+survivors with a fatal diagnostic — there is no Python exception to catch
+mid-collective, so recovery must live ABOVE the world, at the driver level.
+The reference solves the same problem with its retry loop
+(``xgboost_ray/main.py:1606-1713``): detect dead actors, re-create them, and
+restart training from the last checkpoint. ``launch_distributed`` is that
+loop for real process worlds: it spawns the per-process workers, watches for
+any death, tears the attempt down, and respawns the whole world — the
+workers resume from the newest checkpoint via ``load_round_checkpoint``.
+
+Single-host (or the CPU-mesh rehearsal), one launcher supervises the whole
+world. On a multi-host pod, run one launcher per host with
+``local_process_ids`` set to that host's process ids and a fixed
+``coordinator_address``: a death anywhere kills every process (the
+coordination service guarantees it), so every host's launcher observes its
+local children die and independently respawns them — the world re-forms at
+the same coordinator with the attempt counter advanced, and training resumes
+from the shared checkpoint.
+
+Worker functions must be module-level (pickled by reference into the spawned
+interpreter) with signature ``fn(ctx, *args)``; see ``LaunchContext`` for
+what they receive. The canonical training worker:
+
+    def train_worker(ctx, data_path):
+        booster, done = load_round_checkpoint(ctx.checkpoint_path)
+        shards = ...  # THIS process's rows
+        eng = TpuEngine(shards, params, num_actors=W, init_booster=booster)
+        for i in range(total_rounds - done):
+            eng.step(i)
+            save_round_checkpoint(eng.get_booster(), ctx.checkpoint_path,
+                                  done + i)
+        return eng.get_booster().save_raw()
+"""
+
+import dataclasses
+import logging
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "LaunchContext",
+    "LaunchResult",
+    "ProcessFailure",
+    "LaunchFailedError",
+    "launch_distributed",
+    "save_round_checkpoint",
+    "load_round_checkpoint",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class LaunchContext:
+    """What every worker process receives as its first argument."""
+
+    process_id: int
+    num_processes: int
+    coordinator_address: str
+    attempt: int  # 0 on the first try, +1 per world restart
+    checkpoint_path: Optional[str]
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessFailure:
+    attempt: int
+    process_id: int
+    returncode: int
+    log_tail: str
+    # True when the LAUNCHER force-killed this process during teardown;
+    # False when it died on its own (the injected fault, the coordination
+    # service's survivor termination, or a surfaced Python exception)
+    forced: bool = False
+
+
+@dataclasses.dataclass
+class LaunchResult:
+    results: List[Any]  # worker_fn return value per LOCAL process
+    restarts: int  # world restarts that were needed
+    failures: List[ProcessFailure]  # every observed process death
+
+
+class LaunchFailedError(RuntimeError):
+    def __init__(self, message: str, failures: List[ProcessFailure]):
+        super().__init__(message)
+        self.failures = failures
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def save_round_checkpoint(booster, path: str, completed_round: int) -> None:
+    """Atomically persist ``booster`` + the round it completed (the driver's
+    rank-0 checkpoint role, reference ``main.py:612-626``). The MODEL rename
+    is the single commit point — the ``.round`` marker is advisory (humans /
+    monitoring) and never read back, so a death between the two renames
+    cannot desynchronize resume arithmetic."""
+    tmp = f"{path}.tmp"
+    booster.save_model(tmp)
+    os.replace(tmp, path)
+    rtmp = f"{path}.round.tmp"
+    with open(rtmp, "w") as f:
+        f.write(str(int(completed_round)))
+    os.replace(rtmp, f"{path}.round")
+
+
+def load_round_checkpoint(path: Optional[str]) -> Tuple[Optional[Any], int]:
+    """(booster, completed_rounds) from the newest checkpoint, or (None, 0)
+    when none exists yet. ``completed_rounds`` comes from the atomically
+    committed model itself (``num_boosted_rounds``), never the advisory
+    ``.round`` file — a kill between the checkpoint's two renames must not
+    make the resumed world recount."""
+    if not path or not os.path.exists(path):
+        return None, 0
+    from xgboost_ray_tpu.models.booster import RayXGBoostBooster
+
+    booster = RayXGBoostBooster.load_model(path)
+    return booster, booster.num_boosted_rounds()
+
+
+def _tail(path: str, limit: int = 4000) -> str:
+    try:
+        with open(path, "r", errors="replace") as f:
+            data = f.read()
+        return data[-limit:]
+    except OSError:
+        return ""
+
+
+def launch_distributed(
+    worker_fn: Callable,
+    num_processes: int,
+    *,
+    args: tuple = (),
+    checkpoint_path: Optional[str] = None,
+    max_restarts: int = 2,
+    local_process_ids: Optional[Sequence[int]] = None,
+    coordinator_address: Optional[str] = None,
+    env: Optional[Dict[str, str]] = None,
+    timeout_s: float = 900.0,
+    poll_interval: float = 0.25,
+    survivor_grace_s: float = 150.0,
+) -> LaunchResult:
+    """Run ``worker_fn(ctx, *args)`` in a ``num_processes``-process
+    ``jax.distributed`` world, restarting the WHOLE world from the latest
+    checkpoint when any process dies (up to ``max_restarts`` times).
+
+    ``worker_fn`` must be a module-level callable (pickled by reference).
+    Each spawned process joins the world before the fn runs; the fn's return
+    value is pickled back. ``env`` entries override the inherited
+    environment (e.g. ``JAX_PLATFORMS``/``XLA_FLAGS`` for the CPU-mesh
+    rehearsal, ``RXGB_FORCE_CPU_MESH=1`` for tunnel hermeticity).
+
+    Single-host by default (spawns all ``num_processes`` locally with a
+    fresh loopback coordinator per attempt). On a pod, pass this host's
+    ``local_process_ids`` and the shared ``coordinator_address``.
+
+    On a process death, survivors get ``survivor_grace_s`` to exit on their
+    own (the coordination service terminates them — with default heartbeat
+    settings detection takes up to ~100 s, so the grace must exceed it; a
+    Python-level surfaced failure exits sooner) before being force-killed — so ``failures`` records
+    whether each process surfaced the failure itself (``forced=False``) or
+    had to be torn down (``forced=True``).
+    """
+    if num_processes < 1:
+        raise ValueError("num_processes must be >= 1")
+    local_ids = (
+        list(local_process_ids)
+        if local_process_ids is not None
+        else list(range(num_processes))
+    )
+    if any(i < 0 or i >= num_processes for i in local_ids):
+        raise ValueError(
+            f"local_process_ids {local_ids} out of range for "
+            f"num_processes={num_processes}"
+        )
+    # pickle-by-reference sanity check up front (spawned interpreters import
+    # the fn's module; a lambda/closure would die remotely with a worse error)
+    try:
+        payload_fn = pickle.dumps((worker_fn, tuple(args)))
+    except Exception as exc:
+        raise ValueError(
+            f"worker_fn/args must be picklable module-level objects "
+            f"(got {exc})"
+        ) from exc
+
+    scratch = tempfile.mkdtemp(prefix="rxgb_launch_")
+    fn_mod_dir = None
+    mod = sys.modules.get(getattr(worker_fn, "__module__", ""), None)
+    mod_file = getattr(mod, "__file__", None)
+    if mod_file:
+        fn_mod_dir = os.path.dirname(os.path.abspath(mod_file))
+
+    failures: List[ProcessFailure] = []
+    try:
+        return _run_attempts(
+            payload_fn, num_processes, local_ids, checkpoint_path,
+            coordinator_address, env, fn_mod_dir, scratch, timeout_s,
+            poll_interval, survivor_grace_s, max_restarts, failures,
+        )
+    finally:
+        import shutil
+
+        # failure log tails are already captured into the ProcessFailure
+        # records (and into the raised error), so the scratch dir never
+        # needs to outlive the call
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
+def _run_attempts(
+    payload_fn, num_processes, local_ids, checkpoint_path,
+    coordinator_address, env, fn_mod_dir, scratch, timeout_s,
+    poll_interval, survivor_grace_s, max_restarts, failures,
+) -> LaunchResult:
+    restarts = 0
+    attempt = 0
+    while True:
+        coord = coordinator_address or f"127.0.0.1:{_free_port()}"
+        procs: List[subprocess.Popen] = []
+        paths = []
+        for pid_ in local_ids:
+            ctx = LaunchContext(
+                process_id=pid_,
+                num_processes=num_processes,
+                coordinator_address=coord,
+                attempt=attempt,
+                checkpoint_path=checkpoint_path,
+            )
+            payload_path = os.path.join(scratch, f"a{attempt}_p{pid_}.pkl")
+            result_path = os.path.join(scratch, f"a{attempt}_p{pid_}.result")
+            log_path = os.path.join(scratch, f"a{attempt}_p{pid_}.log")
+            with open(payload_path, "wb") as f:
+                pickle.dump({"fn_args": payload_fn, "ctx": ctx}, f)
+            child_env = dict(os.environ)
+            if env:
+                child_env.update(env)
+            py_path = [p for p in (fn_mod_dir,) if p]
+            py_path.append(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            )
+            if child_env.get("PYTHONPATH"):
+                py_path.append(child_env["PYTHONPATH"])
+            child_env["PYTHONPATH"] = os.pathsep.join(py_path)
+            child_env.pop("PYTEST_CURRENT_TEST", None)
+            log_f = open(log_path, "w")
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable,
+                        "-u",
+                        "-m",
+                        "xgboost_ray_tpu._launcher_worker",
+                        payload_path,
+                        result_path,
+                    ],
+                    env=child_env,
+                    stdout=log_f,
+                    stderr=subprocess.STDOUT,
+                )
+            )
+            log_f.close()
+            paths.append((result_path, log_path, pid_))
+
+        deadline = time.monotonic() + timeout_s
+        attempt_failed = False
+        timed_out = False
+        while True:
+            codes = [p.poll() for p in procs]
+            if any(c is not None and c != 0 for c in codes):
+                attempt_failed = True
+                break
+            if all(c == 0 for c in codes):
+                break
+            if time.monotonic() > deadline:
+                attempt_failed = True
+                timed_out = True
+                break
+            time.sleep(poll_interval)
+
+        if attempt_failed:
+            # give survivors the chance to exit on their own (coordination-
+            # service termination / surfaced exception) so `forced` records
+            # who actually surfaced the failure; hung worlds skip the grace
+            if not timed_out and survivor_grace_s > 0:
+                grace_end = time.monotonic() + survivor_grace_s
+                while (any(p.poll() is None for p in procs)
+                       and time.monotonic() < grace_end):
+                    time.sleep(poll_interval)
+            forced_ids = set()
+            for p, (_, _, pid_) in zip(procs, paths):
+                if p.poll() is None:
+                    forced_ids.add(pid_)
+                    p.kill()
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    pass
+            for p, (_, log_path, pid_) in zip(procs, paths):
+                rc = p.returncode if p.returncode is not None else -1
+                if rc != 0:
+                    failures.append(
+                        ProcessFailure(
+                            attempt, pid_, rc, _tail(log_path),
+                            forced=pid_ in forced_ids,
+                        )
+                    )
+            why = "timed out" if timed_out else "process death"
+            if restarts >= max_restarts:
+                raise LaunchFailedError(
+                    f"distributed world failed ({why}) on attempt {attempt} "
+                    f"and the restart budget ({max_restarts}) is exhausted. "
+                    f"Last failure logs:\n"
+                    + "\n".join(
+                        f"--- process {f_.process_id} (rc={f_.returncode})\n"
+                        f"{f_.log_tail[-1500:]}"
+                        for f_ in failures[-len(local_ids):]
+                    ),
+                    failures,
+                )
+            restarts += 1
+            attempt += 1
+            logger.warning(
+                "[RayXGBoost] distributed world died (%s, attempt %d); "
+                "restarting from checkpoint %r (restart %d/%d).",
+                why, attempt - 1, checkpoint_path, restarts, max_restarts,
+            )
+            continue
+
+        results = []
+        for result_path, log_path, pid_ in paths:
+            try:
+                with open(result_path, "rb") as f:
+                    results.append(pickle.load(f))
+            except OSError:
+                results.append(None)
+        return LaunchResult(
+            results=results, restarts=restarts, failures=failures
+        )

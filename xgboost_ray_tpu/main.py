@@ -1355,14 +1355,20 @@ def _predict_shards_spmd(model, shards, predict_kwargs, bm_shards=None,
     if (
         not ENV.SPMD_PREDICT
         or any(predict_kwargs.get(kw) for kw in unsupported)
-        or jax.process_count() > 1  # rows are driver-resident here
     ):
         return None
-    devices = _resolve_mesh_devices(max(len(shards), 1), ray_params)
-    if len(devices) > len(shards) > 0:
-        devices = devices[: len(shards)]
-    if len(devices) <= 1 and len(shards) <= 1:
-        return None
+    if jax.process_count() > 1:
+        # multi-process world: the full global mesh participates; this
+        # process's shards are its local rows (same contract as training).
+        devices = list(jax.devices())
+        if len(devices) % jax.process_count():
+            return None  # host loop fallback on ragged worlds
+    else:
+        devices = _resolve_mesh_devices(max(len(shards), 1), ray_params)
+        if len(devices) > len(shards) > 0:
+            devices = devices[: len(shards)]
+        if len(devices) <= 1 and len(shards) <= 1:
+            return None
 
     xs = [model._coerce_features(sh["data"]) for sh in shards]
     sizes = [xv.shape[0] for xv in xs]
