@@ -1,0 +1,30 @@
+#!/bin/bash
+# Round-5 serialized TPU queue (single-client tunnel — never overlap).
+# Fired automatically by r5_watch.sh the moment the tunnel answers.
+# Order: crash bisection first (validates the 11M SCAN_MAX_CHUNK fix), then
+# the headline bench while the tunnel is known-good, then overhead
+# attribution, distributed predict, MSLR ranking, pallas fate, precision
+# quality. Commits results unattended.
+cd /root/repo
+export PYTHONPATH=/root/repo:${PYTHONPATH}
+export JAX_COMPILATION_CACHE_DIR=/tmp/jax_cache
+L=/root/repo/tpu_logs
+run() {  # run <name> <timeout_s> <cmd...>
+  echo "=== $1 start $(date +%T) ===" >> $L/r5.log
+  timeout "$2" "${@:3}" >> $L/r5.log 2>&1
+  echo "=== $1 exit=$? $(date +%T) ===" >> $L/r5.log
+}
+run bisect 3600 python tpu_logs/r3_bisect.py
+run bench_full 4000 python bench.py
+# preserve the real-TPU bench line separately so it can't be lost
+grep -a '"metric"' $L/r5.log | tail -1 > $L/r5_bench_line.json
+run steady 2400 python tpu_logs/r3_steady.py
+run overhead 3600 python tpu_logs/r4_overhead.py
+run predict_bench 2400 python tests/release/benchmark_predict.py 1 1000000
+run mslr 3600 python tests/release/benchmark_ranking.py 1 100
+run pallas 2400 python tpu_logs/r3_pallas.py
+run int8_probe 1200 python tpu_logs/r4_int8_probe.py
+run quality 1800 python tpu_logs/quality_fast.py
+echo "R5 QUEUE ALL DONE $(date +%T)" >> $L/r5.log
+git add tpu_logs/r5.log tpu_logs/r5_bench_line.json tpu_logs/r5_probe.log 2>/dev/null
+git commit -m "Record round-5 on-TPU measurement queue results" >> $L/r5.log 2>&1

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Round-5 tunnel watchdog: probe until the axon TPU backend answers, then
+# FIRE the measurement queue exactly once (fire-once pattern from r4;
+# VERDICT r4 #1 requires a real-TPU BENCH_r05 or a committed probe log).
+L=/root/repo/tpu_logs
+while true; do
+  ts=$(date +%F_%T)
+  out=$(timeout 240 python -c "import jax; print('DEVS', jax.devices())" 2>&1 | tail -2)
+  # require a REAL accelerator answer: a CPU fallback must not fire the
+  # queue and unattended-commit CPU numbers as the round-5 TPU record
+  if echo "$out" | grep -q "DEVS" && ! echo "$out" | grep -qi "CpuDevice"; then
+    echo "$ts UP: $out" >> $L/r5_probe.log
+    touch $L/TUNNEL_UP_R5
+    bash $L/r5_queue.sh
+    echo "$ts queue finished" >> $L/r5_probe.log
+    exit 0
+  fi
+  echo "$ts down: $(echo "$out" | tr '\n' ' ' | cut -c1-160)" >> $L/r5_probe.log
+  sleep 180
+done

@@ -47,6 +47,19 @@ SALT_BYLEVEL = 0x51D3
 SALT_BYNODE = 0x51D4
 
 
+def route_right_binned(bin_vals, split_bin, default_left, is_cat, missing_bin):
+    """The one binned routing rule (build_tree, lossguide, binned predict):
+    numeric = bin > split_bin goes right, categorical one-vs-rest = the
+    candidate category (bin == split_bin) goes left, missing bucket follows
+    the learned default. All args broadcast elementwise; ``is_cat`` may be
+    None when the tree has no categorical features. predict.py's raw-x
+    walk mirrors this rule in value space (``_step_right``)."""
+    present_right = bin_vals > split_bin
+    if is_cat is not None:
+        present_right = jnp.where(is_cat, bin_vals != split_bin, present_right)
+    return jnp.where(bin_vals == missing_bin, ~default_left, present_right)
+
+
 def cat_mask_const(cat_features: tuple, num_features: int):
     """[F] bool compile-time constant marking categorical features (None when
     there are none) — single source for every walk/build/sketch site."""
@@ -488,13 +501,10 @@ def build_tree(
 
         f_of_row = fsafe[pos]
         b = jnp.take_along_axis(bins.astype(jnp.int32), f_of_row[:, None], axis=1)[:, 0]
-        present_right = b > sp.split_bin[pos]
-        if cat_mask is not None:
-            # categorical routing: the candidate category goes left
-            present_right = jnp.where(
-                cat_mask[f_of_row], b != sp.split_bin[pos], present_right
-            )
-        go_right = jnp.where(b == missing_bin, ~sp.default_left[pos], present_right)
+        go_right = route_right_binned(
+            b, sp.split_bin[pos], sp.default_left[pos],
+            None if cat_mask is None else cat_mask[f_of_row], missing_bin,
+        )
         effective_right = jnp.where(done, False, go_right)
         pos = pos * 2 + effective_right.astype(jnp.int32)
         active = jnp.repeat(valid_split, 2)
@@ -592,13 +602,9 @@ def predict_tree_binned(
     for _ in range(max_depth):
         f = jnp.clip(tree.feature[idx], 0, num_features - 1)
         bv = jnp.take_along_axis(b32, f[:, None], axis=1)[:, 0]
-        present_right = bv > tree.split_bin[idx]
-        if cat_mask is not None:
-            present_right = jnp.where(
-                cat_mask[f], bv != tree.split_bin[idx], present_right
-            )
-        go_right = jnp.where(
-            bv == missing_bin, ~tree.default_left[idx], present_right
+        go_right = route_right_binned(
+            bv, tree.split_bin[idx], tree.default_left[idx],
+            None if cat_mask is None else cat_mask[f], missing_bin,
         )
         nxt = 2 * idx + 1 + go_right.astype(jnp.int32)
         idx = jnp.where(tree.is_leaf[idx], idx, nxt)

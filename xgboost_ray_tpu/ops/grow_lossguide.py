@@ -1,0 +1,198 @@
+"""Leaf-wise (``grow_policy=lossguide``) tree growth under static shapes.
+
+xgboost's lossguide policy repeatedly splits the FRONTIER LEAF WITH THE
+HIGHEST GAIN until ``max_leaves`` is reached — depth-asymmetric trees that
+chase the best objective reduction first (the LightGBM growth strategy;
+reference surface: the params dict forwarded untouched at
+``xgboost_ray/main.py:745-752``).
+
+TPU-native formulation: the dynamic best-first loop becomes ONE
+``lax.scan`` of ``max_leaves - 1`` identical steps over a static frontier
+table of ``2*max_leaves - 1`` entries (every node the tree can ever
+create). Each step: argmax over frontier gains -> split that leaf (dynamic
+heap slot, pure scatters) -> route only its rows -> build the two
+children's histograms (one-hot MXU pass over all rows, psum-merged at the
+reference's Rabit point) -> score their best splits into the two
+append-slots ``1+2t, 2+2t``. Append-only indexing keeps every shape static
+and the whole tree build a single compiled program.
+
+Cost note: each step's histogram pass is O(N) regardless of the split
+leaf's row count (rows outside the leaf are masked, not skipped), so a
+full lossguide tree costs O(N * max_leaves) histogram work vs depthwise's
+O(N * max_depth). That is the static-shape price; the constant is one
+bf16/f32 one-hot matmul per step, which the MXU absorbs.
+"""
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from xgboost_ray_tpu.ops.grow import (
+    GrowConfig,
+    Tree,
+    cat_mask_const,
+    empty_tree,
+    route_right_binned,
+)
+from xgboost_ray_tpu.ops.histogram import hist_onehot
+from xgboost_ray_tpu.ops.split import find_splits, leaf_weight
+
+
+def build_tree_lossguide(
+    bins: jnp.ndarray,  # [N, F] int bins (max_bin == missing bucket)
+    gh: jnp.ndarray,  # [N, 2] grad/hess (0 for padding/subsampled rows)
+    cuts: jnp.ndarray,  # [F, max_bin-1] raw cut values
+    cfg: GrowConfig,
+    feature_mask: Optional[jnp.ndarray] = None,  # [F] bool (colsample_bytree)
+    allreduce: Callable[[jnp.ndarray], jnp.ndarray] = lambda x: x,
+    feat_has_missing: Optional[jnp.ndarray] = None,
+):
+    """Grow one leaf-wise tree. Returns (Tree, row_value[N]) — the same
+    contract as ``build_tree`` so the engine's round step is policy-blind."""
+    n, num_features = bins.shape
+    nbt = cfg.max_bin + 1
+    missing_bin = cfg.max_bin
+    lr = cfg.split.learning_rate
+    heap = cfg.heap_size
+    leaves = max(1, int(cfg.max_leaves))
+    n_ent = 2 * leaves - 1
+    cat_mask = cat_mask_const(cfg.cat_features, num_features)
+
+    def _zero_phantom_missing(h):
+        if feat_has_missing is None:
+            return h
+        keep = feat_has_missing[None, :, None].astype(h.dtype)
+        return h.at[:, :, -1, :].multiply(keep)
+
+    def _hist(gh_b, pos_b, nn):
+        h = hist_onehot(
+            bins, gh_b, pos_b, nn, nbt,
+            chunk=cfg.hist_chunk, precision=cfg.hist_precision,
+        )
+        return _zero_phantom_missing(allreduce(h))
+
+    tree = empty_tree(heap)
+    pos = jnp.zeros((n,), jnp.int32)
+
+    # --- root: evaluate its best split, seed the frontier -------------------
+    root_hist = _hist(gh, pos, 1)  # [1, F, nbt, 2]
+    root_gh = root_hist[:, 0, :, :].sum(axis=1)  # [1, 2]
+    sp0 = find_splits(root_hist, root_gh, cfg.split,
+                      feature_mask=feature_mask, cat_mask=cat_mask)
+    root_value = lr * leaf_weight(root_gh[:, 0], root_gh[:, 1], cfg.split)[0]
+    tree = tree._replace(
+        is_leaf=tree.is_leaf.at[0].set(True),
+        value=tree.value.at[0].set(root_value),
+        cover=tree.cover.at[0].set(root_gh[0, 1]),
+        base_weight=tree.base_weight.at[0].set(root_value),
+    )
+
+    # frontier entry table (append-only; entry 0 = root)
+    ent_pos = jnp.full((n_ent,), -1, jnp.int32).at[0].set(0)
+    ent_active = jnp.zeros((n_ent,), bool).at[0].set(True)
+    can_root = heap > 1  # max_depth >= 1
+    ent_gain = jnp.full((n_ent,), -jnp.inf).at[0].set(
+        jnp.where(sp0.valid[0] & can_root, sp0.gain[0], -jnp.inf)
+    )
+    ent_feat = jnp.zeros((n_ent,), jnp.int32).at[0].set(sp0.feature[0])
+    ent_bin = jnp.zeros((n_ent,), jnp.int32).at[0].set(sp0.split_bin[0])
+    ent_dl = jnp.zeros((n_ent,), bool).at[0].set(sp0.default_left[0])
+
+    b32 = bins.astype(jnp.int32)
+
+    def body(carry, t):
+        tree, pos, ent_pos, ent_active, ent_gain, ent_feat, ent_bin, ent_dl = carry
+
+        scores = jnp.where(ent_active, ent_gain, -jnp.inf)
+        i = jnp.argmax(scores)
+        do_split = jnp.isfinite(scores[i])
+
+        slot = ent_pos[i]
+        feat = jnp.clip(ent_feat[i], 0, num_features - 1)
+        sbin = ent_bin[i]
+        dl = ent_dl[i]
+        thr = cuts[feat, jnp.clip(sbin, 0, cfg.max_bin - 2)]
+        slot_c = jnp.maximum(slot, 0)
+
+        # parent leaf -> internal node (scatters guarded by do_split)
+        def setw(arr, idx, new):
+            return arr.at[idx].set(jnp.where(do_split, new, arr[idx]))
+
+        tree = tree._replace(
+            feature=setw(tree.feature, slot_c, feat),
+            split_bin=setw(tree.split_bin, slot_c, sbin),
+            threshold=setw(tree.threshold, slot_c, thr),
+            default_left=setw(tree.default_left, slot_c, dl),
+            is_leaf=setw(tree.is_leaf, slot_c, False),
+            value=setw(tree.value, slot_c, 0.0),
+            gain=setw(tree.gain, slot_c, ent_gain[i]),
+        )
+
+        # route ONLY this leaf's rows
+        sel = (pos == slot) & do_split
+        bv = jnp.take_along_axis(b32, jnp.full((n, 1), feat), axis=1)[:, 0]
+        go_right = route_right_binned(
+            bv, sbin, dl,
+            None if cat_mask is None else cat_mask[feat], missing_bin,
+        )
+        l_slot, r_slot = 2 * slot_c + 1, 2 * slot_c + 2
+        pos = jnp.where(sel, jnp.where(go_right, r_slot, l_slot), pos)
+
+        # the two children's histograms + best splits
+        gh_sel = gh * sel[:, None].astype(gh.dtype)
+        pos2 = go_right.astype(jnp.int32)
+        hist2 = _hist(gh_sel, pos2, 2)  # [2, F, nbt, 2]
+        child_gh = hist2[:, 0, :, :].sum(axis=1)  # [2, 2]
+        sp2 = find_splits(hist2, child_gh, cfg.split,
+                          feature_mask=feature_mask, cat_mask=cat_mask)
+        child_slots = jnp.stack([l_slot, r_slot])
+        # children may split further only while their own children fit the
+        # depth-bounded heap
+        can_deepen = 2 * child_slots + 2 < heap
+        child_gain = jnp.where(
+            sp2.valid & can_deepen & do_split, sp2.gain, -jnp.inf
+        )
+        child_value = lr * leaf_weight(child_gh[:, 0], child_gh[:, 1],
+                                       cfg.split)
+
+        def set2(arr, new):
+            upd = jnp.where(do_split, new, arr[child_slots])
+            return arr.at[child_slots].set(upd)
+
+        tree = tree._replace(
+            is_leaf=set2(tree.is_leaf, jnp.array([True, True])),
+            value=set2(tree.value, child_value),
+            cover=set2(tree.cover, child_gh[:, 1]),
+            base_weight=set2(tree.base_weight, child_value),
+        )
+
+        # frontier bookkeeping: retire entry i, append children at 1+2t, 2+2t
+        ent_active = ent_active.at[i].set(
+            jnp.where(do_split, False, ent_active[i])
+        )
+        k = 1 + 2 * t
+        ks = jnp.stack([k, k + 1])
+
+        def app(arr, new, fill):
+            upd = jnp.where(do_split, new, jnp.asarray(fill, arr.dtype))
+            return arr.at[ks].set(upd)
+
+        ent_pos = app(ent_pos, child_slots, -1)
+        ent_active = app(ent_active, jnp.array([True, True]), False)
+        ent_gain = app(ent_gain, child_gain, -jnp.inf)
+        ent_feat = app(ent_feat, sp2.feature, 0)
+        ent_bin = app(ent_bin, sp2.split_bin, 0)
+        ent_dl = app(ent_dl, sp2.default_left, False)
+
+        return (tree, pos, ent_pos, ent_active, ent_gain, ent_feat, ent_bin,
+                ent_dl), None
+
+    if leaves > 1:
+        carry = (tree, pos, ent_pos, ent_active, ent_gain, ent_feat, ent_bin,
+                 ent_dl)
+        carry, _ = jax.lax.scan(body, carry, jnp.arange(leaves - 1))
+        tree, pos = carry[0], carry[1]
+
+    row_value = tree.value[pos]
+    return tree, row_value

@@ -284,6 +284,11 @@ def parse_params(params: Optional[Dict[str, Any]]) -> TrainParams:
             (bool(out.monotone_constraints)
              and any(out.monotone_constraints), "monotone_constraints"),
             (bool(out.interaction_constraints), "interaction_constraints"),
+            # the lossguide grower's per-step 2-node histogram is always the
+            # one-hot MXU pass; an explicit different impl must not be
+            # silently dropped (the repo's no-silent-fallback invariant)
+            (out.hist_impl not in ("auto", "onehot"),
+             f"hist_impl={out.hist_impl!r}"),
         ):
             if bad:
                 raise NotImplementedError(
