@@ -261,95 +261,17 @@ def test_hist_partition_skewed_nodes():
     np.testing.assert_allclose(out, ref, atol=1e-4)
 
 
-def test_hist_pallas_interpret_matches_scatter():
-    """Run in a subprocess: the hermetic conftest deregisters the tpu
-    platform, which pallas.tpu needs even for interpret mode."""
-    import subprocess
-    import sys
+def test_unknown_hist_impl_rejected():
+    """hist_impl='pallas' was REMOVED in r5 (the hand-written kernel lost to
+    the identical-layout XLA einsum on-chip — rationale in ops/grow.py's
+    module docstring); an explicit request must fail loudly at parse time,
+    never silently run a different impl."""
+    from xgboost_ray_tpu.params import parse_params
 
-    code = """
-import os
-os.environ["JAX_PLATFORMS"] = "cpu"
-import numpy as np, jax.numpy as jnp
-from xgboost_ray_tpu.ops.histogram import hist_scatter
-from xgboost_ray_tpu.ops.hist_pallas import PALLAS_AVAILABLE, hist_pallas
-assert PALLAS_AVAILABLE
-rng = np.random.RandomState(11)
-n, f, nb, n_nodes = 300, 4, 8, 4
-bins = rng.randint(0, nb + 1, size=(n, f)).astype(np.uint8)
-gh = rng.randn(n, 2).astype(np.float32)
-pos = rng.randint(0, n_nodes, size=n).astype(np.int32)
-ref = np.asarray(hist_scatter(jnp.asarray(bins), jnp.asarray(gh),
-                              jnp.asarray(pos), n_nodes, nb + 1))
-out = np.asarray(hist_pallas(jnp.asarray(bins), jnp.asarray(gh),
-                             jnp.asarray(pos), n_nodes, nb + 1,
-                             block=64, interpret=True))
-np.testing.assert_allclose(out, ref, atol=1e-4)
-print("PALLAS_OK")
-"""
-    result = subprocess.run(
-        [sys.executable, "-c", code], capture_output=True, text=True,
-        timeout=300, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    )
-    assert "PALLAS_OK" in result.stdout, result.stderr[-2000:]
-
-
-def test_hist_pallas_presorted_interpret_matches_scatter():
-    """The presorted variant (fed from update_partition_order's maintained
-    row order, no internal argsort) must match hist_scatter bit-for-bit in
-    interpret mode. Subprocess for the same platform-registration reason."""
-    import subprocess
-    import sys
-
-    code = """
-import os
-os.environ["JAX_PLATFORMS"] = "cpu"
-import numpy as np, jax.numpy as jnp
-from xgboost_ray_tpu.ops.histogram import hist_scatter
-from xgboost_ray_tpu.ops.hist_pallas import PALLAS_AVAILABLE, hist_pallas_presorted
-assert PALLAS_AVAILABLE
-rng = np.random.RandomState(12)
-n, f, nb, n_nodes = 300, 4, 8, 4
-bins = rng.randint(0, nb + 1, size=(n, f)).astype(np.uint8)
-gh = rng.randn(n, 2).astype(np.float32)
-pos = rng.randint(0, n_nodes, size=n).astype(np.int32)
-order = np.argsort(pos, kind="stable").astype(np.int32)
-counts = np.bincount(pos, minlength=n_nodes)
-ref = np.asarray(hist_scatter(jnp.asarray(bins), jnp.asarray(gh),
-                              jnp.asarray(pos), n_nodes, nb + 1))
-out = np.asarray(hist_pallas_presorted(
-    jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(order),
-    jnp.asarray(counts), n_nodes, nb + 1, block=64, interpret=True))
-np.testing.assert_allclose(out, ref, atol=1e-4)
-print("PALLAS_PRESORTED_OK")
-"""
-    result = subprocess.run(
-        [sys.executable, "-c", code], capture_output=True, text=True,
-        timeout=300, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    )
-    assert "PALLAS_PRESORTED_OK" in result.stdout, result.stderr[-2000:]
-
-
-def test_pallas_impl_raises_off_tpu():
-    """hist_impl='pallas' must NOT silently fall back to a different impl off
-    TPU (ADVICE r3): an explicit kernel opt-in either runs the kernel or
-    raises. (The kernel only lowers on TPU; use hist_impl='auto'/'mixed' for
-    portable training.)"""
-    rng = np.random.RandomState(14)
-    x = rng.randn(64, 4).astype(np.float32)
-    g = rng.randn(64).astype(np.float32)
-    h = np.ones(64, np.float32)
-    cuts = binning.sketch_cuts_np(x, max_bin=16)
-    bins = binning.bin_matrix_np(x, cuts, max_bin=16)
-    gh = jnp.asarray(np.stack([g, h], 1))
-    cfg = GrowConfig(max_depth=3, max_bin=16,
-                     split=SplitParams(learning_rate=1.0), hist_impl="pallas")
-    import jax
-
-    if jax.default_backend() == "tpu":
-        pytest.skip("on-TPU run would use the real kernel")
-    with pytest.raises(RuntimeError, match="pallas"):
-        build_tree(jnp.asarray(bins), gh, jnp.asarray(cuts), cfg)
+    with pytest.raises(ValueError, match="Pallas kernel was removed"):
+        parse_params({"hist_impl": "pallas"})
+    with pytest.raises(ValueError, match="Unknown hist_impl"):
+        parse_params({"hist_impl": "bogus"})
 
 
 def test_build_tree_impls_produce_identical_trees():

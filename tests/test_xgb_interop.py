@@ -283,3 +283,212 @@ def test_num_parallel_tree_sum_convention_parity(tmp_path):
     assert back.params.num_parallel_tree == 3
     np.testing.assert_allclose(
         back.predict(x, output_margin=True), ours, atol=1e-4)
+
+
+# --- adversarial golden fixtures (VERDICT r4 #6) ---------------------------
+# Hand-constructed node-array models in shapes real xgboost emits; expected
+# values come from _xgb_core_margin, an independent pure-python walker of
+# the file format (no code shared with the importer).
+
+
+def _mk_tree(t_id, left, right, cond, feat, dleft, bw=None, nf=3):
+    n = len(left)
+    return {
+        "base_weights": bw or [0.0] * n,
+        "categories": [], "categories_nodes": [],
+        "categories_segments": [], "categories_sizes": [],
+        "default_left": dleft, "id": t_id,
+        "left_children": left, "right_children": right,
+        "loss_changes": [1.0] * n,
+        "parents": [2147483647] + [0] * (n - 1),  # parents unused on import
+        "split_conditions": cond, "split_indices": feat,
+        "split_type": [0] * n, "sum_hessian": [1.0] * n,
+        "tree_param": {"num_deleted": "0", "num_feature": str(nf),
+                       "num_nodes": str(n), "size_leaf_vector": "1"},
+    }
+
+
+def _mk_doc(trees, tree_info, objective="reg:squarederror", base_score="0.0",
+            num_class="0", npt="1", booster="gbtree", weight_drop=None, nf=3,
+            per_round=1):
+    # iteration_indptr strides by trees-per-round (k * npt), the layout real
+    # xgboost emits — e.g. [0, 3, 6] for 2 rounds of 3 class trees
+    rounds = max(1, len(trees) // per_round)
+    model = {
+        "gbtree_model_param": {"num_parallel_tree": npt,
+                               "num_trees": str(len(trees))},
+        "iteration_indptr": [r * per_round for r in range(rounds + 1)],
+        "tree_info": tree_info,
+        "trees": trees,
+    }
+    if booster == "dart":
+        gb = {"name": "dart", "gbtree": {"model": model},
+              "weight_drop": weight_drop}
+    else:
+        gb = {"name": "gbtree", "model": model}
+    return {
+        "learner": {
+            "attributes": {}, "feature_names": [], "feature_types": [],
+            "gradient_booster": gb,
+            "learner_model_param": {"base_score": base_score,
+                                    "boost_from_average": "1",
+                                    "num_class": num_class,
+                                    "num_feature": str(nf),
+                                    "num_target": "1"},
+            "objective": {"name": objective,
+                          "reg_loss_param": {"scale_pos_weight": "1"}},
+        },
+        "version": [2, 0, 0],
+    }
+
+
+def test_import_golden_deep_asymmetric_chain():
+    """Depth-5 right-spine chain (every left child a leaf) — the extreme
+    lossguide shape; node ids deliberately NOT in heap order."""
+    #  n0: x0<1 ? leaf(0.1) : n2: x0<2 ? leaf(0.2) : n4: x0<3 ? ... depth 5
+    left = [1, -1, 3, -1, 5, -1, 7, -1, 9, -1, -1]
+    right = [2, -1, 4, -1, 6, -1, 8, -1, 10, -1, -1]
+    cond = [1.0, 0.1, 2.0, 0.2, 3.0, 0.3, 4.0, 0.4, 5.0, 0.5, 0.6]
+    feat = [0] * 11
+    dleft = [1, 0, 0, 0, 1, 0, 0, 0, 1, 0, 0]
+    doc = _mk_doc([_mk_tree(0, left, right, cond, feat, dleft)], [0])
+    back = RayXGBoostBooster.import_xgboost_json(doc)
+    x = np.array([[0.5, 0, 0], [1.5, 0, 0], [2.5, 0, 0], [3.5, 0, 0],
+                  [4.5, 0, 0], [9.0, 0, 0], [np.nan, 0, 0]], np.float32)
+    got = back.predict(x, output_margin=True)
+    want = _xgb_core_margin(doc, x)  # base_score 0 margin
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    np.testing.assert_allclose(
+        got, [0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.1], atol=1e-6
+    )
+
+
+def _xgb_core_margin_multi(doc, x, num_class):
+    """Per-class sum-convention walker (tree_info routes trees to classes)."""
+    model = doc["learner"]["gradient_booster"]["model"]
+    info = model["tree_info"]
+    out = np.zeros((len(x), num_class), np.float64)
+    for t, tree in enumerate(model["trees"]):
+        one = _xgb_core_margin(
+            {"learner": {"gradient_booster": {"model": {"trees": [tree]}}}}, x
+        )
+        out[:, info[t]] += one
+    return out
+
+
+def test_import_golden_multiclass_tree_info_order():
+    """3-class softprob, 2 rounds: tree_info [0,1,2,0,1,2] must route each
+    tree's leaves into its class margin in round-major order."""
+    trees, info = [], []
+    for r in range(2):
+        for k in range(3):
+            v = 0.1 * (r + 1) + k  # distinct leaf per (round, class)
+            trees.append(_mk_tree(len(trees), [1, -1, -1], [2, -1, -1],
+                                  [0.0, -v, v], [0, 0, 0], [0, 0, 0]))
+            info.append(k)
+    doc = _mk_doc(trees, info, objective="multi:softprob", num_class="3",
+                  per_round=3)
+    back = RayXGBoostBooster.import_xgboost_json(doc)
+    assert back.params.num_class == 3
+    x = np.array([[1.0, 0, 0], [-1.0, 0, 0]], np.float32)
+    want = _xgb_core_margin_multi(doc, x, 3)
+    got = back.predict(x, output_margin=True)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    probs = back.predict(x)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-6)
+
+
+def test_import_golden_dart_weight_drop_scaling():
+    """dart: prediction must scale each tree by its weight_drop entry."""
+    t0 = _mk_tree(0, [1, -1, -1], [2, -1, -1], [0.0, -1.0, 1.0],
+                  [0, 0, 0], [0, 0, 0])
+    t1 = _mk_tree(1, [1, -1, -1], [2, -1, -1], [0.0, -10.0, 10.0],
+                  [1, 0, 0], [0, 0, 0])
+    doc = _mk_doc([t0, t1], [0, 0], booster="dart", weight_drop=[0.5, 0.25])
+    back = RayXGBoostBooster.import_xgboost_json(doc)
+    x = np.array([[1.0, 1.0, 0], [-1.0, -1.0, 0], [1.0, -1.0, 0]], np.float32)
+    # weighted sums: 0.5*t0 + 0.25*t1
+    want = np.array([0.5 + 2.5, -0.5 - 2.5, 0.5 - 2.5])
+    np.testing.assert_allclose(
+        back.predict(x, output_margin=True), want, atol=1e-6
+    )
+
+
+def test_import_golden_base_score_not_half():
+    """binary:logistic with base_score=0.2: the margin offset is
+    logit(0.2), not 0.2 — the transform real xgboost applies."""
+    t0 = _mk_tree(0, [1, -1, -1], [2, -1, -1], [0.0, -0.7, 0.7],
+                  [0, 0, 0], [0, 0, 0])
+    doc = _mk_doc([t0], [0], objective="binary:logistic", base_score="0.2")
+    back = RayXGBoostBooster.import_xgboost_json(doc)
+    x = np.array([[1.0, 0, 0], [-1.0, 0, 0]], np.float32)
+    logit = np.log(0.2 / 0.8)
+    want_margin = logit + np.array([0.7, -0.7])
+    np.testing.assert_allclose(
+        back.predict(x, output_margin=True), want_margin, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        back.predict(x), 1 / (1 + np.exp(-want_margin)), atol=1e-5
+    )
+
+
+# --- against REAL xgboost (CI leg installs it; skipped locally) ------------
+
+
+def test_real_xgboost_loads_our_export_with_parity(tmp_path):
+    xgb = pytest.importorskip("xgboost")
+    bst, x = _binary_model()
+    path = str(tmp_path / "ours.json")
+    bst.export_xgboost_json(path)
+    real = xgb.Booster(model_file=path)
+    dm = xgb.DMatrix(x)
+    np.testing.assert_allclose(
+        real.predict(dm, output_margin=True),
+        bst.predict(x, output_margin=True), atol=1e-4,
+    )
+    np.testing.assert_allclose(real.predict(dm), bst.predict(x), atol=1e-4)
+
+
+def test_real_xgboost_npt_export_parity(tmp_path):
+    """The sum-vs-average convention fix (ADVICE r4): real xgboost summing
+    our scaled leaves must reproduce our averaged prediction."""
+    xgb = pytest.importorskip("xgboost")
+    rng = np.random.RandomState(3)
+    x = rng.randn(200, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    bst = train({"objective": "reg:squarederror", "num_parallel_tree": 3,
+                 "subsample": 0.8, "max_depth": 3, "seed": 0},
+                RayDMatrix(x, y), 3, ray_params=RP)
+    path = str(tmp_path / "npt.json")
+    bst.export_xgboost_json(path)
+    real = xgb.Booster(model_file=path)
+    np.testing.assert_allclose(
+        real.predict(xgb.DMatrix(x), output_margin=True),
+        bst.predict(x, output_margin=True), atol=1e-4,
+    )
+
+
+def test_real_xgboost_model_imports_with_parity(tmp_path):
+    """A model REAL xgboost trained (hist, with missing values) must import
+    and predict identically here."""
+    xgb = pytest.importorskip("xgboost")
+    rng = np.random.RandomState(4)
+    x = rng.randn(300, 5).astype(np.float32)
+    x[rng.rand(300, 5) < 0.15] = np.nan  # exercise learned defaults
+    y = (np.nan_to_num(x[:, 0]) + 0.5 * np.nan_to_num(x[:, 1]) > 0).astype(
+        np.float32)
+    real = xgb.train(
+        {"objective": "binary:logistic", "max_depth": 4, "eta": 0.4,
+         "tree_method": "hist", "seed": 0},
+        xgb.DMatrix(x, label=y), num_boost_round=6,
+    )
+    path = str(tmp_path / "real.json")
+    real.save_model(path)
+    back = RayXGBoostBooster.import_xgboost_json(path)
+    np.testing.assert_allclose(
+        back.predict(x, output_margin=True),
+        real.predict(xgb.DMatrix(x), output_margin=True), atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        back.predict(x), real.predict(xgb.DMatrix(x)), atol=1e-4
+    )

@@ -3,8 +3,7 @@
 # Fired automatically by r5_watch.sh the moment the tunnel answers.
 # Order: crash bisection first (validates the 11M SCAN_MAX_CHUNK fix), then
 # the headline bench while the tunnel is known-good, then overhead
-# attribution, distributed predict, MSLR ranking, pallas fate, precision
-# quality. Commits results unattended.
+# attribution, distributed predict, MSLR ranking, precision quality. Commits results unattended.
 cd /root/repo
 export PYTHONPATH=/root/repo:${PYTHONPATH}
 export JAX_COMPILATION_CACHE_DIR=/tmp/jax_cache
@@ -22,7 +21,6 @@ run steady 2400 python tpu_logs/r3_steady.py
 run overhead 3600 python tpu_logs/r4_overhead.py
 run predict_bench 2400 python tests/release/benchmark_predict.py 1 1000000
 run mslr 3600 python tests/release/benchmark_ranking.py 1 100
-run pallas 2400 python tpu_logs/r3_pallas.py
 run int8_probe 1200 python tpu_logs/r4_int8_probe.py
 run quality 1800 python tpu_logs/quality_fast.py
 echo "R5 QUEUE ALL DONE $(date +%T)" >> $L/r5.log

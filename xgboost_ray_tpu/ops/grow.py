@@ -14,6 +14,20 @@ updated with pure gathers each level — no host round-trips, no sorting.
 The histogram allreduce point is the ``allreduce`` callable: identity on a
 single device, ``lax.psum(..., "actors")`` inside the shard_map round step —
 this is the exact spot where the reference relied on Rabit (SURVEY §5.8).
+
+Histogram impl choice (and the fate of the hand-written Pallas kernel):
+``scatter`` (segment-sum), ``onehot`` (one-hot matmul on the MXU), and
+``partition``/``mixed`` (node-contiguous presorted blocks; ``mixed`` =
+onehot at tiny fan-out, presorted beyond) are all XLA formulations.
+A hand-written Pallas presorted-histogram kernel shipped r2-r4 behind an
+opt-in flag and was DELETED in r5: on-chip v5e measurement (r2,
+tpu_logs/r2.log) showed it ~1.4x SLOWER per level than the identical-layout
+XLA einsum — the blocked one-hot matmul IS the idiomatic MXU formulation,
+XLA already fuses/tiles it, and the kernel's only remaining niche
+(high-bin scatter-bound shapes) is served by ``partition`` without custom
+code. It also rode the axon remote-compile helper, which hung/died
+repeatedly on the tunnel. Verdict: a kernel that loses to the compiler on
+its own target hardware is dead weight; the learning stays here.
 """
 
 import dataclasses
@@ -29,6 +43,7 @@ from xgboost_ray_tpu.ops.histogram import (
     select_small_child_rows,
     node_sums,
     update_partition_order,
+    zero_phantom_missing,
 )
 from xgboost_ray_tpu.ops.split import (
     SplitParams,
@@ -249,7 +264,7 @@ def build_tree(
 
     # partition-based impls keep rows sorted by node across levels with an
     # O(N) stable segment split (no per-level argsort)
-    track_order = cfg.hist_impl in ("partition", "mixed", "pallas")
+    track_order = cfg.hist_impl in ("partition", "mixed")
     order = counts = None
     if track_order:
         order = jnp.arange(n, dtype=jnp.int32)
@@ -259,26 +274,6 @@ def build_tree(
     for d in range(cfg.max_depth):
         n_nodes = 1 << d
         base = n_nodes - 1
-
-        def _use_pallas(explicit: bool) -> bool:
-            """Kernel is TPU-only (pltpu grid spec); other backends use the
-            identical-layout XLA einsum. The measured kernel is ~1.4x the
-            einsum per level, but compiling it rides the axon remote-compile
-            helper, which hangs/dies often enough (observed repeatedly on the
-            v5e tunnel) that `mixed`/auto only uses it when the user opts in
-            via hist_impl="pallas" or RXGB_ENABLE_PALLAS=1."""
-            import os
-
-            if os.environ.get("RXGB_DISABLE_PALLAS"):
-                return False
-            if not explicit and not os.environ.get("RXGB_ENABLE_PALLAS"):
-                return False
-            try:
-                from xgboost_ray_tpu.ops import hist_pallas as hp
-
-                return hp.PALLAS_AVAILABLE and jax.default_backend() == "tpu"
-            except Exception:
-                return False
 
         def _build(gh_b, pos_b, order_b, counts_b, nn, rows_sel=None):
             """One histogram build over nn node slots with the configured impl.
@@ -295,17 +290,10 @@ def build_tree(
             the bucket is exactly zero, so it is zeroed to keep phantom
             missing mass from steering the learned default direction.
             """
-            return _zero_phantom_missing(
-                _build_raw(gh_b, pos_b, order_b, counts_b, nn, rows_sel)
+            return zero_phantom_missing(
+                _build_raw(gh_b, pos_b, order_b, counts_b, nn, rows_sel),
+                feat_has_missing,
             )
-
-        def _zero_phantom_missing(h):
-            if feat_has_missing is None:
-                return h
-            # h: [nn, F, nbt, 2]; zero the last (missing) bucket where the
-            # feature provably has no missing values
-            keep = feat_has_missing[None, :, None].astype(h.dtype)
-            return h.at[:, :, -1, :].multiply(keep)
 
         def _build_raw(gh_b, pos_b, order_b, counts_b, nn, rows_sel=None):
             def gathered():
@@ -317,31 +305,12 @@ def build_tree(
 
             order_in = order_b if rows_sel is None else rows_sel
 
-            def presorted(use_pallas: bool):
-                if use_pallas:
-                    from xgboost_ray_tpu.ops import hist_pallas as hp
-
-                    return hp.hist_pallas_presorted(
-                        bins, gh_b, order_in, counts_b, nn, nbt,
-                        precision=cfg.hist_precision,
-                    )
+            def presorted():
                 return hist_partition_presorted(
                     bins, gh_b, order_in, counts_b, nn, nbt,
                     precision=cfg.hist_precision,
                 )
 
-            if cfg.hist_impl == "pallas":
-                if not _use_pallas(explicit=True):
-                    # no silent fallback (mirrors build_histogram): a user
-                    # explicitly opting into the kernel must not silently get
-                    # a different impl with different perf
-                    raise RuntimeError(
-                        "hist_impl='pallas' requested but the Pallas TPU "
-                        "kernel cannot run here (kernel unavailable, non-TPU "
-                        "backend, or RXGB_DISABLE_PALLAS set); use "
-                        "hist_impl='auto'."
-                    )
-                return presorted(True)
             if cfg.hist_impl == "mixed":
                 # measured on v5e (1M x 28 x 256): one-hot wins at tiny node
                 # fan-out (cost scales with nn), the fused block kernel is
@@ -351,9 +320,9 @@ def build_tree(
                     return hist_onehot(bins_g, gh_g, pos_b, nn, nbt,
                                        chunk=cfg.hist_chunk,
                                        precision=cfg.hist_precision)
-                return presorted(_use_pallas(explicit=False))
+                return presorted()
             if track_order and cfg.hist_impl == "partition":
-                return presorted(False)
+                return presorted()
             bins_g, gh_g = gathered()
             return build_histogram(
                 bins_g, gh_g, pos_b, nn, nbt, impl=cfg.hist_impl,

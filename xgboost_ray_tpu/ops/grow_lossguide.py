@@ -35,7 +35,7 @@ from xgboost_ray_tpu.ops.grow import (
     empty_tree,
     route_right_binned,
 )
-from xgboost_ray_tpu.ops.histogram import hist_onehot
+from xgboost_ray_tpu.ops.histogram import hist_onehot, zero_phantom_missing
 from xgboost_ray_tpu.ops.split import find_splits, leaf_weight
 
 
@@ -59,18 +59,12 @@ def build_tree_lossguide(
     n_ent = 2 * leaves - 1
     cat_mask = cat_mask_const(cfg.cat_features, num_features)
 
-    def _zero_phantom_missing(h):
-        if feat_has_missing is None:
-            return h
-        keep = feat_has_missing[None, :, None].astype(h.dtype)
-        return h.at[:, :, -1, :].multiply(keep)
-
     def _hist(gh_b, pos_b, nn):
         h = hist_onehot(
             bins, gh_b, pos_b, nn, nbt,
             chunk=cfg.hist_chunk, precision=cfg.hist_precision,
         )
-        return _zero_phantom_missing(allreduce(h))
+        return zero_phantom_missing(allreduce(h), feat_has_missing)
 
     tree = empty_tree(heap)
     pos = jnp.zeros((n,), jnp.int32)

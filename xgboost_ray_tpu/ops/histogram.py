@@ -417,6 +417,19 @@ def node_sums(gh: jnp.ndarray, pos: jnp.ndarray, n_nodes: int) -> jnp.ndarray:
     return out.at[pos].add(gh)
 
 
+def zero_phantom_missing(h: jnp.ndarray, feat_has_missing) -> jnp.ndarray:
+    """h: [nn, F, nbt, 2]; zero the (subtraction-reconstructed) missing
+    bucket where the feature provably has NO missing values — under
+    hist_precision="fast" the bf16 rounding residue of the regular bins
+    lands in that bucket, and phantom missing mass must not steer the
+    learned default direction. Shared by both growers (depthwise build_tree
+    and the lossguide scan)."""
+    if feat_has_missing is None:
+        return h
+    keep = feat_has_missing[None, :, None].astype(h.dtype)
+    return h.at[:, :, -1, :].multiply(keep)
+
+
 def build_histogram(
     bins: jnp.ndarray,
     gh: jnp.ndarray,
@@ -441,16 +454,8 @@ def build_histogram(
                                chunk=chunk, precision=precision)
         return hist_partition(bins, gh, pos, n_nodes, n_bins_total,
                               precision=precision)
-    if impl == "pallas":
-        # no silent fallback: a user explicitly opting into the kernel must
-        # not silently get a different impl with different perf (VERDICT r2)
-        from xgboost_ray_tpu.ops import hist_pallas
-
-        if not hist_pallas.PALLAS_AVAILABLE:
-            raise RuntimeError(
-                "hist_impl='pallas' requested but the Pallas TPU kernel is "
-                "unavailable on this backend; use hist_impl='auto'."
-            )
-        return hist_pallas.hist_pallas(bins, gh, pos, n_nodes, n_bins_total,
-                                       precision=precision)
+    if impl != "scatter":
+        # defense-in-depth behind parse_params: a typo'd or removed impl
+        # (e.g. the deleted 'pallas') must not silently become scatter
+        raise ValueError(f"unknown histogram impl {impl!r}")
     return hist_scatter(bins, gh, pos, n_nodes, n_bins_total)
