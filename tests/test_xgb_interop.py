@@ -492,3 +492,31 @@ def test_real_xgboost_model_imports_with_parity(tmp_path):
     np.testing.assert_allclose(
         back.predict(x), real.predict(xgb.DMatrix(x)), atol=1e-4
     )
+
+
+def test_real_xgboost_loads_gblinear_export(tmp_path):
+    xgb = pytest.importorskip("xgboost")
+    from xgboost_ray_tpu.linear import RayLinearBooster
+
+    rng = np.random.RandomState(5)
+    x = rng.randn(200, 4).astype(np.float32)
+    y = (x @ np.array([1.0, -1.0, 0.5, 0.0], np.float32) + 0.3).astype(
+        np.float32)
+    bst = train({"objective": "reg:squarederror", "booster": "gblinear",
+                 "eta": 0.5}, RayDMatrix(x, y), 15, ray_params=RP)
+    path = str(tmp_path / "lin.json")
+    bst.save_model(path)
+    real = xgb.Booster(model_file=path)
+    np.testing.assert_allclose(
+        real.predict(xgb.DMatrix(x)), bst.predict(x), atol=1e-4
+    )
+    # and a real xgboost gblinear model imports here
+    real2 = xgb.train({"objective": "reg:squarederror",
+                       "booster": "gblinear", "eta": 0.5},
+                      xgb.DMatrix(x, label=y), num_boost_round=10)
+    path2 = str(tmp_path / "real_lin.json")
+    real2.save_model(path2)
+    back = RayLinearBooster.load_model(path2)
+    np.testing.assert_allclose(
+        back.predict(x), real2.predict(xgb.DMatrix(x)), atol=1e-4
+    )

@@ -459,13 +459,24 @@ def _deserialize_booster(raw: Optional[bytes]) -> Optional[RayXGBoostBooster]:
 
 
 def _coerce_model(model) -> Optional[RayXGBoostBooster]:
+    from xgboost_ray_tpu.linear import RayLinearBooster
+
     if model is None:
         return None
-    if isinstance(model, RayXGBoostBooster):
+    if isinstance(model, (RayXGBoostBooster, RayLinearBooster)):
         return model
     if isinstance(model, bytes):
         return _deserialize_booster(model)
     if isinstance(model, str):
+        # dispatch on the document's own booster name so a malformed tree
+        # file fails with ITS parse error, not a misleading gblinear one
+        import json as _json
+
+        with open(model) as f:
+            doc = _json.load(f)
+        name = doc.get("learner", {}).get("gradient_booster", {}).get("name")
+        if name == "gblinear":
+            return RayLinearBooster.import_xgboost_json(doc)
         return RayXGBoostBooster.load_model(model)
     raise ValueError(f"Cannot interpret xgb_model of type {type(model)}")
 
@@ -616,19 +627,32 @@ def _train(
             evals_in.append((eshards, name))
     init_booster = _deserialize_booster(state.checkpoint.value)
     trial_devices = _resolve_mesh_devices(len(alive), ray_params)
-    engine = TpuEngine(
-        train_shards,
-        parsed,
-        num_actors=len(alive),
-        evals=evals_in,
-        devices=trial_devices,
-        init_booster=init_booster,
-        feature_names=dtrain.resolved_feature_names,
-        total_rounds=boost_rounds_left,
-        feature_weights=dtrain.feature_weights,
-        feature_types=dtrain.resolved_feature_types,
-        categories=train_cats,
-    )
+    if parsed.booster == "gblinear":
+        from xgboost_ray_tpu.linear import LinearEngine
+
+        engine = LinearEngine(
+            train_shards,
+            parsed,
+            num_actors=len(alive),
+            evals=evals_in,
+            devices=trial_devices,
+            init_booster=init_booster,
+            feature_names=dtrain.resolved_feature_names,
+        )
+    else:
+        engine = TpuEngine(
+            train_shards,
+            parsed,
+            num_actors=len(alive),
+            evals=evals_in,
+            devices=trial_devices,
+            init_booster=init_booster,
+            feature_names=dtrain.resolved_feature_names,
+            total_rounds=boost_rounds_left,
+            feature_weights=dtrain.feature_weights,
+            feature_types=dtrain.resolved_feature_types,
+            categories=train_cats,
+        )
     total_n = sum(a.local_n(dtrain) for a in alive)
     state.additional_results["total_n"] = total_n
 
@@ -1355,6 +1379,7 @@ def _predict_shards_spmd(model, shards, predict_kwargs, bm_shards=None,
     if (
         not ENV.SPMD_PREDICT
         or any(predict_kwargs.get(kw) for kw in unsupported)
+        or not hasattr(model, "predict_margin_spmd")  # gblinear: host matmul
     ):
         return None
     if jax.process_count() > 1:

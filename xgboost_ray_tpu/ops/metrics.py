@@ -250,7 +250,27 @@ def is_device_metric(name: str, has_groups: bool, has_bounds: bool = False) -> b
         return has_groups
     if name == "aft-nloglik":
         return has_bounds
+    if name == "cox-nloglik":
+        return True
     return False
+
+
+def cox_nloglik_global(m, label, weight):
+    """(num, den) of the Breslow negative partial log-likelihood — the
+    survival:cox default metric. Risk sets span every shard, so the rows
+    are all_gathered and the already-global scalars returned un-psummed
+    (identical on every shard). Outside shard_map the local arrays are the
+    global arrays."""
+    from xgboost_ray_tpu.ops.objectives import (
+        cox_risk_terms,
+        gather_global_rows,
+    )
+
+    (mg, lg, wg), _ = gather_global_rows(m, label, weight)
+    _, ev, _, _, logD = cox_risk_terms(mg, lg, wg)
+    num = jnp.sum(ev * (logD - mg))
+    den = jnp.sum(ev)
+    return num, den
 
 
 def device_metric_contrib(name, margin, label, weight, group_rows, psum,
@@ -273,6 +293,12 @@ def device_metric_contrib(name, margin, label, weight, group_rows, psum,
             distribution=aft_distribution, sigma=aft_sigma,
         )
         return psum(num), psum(den)
+    if name == "cox-nloglik":
+        # cross-shard risk sets: gather, compute the GLOBAL value on every
+        # shard (replicated), and return it WITHOUT psum — it is already
+        # the merged scalar
+        num, den = cox_nloglik_global(margin[:, 0], label, weight)
+        return num, den
     if base in _ELEMENTWISE:
         num, den = elementwise_contrib(
             name, margin, label, weight,
@@ -464,6 +490,11 @@ def compute_metric(
         if weight is None or np.size(weight) == 0
         else np.asarray(weight, np.float32)
     )
+    if name == "cox-nloglik":
+        num, den = cox_nloglik_global(
+            jnp.asarray(margin[:, 0]), jnp.asarray(label), jnp.asarray(weight)
+        )
+        return float(num) / max(float(den), 1e-12)
     base, arg = parse_metric_name(name)
     if base in _ELEMENTWISE:
         num, den = elementwise_contrib(

@@ -12,6 +12,7 @@ xgboost semantics exactly). Ranking objectives live in ``ranking.py``.
 """
 
 import dataclasses
+import math
 from typing import Callable, Optional, Tuple
 
 import jax
@@ -269,6 +270,107 @@ RANKING_OBJECTIVES = ("rank:pairwise", "rank:ndcg", "rank:map")
 SURVIVAL_OBJECTIVES = ("survival:aft",)
 
 
+def gather_global_rows(*arrays):
+    """Inside shard_map: all_gather each [n_local] array over the mesh axis
+    into its [n_global] form (plus this shard's row offset). Outside
+    shard_map the locals ARE the globals (offset 0). One home for the
+    try/except idiom the cross-shard objectives/metrics (cox) share."""
+    try:
+        out = tuple(
+            jax.lax.all_gather(a, "actors").reshape(-1) for a in arrays
+        )
+        offset = jax.lax.axis_index("actors") * arrays[0].shape[0]
+        return out, offset
+    except NameError:  # not under shard_map
+        return arrays, 0
+
+
+def cox_risk_terms(m, label, w):
+    """Shared Breslow machinery for survival:cox grad/hess and cox-nloglik.
+
+    ``label``: time-to-event; NEGATIVE values are right-censored at |label|
+    (the xgboost survival:cox convention). Returns per-row
+    (r, ev, S1, S2, logD) over the GLOBAL arrays passed in, where
+    r_i = w_i * exp(m_i - M) (stabilized; M cancels in grad/hess),
+    ev_i = w_i * 1[event], D(tau) = sum of r over t_j >= tau (ties share
+    one risk set via searchsorted), S1_i = sum over events with
+    t_k <= t_i of ev_k / D_k, S2_i the same with D_k^2, and
+    logD_i = log D(t_i) + M (true scale, for the nloglik metric).
+
+    Weighted Breslow partial likelihood:
+      -logL = -sum_k ev_k * (m_k - log D_k)
+      grad_i = r_i * S1_i - ev_i
+      hess_i = r_i * S1_i - r_i^2 * S2_i
+    """
+    t = jnp.abs(label)
+    delta = (label > 0).astype(jnp.float32)
+    mM = jnp.max(jnp.where(w > 0, m, -jnp.inf))
+    mM = jnp.where(jnp.isfinite(mM), mM, 0.0)
+    r = w * jnp.exp(m - mM)
+    ev = w * delta
+
+    neg_t = -t
+    order = jnp.argsort(neg_t)  # descending time
+    neg_ts = neg_t[order]
+    cum_r = jnp.cumsum(r[order])
+    # count of rows with t_j >= tau, tie-inclusive
+    cnt_ge = jnp.searchsorted(neg_ts, neg_t, side="right")
+    D = cum_r[jnp.maximum(cnt_ge - 1, 0)]
+    D = jnp.maximum(D, 1e-38)
+    logD = jnp.log(D) + mM
+
+    # per-event 1/D and 1/D^2 in sorted order; prefix sums exclude the
+    # events with t_k > t_i (they occupy the first cnt_gt_i sorted slots)
+    D_sorted = D[order]
+    evs = ev[order]
+    term1 = evs / D_sorted
+    term2 = evs / (D_sorted * D_sorted)
+    pref1 = jnp.concatenate([jnp.zeros((1,)), jnp.cumsum(term1)])
+    pref2 = jnp.concatenate([jnp.zeros((1,)), jnp.cumsum(term2)])
+    cnt_gt = jnp.searchsorted(neg_ts, neg_t, side="left")
+    S1 = pref1[-1] - pref1[cnt_gt]
+    S2 = pref2[-1] - pref2[cnt_gt]
+    return r, ev, S1, S2, logD
+
+
+def _make_cox() -> Objective:
+    """survival:cox — Breslow partial likelihood on right-censored times.
+
+    The risk set of every event spans ALL rows, so inside the sharded round
+    step the per-shard rows are all_gathered over the mesh axis, the global
+    grad/hess computed (replicated work, one O(N log N) sort), and this
+    shard's slice taken back. Outside shard_map (unit tests, host paths)
+    the local arrays ARE the global arrays. Reference surface: xgboost's
+    CoxRegression objective, passed through at xgboost_ray/main.py:745-752.
+    """
+
+    def _global_gh(m, label, w):
+        r, ev, S1, S2, _ = cox_risk_terms(m, label, w)
+        g = r * S1 - ev
+        h = jnp.maximum(r * S1 - r * r * S2, 1e-16)
+        return g, h
+
+    def gh(margin, label, weight):
+        m = margin[:, 0]
+        shard_n = m.shape[0]
+        (mg, lg, wg), offset = gather_global_rows(m, label, weight)
+        g, h = _global_gh(mg, lg, wg)
+        if mg.shape[0] != shard_n:  # gathered: slice this shard's rows back
+            g = jax.lax.dynamic_slice(g, (offset,), (shard_n,))
+            h = jax.lax.dynamic_slice(h, (offset,), (shard_n,))
+        return g[:, None], h[:, None]
+
+    return Objective(
+        name="survival:cox",
+        grad_hess=gh,
+        transform=lambda m: jnp.exp(m[:, 0]),  # hazard-ratio scale
+        default_metric="cox-nloglik",
+        base_score_to_margin=lambda s: math.log(max(float(s), 1e-16)),
+        default_base_score=0.5,
+        output_kind="value",
+    )
+
+
 def get_objective(
     name: str,
     num_class: int = 0,
@@ -310,6 +412,8 @@ def get_objective(
         return _make_gamma()
     if name == "reg:tweedie":
         return _make_tweedie(tweedie_variance_power)
+    if name == "survival:cox":
+        return _make_cox()
     if name in RANKING_OBJECTIVES:
         from xgboost_ray_tpu.ops import ranking
 

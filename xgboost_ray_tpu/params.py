@@ -206,6 +206,10 @@ def parse_params(params: Optional[Dict[str, Any]]) -> TrainParams:
         raise ValueError(
             "`grow_colmaker` updater doesn't support distributed training."
         )
+    feature_selector = params.pop("feature_selector", None)
+    # gblinear's LinearTrainParam defaults reg_lambda to 0 (the tree
+    # booster's default is 1); remember whether the user set it explicitly
+    had_lambda = any(k in params for k in ("lambda", "reg_lambda"))
 
     em = params.pop("eval_metric", None)
     if em is not None:
@@ -301,11 +305,32 @@ def parse_params(params: Optional[Dict[str, Any]]) -> TrainParams:
         raise ValueError("max_bin must be in (1, 1024]")
     if out.objective.startswith("multi:") and out.num_class < 2:
         raise ValueError("multi:* objectives require num_class >= 2")
-    if out.booster not in ("gbtree", "dart"):
+    if out.booster not in ("gbtree", "dart", "gblinear"):
         raise ValueError(
-            f"Unsupported booster: {out.booster!r} (gbtree or dart; gblinear "
-            f"has no tree to build)."
+            f"Unsupported booster: {out.booster!r} (gbtree, dart, or "
+            f"gblinear)."
         )
+    if out.booster == "gblinear":
+        if not had_lambda:
+            out.reg_lambda = 0.0  # xgboost LinearTrainParam default
+        if updater is not None and str(updater) not in ("shotgun",
+                                                        "coord_descent"):
+            raise ValueError(
+                f"gblinear updater must be 'shotgun' or 'coord_descent'; "
+                f"got {updater!r}"
+            )
+        if feature_selector is not None and str(feature_selector) != "cyclic":
+            raise NotImplementedError(
+                "gblinear feature_selector other than 'cyclic' is not "
+                "supported (both updaters run the deterministic cyclic "
+                "pass here)."
+            )
+        if out.grow_policy == "lossguide" or out.monotone_constraints or \
+                out.interaction_constraints:
+            raise NotImplementedError(
+                "tree growth options (grow_policy/constraints) do not apply "
+                "to booster='gblinear'."
+            )
     if out.booster == "dart":
         if out.num_parallel_tree != 1:
             raise ValueError("dart does not support num_parallel_tree > 1")
