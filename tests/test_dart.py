@@ -115,8 +115,9 @@ def test_dart_invalid_params():
     with pytest.raises(ValueError, match="num_parallel_tree"):
         train(dict(_BASE, booster="dart", num_parallel_tree=4),
               RayDMatrix(x, y), 3, ray_params=RayParams(num_actors=2))
+    # gblinear is a real booster since r5; unknown names still rejected
     with pytest.raises(ValueError, match="booster"):
-        train(dict(_BASE, booster="gblinear"),
+        train(dict(_BASE, booster="gbforest"),
               RayDMatrix(x, y), 3, ray_params=RayParams(num_actors=2))
 
 

@@ -206,15 +206,26 @@ def test_classifier_resume_from_model(tmp_path):
     assert err_b <= err_a + 1e-9
 
 
-def test_constraint_parameters_rejected():
-    # monotone constraints: explicit rejection, not a silent no-op
-    # (reference test_sklearn.py:957-988 trains them; our compat table
-    # documents the NotImplementedError)
+def test_constraint_parameters_through_sklearn():
+    # monotone constraints flow through the estimator facade and are
+    # actually enforced (reference test_sklearn.py:957-988 trains them;
+    # r5 implements them in the split scan — tests/test_constraints.py
+    # pins the semantics, this pins the sklearn plumbing)
     x, y = _bc()
-    clf = RayXGBClassifier(n_estimators=2, monotone_constraints="(1,-1)",
+    clf = RayXGBClassifier(n_estimators=4, max_depth=3,
+                           monotone_constraints="(1,)", ray_params=_RP)
+    clf.fit(x, y)
+    base = np.median(x, axis=0).astype(np.float32)
+    grid = np.tile(base, (32, 1))
+    lo, hi = x[:, 0].min(), x[:, 0].max()
+    grid[:, 0] = np.linspace(lo, hi, 32, dtype=np.float32)
+    margins = clf.get_booster().predict(grid, output_margin=True)
+    assert (np.diff(margins) >= -1e-5).all()
+    # malformed constraint values still rejected loudly
+    bad = RayXGBClassifier(n_estimators=2, monotone_constraints="(2,)",
                            ray_params=_RP)
-    with pytest.raises(NotImplementedError, match="monotone"):
-        clf.fit(x, y)
+    with pytest.raises(ValueError, match="-1, 0, or"):
+        bad.fit(x, y)
 
 
 def test_multiclass_num_class_inferred():

