@@ -1375,14 +1375,30 @@ def _predict_shards_spmd(model, shards, predict_kwargs, bm_shards=None,
     """
     import jax
 
-    unsupported = ("pred_contribs", "pred_interactions", "pred_leaf")
     if (
         not ENV.SPMD_PREDICT
-        or any(predict_kwargs.get(kw) for kw in unsupported)
         or not hasattr(model, "predict_margin_spmd")  # gblinear: host matmul
     ):
         return None
+    special = None  # non-margin outputs ride their own SPMD kernels
+    if predict_kwargs.get("pred_interactions"):
+        special = "interactions"
+        if predict_kwargs.get("approx_contribs"):
+            import warnings
+
+            # mirror the host path's signal that the flag is ignored
+            warnings.warn(
+                "approx_contribs=True is ignored with pred_interactions: "
+                "only the exact interactions kernel is implemented."
+            )
+    elif predict_kwargs.get("pred_contribs"):
+        special = ("contribs_approx" if predict_kwargs.get("approx_contribs")
+                   else "contribs")
+    elif predict_kwargs.get("pred_leaf"):
+        special = "leaf"
     if jax.process_count() > 1:
+        if special:
+            return None  # host loop: special outputs are single-process SPMD
         # multi-process world: the full global mesh participates; this
         # process's shards are its local rows (same contract as training).
         devices = list(jax.devices())
@@ -1417,6 +1433,14 @@ def _predict_shards_spmd(model, shards, predict_kwargs, bm_shards=None,
     iteration_range = predict_kwargs.get("iteration_range")
     if iteration_range is not None and iteration_range != (0, 0):
         booster = model.slice_rounds(iteration_range[0], iteration_range[1])
+    bounds = np.cumsum(sizes)[:-1]
+    if special:
+        res = booster.predict_special_spmd(
+            x_all, devices, special,
+            ntree_limit=int(predict_kwargs.get("ntree_limit", 0) or 0),
+            base_margin=base_margin,
+        )
+        return np.split(res, bounds, axis=0)
     margin = booster.predict_margin_spmd(
         x_all, devices,
         ntree_limit=int(predict_kwargs.get("ntree_limit", 0) or 0),
@@ -1425,7 +1449,6 @@ def _predict_shards_spmd(model, shards, predict_kwargs, bm_shards=None,
     pred = booster._margin_to_prediction(
         margin, bool(predict_kwargs.get("output_margin"))
     )
-    bounds = np.cumsum(sizes)[:-1]
     return np.split(pred, bounds, axis=0)
 
 

@@ -72,15 +72,14 @@ def combine_data(sharding: RayShardingMode, data: Iterable) -> np.ndarray:
         return np.array([])
     if sharding == RayShardingMode.BATCH:
         return np.concatenate(parts, axis=0)
-    # INTERLEAVED: ranks may be off by one for uneven splits
+    # INTERLEAVED: ranks may be off by one for uneven splits. Stacking on a
+    # new axis 1 then flattening restores row order for ANY trailing shape
+    # (scalars, softprob [K], SHAP [F+1] / [K,F+1], interactions
+    # [F+1,F+1], leaf indices [T]).
     min_len = min(len(d) for d in parts)
-    if parts[0].ndim == 1:
-        res = np.ravel(np.column_stack([d[:min_len] for d in parts]))
-    else:
-        n_cols = parts[0].shape[1]
-        res = np.hstack([d[:min_len] for d in parts]).reshape(
-            len(parts) * min_len, n_cols
-        )
+    res = np.stack([d[:min_len] for d in parts], axis=1).reshape(
+        (len(parts) * min_len,) + parts[0].shape[1:]
+    )
     tails = [d[min_len:] for d in parts if len(d) > min_len]
     if tails:
         res = np.concatenate([res] + tails, axis=0)

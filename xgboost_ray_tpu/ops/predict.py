@@ -508,6 +508,7 @@ def predict_interactions(
     return out
 
 
+@functools.partial(jax.jit, static_argnames=("max_depth", "cat_features"))
 def predict_leaf_index(
     forest: Tree, x: jnp.ndarray, max_depth: int, cat_features: tuple = ()
 ) -> jnp.ndarray:
