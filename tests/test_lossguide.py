@@ -133,3 +133,27 @@ def test_grow_policy_validation():
         train({"objective": "reg:squarederror", "grow_policy": "lossguide",
                "hist_impl": "partition"}, RayDMatrix(x, y), 1,
               ray_params=RP1)
+
+
+def test_lossguide_with_missing_categorical_and_multiclass():
+    """Feature-combination hardening: lossguide routing must honor the
+    missing bucket's learned default and one-vs-rest categorical splits,
+    and the engine's per-class tree loop composes with the scan grower."""
+    rng = np.random.RandomState(8)
+    n = 500
+    y = rng.randint(0, 3, n).astype(np.float32)
+    x = np.zeros((n, 3), np.float32)
+    x[:, 0] = y + 0.3 * rng.randn(n)  # numeric, informative
+    x[:, 1] = rng.randint(0, 4, n)  # categorical codes; partially informative
+    x[y == 2, 1] = 3
+    x[rng.rand(n) < 0.2, 0] = np.nan  # missing values
+    bst = train({"objective": "multi:softprob", "num_class": 3,
+                 "grow_policy": "lossguide", "max_leaves": 8,
+                 "max_depth": 5, "eta": 0.4, "seed": 0},
+                RayDMatrix(x, y, feature_types=["q", "c", "q"]), 8,
+                ray_params=RP2)
+    p = bst.predict(x)
+    assert p.shape == (n, 3)
+    assert (p.argmax(axis=1) == y).mean() > 0.8
+    for count, _ in _leaf_stats(bst):
+        assert count <= 8
