@@ -149,3 +149,32 @@ def test_gblinear_validation_errors():
         train({"objective": "reg:squarederror", "booster": "gblinear",
                "monotone_constraints": "(1,0,0)"}, RayDMatrix(x, y), 1,
               ray_params=RP1)
+
+
+def test_gblinear_launcher_checkpoint_roundtrip(tmp_path):
+    """The launcher's canonical checkpoint/resume helpers must round-trip a
+    gblinear model (they dispatch on the document's booster schema)."""
+    from xgboost_ray_tpu.launcher import (
+        load_round_checkpoint,
+        save_round_checkpoint,
+    )
+
+    x, y, _ = _lin_data(seed=8)
+    bst = train({"objective": "reg:squarederror", "booster": "gblinear",
+                 "eta": 0.5}, RayDMatrix(x, y), 6, ray_params=RP1)
+    path = str(tmp_path / "lin_ckpt.json")
+    save_round_checkpoint(bst, path, 5)
+    back, done = load_round_checkpoint(path)
+    assert isinstance(back, RayLinearBooster)
+    assert done == 6  # from the model itself (num_boosted_rounds)
+    np.testing.assert_allclose(back.predict(x), bst.predict(x), atol=1e-6)
+
+
+def test_gblinear_rejects_categorical_features():
+    x = np.random.RandomState(0).randn(60, 3).astype(np.float32)
+    x[:, 0] = np.random.RandomState(1).randint(0, 4, 60)
+    y = x[:, 1].astype(np.float32)
+    with pytest.raises(NotImplementedError, match="categorical"):
+        train({"objective": "reg:squarederror", "booster": "gblinear"},
+              RayDMatrix(x, y, feature_types=["c", "q", "q"]), 2,
+              ray_params=RP1)

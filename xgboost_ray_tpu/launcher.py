@@ -126,17 +126,31 @@ def load_round_checkpoint(path: Optional[str]) -> Tuple[Optional[Any], int]:
     make the resumed world recount."""
     if not path or not os.path.exists(path):
         return None, 0
-    from xgboost_ray_tpu.models.booster import RayXGBoostBooster
+    import json
 
-    booster = RayXGBoostBooster.load_model(path)
+    with open(path) as f:
+        doc = json.load(f)
+    # dispatch on the document's booster (gblinear checkpoints carry the
+    # xgboost gblinear learner schema, trees our native format)
+    name = doc.get("learner", {}).get("gradient_booster", {}).get("name")
+    if name == "gblinear":
+        from xgboost_ray_tpu.linear import RayLinearBooster
+
+        booster = RayLinearBooster.import_xgboost_json(doc)
+    else:
+        from xgboost_ray_tpu.models.booster import RayXGBoostBooster
+
+        booster = RayXGBoostBooster._from_dict(doc)
     return booster, booster.num_boosted_rounds()
 
 
 def _tail(path: str, limit: int = 4000) -> str:
     try:
-        with open(path, "r", errors="replace") as f:
-            data = f.read()
-        return data[-limit:]
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - limit))
+            return f.read().decode("utf-8", errors="replace")
     except OSError:
         return ""
 

@@ -243,7 +243,16 @@ class LinearEngine:
 
     def __init__(self, shards, params: TrainParams, num_actors: int,
                  evals=None, devices=None, init_booster=None,
-                 feature_names=None, **_ignored):
+                 feature_names=None, feature_types=None, **_ignored):
+        from xgboost_ray_tpu.params import cat_feature_indices
+
+        if cat_feature_indices(feature_types):
+            raise NotImplementedError(
+                "categorical features with booster='gblinear' are not "
+                "supported (a single linear coefficient on category CODES "
+                "would silently misread them as ordinal); one-hot encode "
+                "the columns or use a tree booster."
+            )
         from xgboost_ray_tpu.engine import _concat_shards
         from xgboost_ray_tpu.ops.ranking import RankingObjective
         from xgboost_ray_tpu.ops.survival import SurvivalObjective

@@ -468,8 +468,9 @@ def _coerce_model(model) -> Optional[RayXGBoostBooster]:
     if isinstance(model, bytes):
         return _deserialize_booster(model)
     if isinstance(model, str):
-        # dispatch on the document's own booster name so a malformed tree
-        # file fails with ITS parse error, not a misleading gblinear one
+        # parse ONCE, dispatch on the document's own booster name (a
+        # malformed tree file then fails with ITS parse error, not a
+        # misleading gblinear one; no double I/O on big forests)
         import json as _json
 
         with open(model) as f:
@@ -477,7 +478,7 @@ def _coerce_model(model) -> Optional[RayXGBoostBooster]:
         name = doc.get("learner", {}).get("gradient_booster", {}).get("name")
         if name == "gblinear":
             return RayLinearBooster.import_xgboost_json(doc)
-        return RayXGBoostBooster.load_model(model)
+        return RayXGBoostBooster._from_dict(doc)
     raise ValueError(f"Cannot interpret xgb_model of type {type(model)}")
 
 
@@ -638,6 +639,7 @@ def _train(
             devices=trial_devices,
             init_booster=init_booster,
             feature_names=dtrain.resolved_feature_names,
+            feature_types=dtrain.resolved_feature_types,
         )
     else:
         engine = TpuEngine(

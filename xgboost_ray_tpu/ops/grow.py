@@ -238,9 +238,15 @@ def build_tree(
     mono_on = any(int(c) != 0 for c in cfg.monotone_constraints)
     mono_arr = lower = upper = None
     if mono_on:
-        mc = list(cfg.monotone_constraints)[:num_features]
-        mc += [0] * (num_features - len(mc))
-        mono_arr = jnp.asarray(mc, jnp.float32)
+        # the engine validates + zero-pads to exactly num_features
+        # (engine.py constraint block); keep one normalization layer
+        if len(cfg.monotone_constraints) != num_features:
+            raise ValueError(
+                f"monotone_constraints length "
+                f"{len(cfg.monotone_constraints)} != {num_features} features"
+                f" (pad with 0 for unconstrained columns)."
+            )
+        mono_arr = jnp.asarray(cfg.monotone_constraints, jnp.float32)
         lower = jnp.full((1,), -jnp.inf, jnp.float32)
         upper = jnp.full((1,), jnp.inf, jnp.float32)
 

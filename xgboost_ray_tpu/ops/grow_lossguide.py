@@ -60,6 +60,10 @@ def build_tree_lossguide(
     cat_mask = cat_mask_const(cfg.cat_features, num_features)
 
     def _hist(gh_b, pos_b, nn):
+        # node totals downstream are read from the zeroed histogram's
+        # feature-0 row, so under hist_precision="fast" they carry the
+        # regular bins' bf16 rounding — the SAME accepted contract as the
+        # depthwise grower's node_gh (see ops/grow.py's node_gh comment)
         h = hist_onehot(
             bins, gh_b, pos_b, nn, nbt,
             chunk=cfg.hist_chunk, precision=cfg.hist_precision,
