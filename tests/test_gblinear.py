@@ -178,3 +178,23 @@ def test_gblinear_rejects_categorical_features():
         train({"objective": "reg:squarederror", "booster": "gblinear"},
               RayDMatrix(x, y, feature_types=["c", "q", "q"]), 2,
               ray_params=RP1)
+
+
+def test_gblinear_through_sklearn_with_coef():
+    """The estimator facade works with booster='gblinear' and exposes the
+    xgboost-sklearn coef_/intercept_ surface."""
+    from xgboost_ray_tpu.sklearn import RayXGBRegressor
+
+    x, y, w_true = _lin_data(seed=9)
+    m = RayXGBRegressor(n_estimators=25, booster="gblinear", learning_rate=0.5,
+                        ray_params=RP2)
+    m.fit(x, y)
+    p = m.predict(x)
+    assert np.mean((p - y) ** 2) < 0.1
+    np.testing.assert_allclose(m.coef_, w_true, atol=0.15)
+    assert m.intercept_.shape == (1,)
+    # tree estimators raise (coef_ is linear-only, xgboost convention)
+    t = RayXGBRegressor(n_estimators=2, max_depth=2, ray_params=RP2)
+    t.fit(x, y)
+    with pytest.raises(AttributeError, match="gblinear"):
+        _ = t.coef_

@@ -409,6 +409,28 @@ class RayXGBMixin:
         total = vals.sum()
         return (vals / total) if total > 0 else vals
 
+    @property
+    def coef_(self) -> np.ndarray:
+        """Linear coefficients — defined for ``booster="gblinear"`` only
+        (xgboost sklearn convention: [F] or [K, F] for multi-output)."""
+        booster = self.get_booster()
+        if not hasattr(booster, "weights"):
+            raise AttributeError(
+                "coef_ is only defined for booster='gblinear' models."
+            )
+        w = np.asarray(booster.weights)  # [F, K]
+        return w[:, 0] if w.shape[1] == 1 else w.T
+
+    @property
+    def intercept_(self) -> np.ndarray:
+        """Linear bias — defined for ``booster="gblinear"`` only."""
+        booster = self.get_booster()
+        if not hasattr(booster, "weights"):
+            raise AttributeError(
+                "intercept_ is only defined for booster='gblinear' models."
+            )
+        return np.asarray(booster.bias)
+
     def save_model(self, fname: str):
         self.get_booster().save_model(fname)
 
