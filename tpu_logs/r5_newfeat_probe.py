@@ -1,0 +1,54 @@
+"""On-chip timing probe for the round-5 features (runs LAST in r5_queue.sh).
+
+Times 10 steady rounds of (a) depthwise baseline, (b) lossguide at two leaf
+budgets, (c) gblinear, at 1M x 28 on whatever backend answers — small
+enough to not endanger the headline bench's tunnel time, enough to anchor
+the lossguide O(N * leaves) cost model and the gblinear round cost with
+real numbers.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    backend = jax.default_backend()
+    rng = np.random.RandomState(0)
+    n, f = 1_000_000, 28
+    x = rng.standard_normal(size=(n, f)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float32)
+
+    from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+    configs = {
+        "depthwise_d8": {"max_depth": 8},
+        "lossguide_l64": {"grow_policy": "lossguide", "max_leaves": 64,
+                          "max_depth": 10},
+        "lossguide_l256": {"grow_policy": "lossguide", "max_leaves": 256,
+                           "max_depth": 12},
+        "gblinear": {"booster": "gblinear"},
+    }
+    for name, extra in configs.items():
+        params = {"objective": "binary:logistic", "eta": 0.3, "seed": 0,
+                  **extra}
+        t0 = time.time()
+        train(params, RayDMatrix(x, y), 2, ray_params=RayParams(num_actors=1))
+        warm = time.time() - t0
+        t1 = time.time()
+        train(params, RayDMatrix(x, y), 10,
+              ray_params=RayParams(num_actors=1))
+        total = time.time() - t1
+        print(json.dumps({
+            "probe": "r5_newfeat", "config": name, "backend": backend,
+            "rows": n, "warmup_2r_s": round(warm, 2),
+            "run_10r_s": round(total, 2),
+            "per_round_s": round(total / 10, 3),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

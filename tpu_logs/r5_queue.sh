@@ -23,6 +23,7 @@ run predict_bench 2400 python tests/release/benchmark_predict.py 1 1000000
 run mslr 3600 python tests/release/benchmark_ranking.py 1 100
 run int8_probe 1200 python tpu_logs/r4_int8_probe.py
 run quality 1800 python tpu_logs/quality_fast.py
+run newfeat 2400 python tpu_logs/r5_newfeat_probe.py
 echo "R5 QUEUE ALL DONE $(date +%T)" >> $L/r5.log
 git add tpu_logs/r5.log tpu_logs/r5_bench_line.json tpu_logs/r5_probe.log 2>/dev/null
 git commit -m "Record round-5 on-TPU measurement queue results" >> $L/r5.log 2>&1
