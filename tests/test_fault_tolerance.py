@@ -276,3 +276,22 @@ def test_elastic_slow_load_does_not_block(monkeypatch):
     elastic._update_scheduled_actor_states(state)  # arms (grace 0)
     with pytest.raises(RayXGBoostActorAvailable):
         elastic._update_scheduled_actor_states(state)
+
+
+def test_gblinear_restart_from_checkpoint_matches():
+    """The driver's retry loop is booster-agnostic: a mid-train actor death
+    during gblinear training must restart from the pickled LinearBooster
+    checkpoint and reproduce the no-failure model (coordinate descent is
+    deterministic given the resumed margins)."""
+    x, y = _data()
+    params = {"objective": "binary:logistic", "booster": "gblinear",
+              "eta": 0.5}
+    ref = train(params, RayDMatrix(x, y), 10,
+                ray_params=RayParams(num_actors=2))
+    bst = train(params, RayDMatrix(x, y), 10,
+                ray_params=RayParams(num_actors=2, max_actor_restarts=1,
+                                     checkpoint_frequency=2),
+                callbacks=[KillAt({5: [1]})])
+    assert bst.num_boosted_rounds() == 10
+    np.testing.assert_allclose(bst.weights, ref.weights, atol=1e-5)
+    np.testing.assert_allclose(bst.bias, ref.bias, atol=1e-5)
