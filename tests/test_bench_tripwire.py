@@ -1,0 +1,79 @@
+"""bench.py round-time regression tripwire (pure helpers, no training).
+
+The r4->r5 CPU-mesh bench regressed 0.76 -> 1.44 s/round (52%) with an
+unchanged bench.py and nothing flagged it; these tests pin the guard that
+now compares every run against the newest recorded BENCH_*.json.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def test_tripwire_fires_on_synthetic_2x_slowdown(capsys):
+    rec = {"metric": "m", "backend": "cpu", "steady_median_s": 0.5}
+    out = bench.round_time_tripwire(1.0, rec, "BENCH_r05.json", backend="cpu",
+                                    current_basis="steady")
+    assert out is not None and out["fired"]
+    assert out["ratio"] == 2.0
+    assert out["prev_per_round_s"] == 0.5
+    assert "TRIPWIRE" in capsys.readouterr().err
+
+
+def test_tripwire_quiet_within_threshold(capsys):
+    rec = {"metric": "m", "backend": "cpu", "steady_median_s": 0.5}
+    out = bench.round_time_tripwire(0.55, rec, "BENCH_r05.json", backend="cpu",
+                                    current_basis="steady")
+    assert out is not None and not out["fired"]
+    assert "TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_tripwire_reports_but_never_fires_across_bases(capsys):
+    """A compile-inclusive first-chunk mean against a prior steady median
+    measures XLA compile time, not a regression — reported, never fired."""
+    rec = {"metric": "m", "backend": "cpu", "steady_median_s": 0.5}
+    out = bench.round_time_tripwire(5.0, rec, "BENCH_r05.json", backend="cpu",
+                                    current_basis="compile_inclusive")
+    assert out is not None and not out["fired"]
+    assert out["basis_mismatch"] == "prev=steady"
+    assert "TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_tripwire_skips_cross_backend_comparison():
+    rec = {"metric": "m", "backend": "tpu", "steady_median_s": 0.5}
+    assert bench.round_time_tripwire(5.0, rec, "x", backend="cpu") is None
+
+
+def test_tripwire_falls_back_to_train_time_over_rounds():
+    rec = {"metric": "m", "backend": "cpu", "train_time_s": 10.0, "rounds": 10}
+    out = bench.round_time_tripwire(2.5, rec, "x", backend="cpu")
+    assert out is not None and out["fired"] and out["ratio"] == 2.5
+
+
+def test_tripwire_none_without_comparable_record():
+    assert bench.round_time_tripwire(1.0, None, None) is None
+    assert bench.round_time_tripwire(1.0, {"metric": "m"}, "x") is None
+    assert bench.round_time_tripwire(None, {"metric": "m"}, "x") is None
+
+
+def test_load_latest_bench_record_picks_newest_round(tmp_path):
+    for n, val in ((1, 0.9), (5, 1.44), (3, 0.8)):
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+            json.dumps({
+                "n": n,
+                "parsed": {"metric": "m", "backend": "cpu",
+                           "steady_median_s": val},
+            })
+        )
+    (tmp_path / "BENCH_broken.json").write_text("{not json")
+    rec, name = bench._load_latest_bench_record(str(tmp_path))
+    assert name == "BENCH_r05.json"
+    assert rec["steady_median_s"] == 1.44
+
+
+def test_load_latest_bench_record_empty_dir(tmp_path):
+    assert bench._load_latest_bench_record(str(tmp_path)) == (None, None)

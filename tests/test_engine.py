@@ -386,15 +386,32 @@ def test_scan_path_transfer_count_regression(monkeypatch):
 
     import inspect
 
-    from jax._src import array as _jarr
+    try:
+        from jax._src import array as _jarr
+    except ImportError:  # pragma: no cover - jax internals moved
+        pytest.skip(
+            "jax._src.array moved in this jax version; the transfer-count "
+            "hook point is gone — re-find the host-materialization "
+            "chokepoint before trusting transfer counts."
+        )
 
     counts = {"d2h": 0}
     # count at the `_value` property — the single host-materialization
     # chokepoint behind np.asarray, float(), and .item() alike, so a
     # regression rewritten as per-round float(scalar) reads cannot evade
-    # the bound
-    orig = inspect.getattr_static(_jarr.ArrayImpl, "_value")
-    assert isinstance(orig, property)
+    # the bound. CI installs unpinned `-U jax`, so a PRIVATE-attribute move
+    # must skip loudly instead of failing the suite for a non-repo reason
+    # (ADVICE r5).
+    orig = inspect.getattr_static(
+        getattr(_jarr, "ArrayImpl", object), "_value", None
+    )
+    if not isinstance(orig, property):
+        pytest.skip(
+            "private jax attribute ArrayImpl._value is no longer a "
+            "property in this jax version; the transfer-count "
+            "instrumentation point moved — update the hook, the batching "
+            "itself is untested here."
+        )
 
     def counting_value(self):
         counts["d2h"] += 1

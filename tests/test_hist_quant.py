@@ -1,0 +1,332 @@
+"""Quantized histogram allreduce (``hist_quant``) — the per-round psum hot
+path with an int8/int16 wire format (ops/histogram.py).
+
+Covers the acceptance contract: keystone half/joint accuracy under int8,
+1-actor vs 2-actor structural identity, deterministic (bit-identical across
+shards) merging, and the measured allreduce payload-byte reduction.
+
+Size threshold: payloads under ``hist_quant_min_bytes`` (default 32 KiB)
+keep the exact f32 psum — small collectives are latency-bound, and exactness
+below the threshold keeps small-problem tree structure invariant to the
+world size. Tests that exercise the quantized wire itself therefore pass
+``hist_quant_min_bytes=0`` (quantize everything), while the structural-
+identity test pins the DEFAULT contract.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from xgboost_ray_tpu.compat import shard_map_compat as shard_map
+from xgboost_ray_tpu.engine import TpuEngine
+from xgboost_ray_tpu.ops.histogram import quantized_hist_allreduce
+from xgboost_ray_tpu.params import parse_params
+
+
+def _one_hot_fixture():
+    eye = np.eye(4, dtype=np.float32)
+    x = np.concatenate([np.tile(eye[[0, 1]], (8, 1)), np.tile(eye[[2, 3]], (8, 1))])
+    y = np.concatenate(
+        [np.tile([1.0, 0.0], 8), np.tile([1.0, 0.0], 8)]
+    ).astype(np.float32)
+    return x, y, eye
+
+
+_KEYSTONE = {
+    "objective": "binary:logistic",
+    "max_depth": 3,
+    "eta": 0.5,
+    "eval_metric": ["logloss", "error"],
+    "reg_lambda": 0.0,
+    "min_child_weight": 0.0,
+}
+
+
+def _train(shards, num_actors, rounds=10, params=None, **kw):
+    eng = TpuEngine(shards, parse_params(params or _KEYSTONE), num_actors, **kw)
+    last = None
+    for i in range(rounds):
+        last = eng.step(i)
+    return eng, last
+
+
+# ---------------------------------------------------------------------------
+# op level
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode,rel_tol", [("int8", 0.05), ("int16", 2e-4)])
+def test_quantized_allreduce_matches_psum(mode, rel_tol):
+    """The quantized merge approximates the f32 psum within the mode's
+    granularity, and every shard sees a BIT-IDENTICAL merged histogram
+    (deterministic rounding, shared scales)."""
+    n_dev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()), ("actors",))
+    rng = np.random.RandomState(0)
+    nn, F, nbt = 4, 3, 17  # rows (nn*F) NOT divisible by 8: exercises padding
+    # per-(node, feature) magnitudes spanning 4 orders: per-row scales must
+    # hold relative accuracy where a global scale could not
+    mags = 10.0 ** rng.uniform(-2, 2, size=(nn, F, 1, 1)).astype(np.float32)
+    local = (rng.randn(n_dev, nn, F, nbt, 2).astype(np.float32) * mags)
+
+    def f(h):
+        out = quantized_hist_allreduce(
+            h[0], "actors", mode, n_dev, None, min_bytes=0
+        )
+        return out[None]
+
+    mapped = jax.jit(
+        shard_map(f, mesh=mesh, in_specs=P("actors"), out_specs=P("actors"))
+    )
+    # out_specs P("actors") keeps every shard's copy visible for the
+    # bit-identity check
+    out = np.asarray(mapped(jnp.asarray(local)))
+    for i in range(1, n_dev):
+        np.testing.assert_array_equal(out[i], out[0])
+    ref = local.sum(axis=0)
+    # error bound: two roundings at 1/qmax of the per-(node, feature) absmax
+    amax = np.abs(ref).max(axis=(2, 3), keepdims=True)
+    err = np.abs(out[0] - ref) / np.maximum(amax, 1e-12)
+    assert err.max() < rel_tol, err.max()
+
+
+def test_quantized_allreduce_none_and_subthreshold_are_exact_psum():
+    n_dev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()), ("actors",))
+    local = np.random.RandomState(1).randn(n_dev, 2, 3, 9, 2).astype(np.float32)
+    ref = local.sum(axis=0)
+
+    for mode, min_bytes in (("none", 0), ("int8", 1 << 20)):
+        def f(h):
+            return quantized_hist_allreduce(
+                h[0], "actors", mode, n_dev, None, min_bytes=min_bytes
+            )[None]
+
+        out = np.asarray(
+            jax.jit(
+                shard_map(f, mesh=mesh, in_specs=P("actors"),
+                          out_specs=P("actors"))
+            )(jnp.asarray(local))
+        )
+        # sub-threshold int8 payloads take the identical exact-psum path
+        np.testing.assert_allclose(out[0], ref, rtol=1e-6, atol=1e-6)
+
+
+def test_quantized_allreduce_zero_histogram():
+    """All-zero histograms (empty nodes) must survive the scale guard."""
+    n_dev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()), ("actors",))
+    local = np.zeros((n_dev, 2, 2, 9, 2), np.float32)
+
+    def f(h):
+        return quantized_hist_allreduce(
+            h[0], "actors", "int8", n_dev, None, min_bytes=0
+        )[None]
+
+    out = np.asarray(
+        jax.jit(shard_map(f, mesh=mesh, in_specs=P("actors"), out_specs=P("actors")))(
+            jnp.asarray(local)
+        )
+    )
+    np.testing.assert_array_equal(out[0], 0.0)
+
+
+# ---------------------------------------------------------------------------
+# engine level — the acceptance contract
+# ---------------------------------------------------------------------------
+
+
+def test_int8_keystone_joint_matches_f32():
+    """Keystone half/joint end-to-end under hist_quant='int8' with the wire
+    quantized at EVERY level (min_bytes=0, strictly harder than the default
+    threshold): joint 2-actor training still recovers 100% accuracy and the
+    final train metric is within 1e-3 relative of the f32 run."""
+    x, y, eye = _one_hot_fixture()
+    shards = [
+        {"data": x[:16], "label": y[:16]},
+        {"data": x[16:], "label": y[16:]},
+    ]
+    finals = {}
+    for hq in ("none", "int8"):
+        p = dict(_KEYSTONE)
+        p.update(hist_quant=hq, hist_quant_min_bytes=0)
+        eng, metrics = _train(shards, 2, params=p, evals=[(shards, "train")])
+        finals[hq] = metrics["train"]
+        pred = eng.get_booster().predict(eye)
+        assert pred[0] > 0.9 and pred[2] > 0.9
+        assert pred[1] < 0.1 and pred[3] < 0.1
+    assert finals["int8"]["error"] == 0.0
+    a, b = finals["none"]["logloss"], finals["int8"]["logloss"]
+    assert abs(a - b) / max(abs(a), 1e-12) < 1e-3
+
+
+def _forest_structure(forest):
+    return (
+        np.asarray(forest.feature),
+        np.asarray(forest.split_bin),
+        np.asarray(forest.threshold),
+    )
+
+
+def test_int8_keystone_structural_noop_per_world_size():
+    """On the keystone fixture every level payload sits under the default
+    size threshold, so hist_quant='int8' must be a BIT-EXACT no-op: for each
+    world size, the int8 forest is structurally identical to the f32 forest
+    (same split features/bins/thresholds).
+
+    Why per world size and not 1-actor-vs-2-actor directly: the keystone's
+    symmetric patterns produce exactly tied gains, and even pure-f32
+    training breaks those ties differently under different shardings (psum
+    reassociation) — pinned by test_f32_keystone_tie_breaking_baseline
+    below. Quantization must not make that any worse, which the no-op
+    property guarantees."""
+    x, y, _ = _one_hot_fixture()
+    for shards in (
+        [{"data": x, "label": y}],
+        [{"data": x[:16], "label": y[:16]}, {"data": x[16:], "label": y[16:]}],
+    ):
+        structures = {}
+        for hq in ("none", "int8"):
+            p = dict(_KEYSTONE)
+            p["hist_quant"] = hq
+            eng, _ = _train(shards, len(shards), params=p)
+            structures[hq] = _forest_structure(eng.get_booster().forest)
+        for a, b in zip(structures["none"], structures["int8"]):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_int8_world_size_structural_identity_where_f32_has_it():
+    """On a tie-free fixture whose payloads stay sub-threshold, 1-actor and
+    2-actor training produce structurally identical trees under f32 — and
+    hist_quant='int8' preserves that property exactly. (In the quantized
+    regime a lossy wire cannot guarantee near-ties break identically under
+    different shardings — the same class of effect f32 psum reassociation
+    already exhibits on exactly tied gains.)"""
+    rng = np.random.RandomState(7)
+    x = rng.randn(400, 5).astype(np.float32)
+    y = (x[:, 0] * 2 + np.sin(x[:, 1]) + 0.1 * rng.randn(400)).astype(np.float32)
+    for hq in ("none", "int8"):
+        p = {"objective": "reg:squarederror", "max_depth": 3, "eta": 0.3,
+             "hist_quant": hq}
+        structures = []
+        for n in (1, 2):
+            shards = [{"data": x[i::n], "label": y[i::n]} for i in range(n)]
+            eng, _ = _train(shards, n, rounds=5, params=p)
+            structures.append(_forest_structure(eng.get_booster().forest))
+        for a, b in zip(*structures):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_f32_keystone_tie_breaking_baseline():
+    """Pin the PRE-EXISTING baseline behavior the structural contract is
+    defined against: pure-f32 keystone training already breaks its
+    symmetric gain ties differently for 1 vs 2 actors (psum
+    reassociation). If this ever starts passing, the no-op framing above
+    can be upgraded to direct world-size structural identity."""
+    x, y, _ = _one_hot_fixture()
+    structures = []
+    for shards in (
+        [{"data": x, "label": y}],
+        [{"data": x[:16], "label": y[:16]}, {"data": x[16:], "label": y[16:]}],
+    ):
+        eng, _ = _train(shards, len(shards))
+        structures.append(_forest_structure(eng.get_booster().forest))
+    assert not np.array_equal(structures[0][0], structures[1][0])
+
+
+def test_int16_tracks_f32_closely():
+    """int16 granularity (1/32767) should land within regular numeric noise
+    of the f32 model on a real regression task, with every level
+    quantized."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(512, 6).astype(np.float32)
+    y = (x[:, 0] * 2 + np.sin(x[:, 1]) + 0.1 * rng.randn(512)).astype(np.float32)
+    shards = [{"data": x, "label": y}]
+    preds = {}
+    for hq in ("none", "int16"):
+        p = {"objective": "reg:squarederror", "max_depth": 4, "eta": 0.3,
+             "eval_metric": ["rmse"], "hist_quant": hq,
+             "hist_quant_min_bytes": 0}
+        eng, metrics = _train(shards, 4, rounds=15, params=p,
+                              evals=[(shards, "train")])
+        preds[hq] = metrics["train"]["rmse"]
+    assert preds["int16"] < 0.35
+    assert abs(preds["none"] - preds["int16"]) / preds["none"] < 0.02
+
+
+def test_allreduce_bytes_counter_measures_reduction():
+    """The device-side byte counter reports the real wire-format saving:
+    >= 3.5x for int8 vs the f32 psum on the 8-way mesh at a HIGGS-shaped
+    feature count (every level payload clears the default size threshold;
+    4x is the dtype ratio, the gap is scales + the small exact node-total
+    psums that ride along in every mode)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(512, 28).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    shards = [{"data": x[i::8], "label": y[i::8]} for i in range(8)]
+    bytes_per = {}
+    for hq in ("none", "int8", "int16"):
+        p = {"objective": "binary:logistic", "max_depth": 4, "hist_quant": hq}
+        eng, _ = _train(shards, 8, rounds=1, params=p)
+        bytes_per[hq] = eng.hist_allreduce_bytes_per_round()
+        assert bytes_per[hq] is not None and bytes_per[hq] > 0
+    assert bytes_per["none"] / bytes_per["int8"] >= 3.5
+    assert bytes_per["none"] / bytes_per["int16"] >= 1.7
+
+
+def test_scan_path_matches_per_round_under_int8():
+    """The fused lax.scan path and per-round stepping share one traced round
+    body; under quantization they must still produce identical forests."""
+    rng = np.random.RandomState(11)
+    x = rng.randn(300, 5).astype(np.float32)
+    y = (x[:, 0] + 0.3 * x[:, 1] > 0).astype(np.float32)
+    p = parse_params({"objective": "binary:logistic", "max_depth": 3,
+                      "eta": 0.4, "hist_quant": "int8",
+                      "hist_quant_min_bytes": 0})
+    shards = [{"data": x, "label": y}]
+
+    eng_scan = TpuEngine(shards, p, num_actors=2)
+    assert eng_scan.can_batch_rounds()
+    eng_scan.step_many(0, 4)
+    assert eng_scan.hist_allreduce_bytes_per_round() > 0
+    eng_step = TpuEngine(shards, p, num_actors=2)
+    for i in range(4):
+        eng_step.step(i)
+    np.testing.assert_allclose(
+        eng_scan.get_booster().predict(x, output_margin=True),
+        eng_step.get_booster().predict(x, output_margin=True),
+        atol=1e-5,
+    )
+
+
+def test_hist_quant_lossguide_and_partition_impls():
+    """The quantized wire plugs into both growers and the partition-order
+    histogram impls."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(500, 8).astype(np.float32)
+    y = (x[:, 2] > 0).astype(np.float32)
+    shards = [{"data": x, "label": y}]
+    for extra in (
+        {"grow_policy": "lossguide", "max_leaves": 8},
+        {"hist_impl": "partition"},
+        {"hist_impl": "mixed"},
+    ):
+        p = dict(_KEYSTONE)
+        p.update(extra)
+        p.update(hist_quant="int8", hist_quant_min_bytes=0)
+        eng, metrics = _train(shards, 2, rounds=10, params=p,
+                              evals=[(shards, "train")])
+        assert metrics["train"]["error"] < 0.05, extra
+
+
+def test_hist_quant_param_validation():
+    assert parse_params({"hist_quant": "int8"}).hist_quant == "int8"
+    out = parse_params({})
+    assert out.hist_quant == "none"
+    assert out.hist_quant_min_bytes == 32768
+    assert parse_params({"hist_quant_min_bytes": 0}).hist_quant_min_bytes == 0
+    with pytest.raises(ValueError, match="hist_quant"):
+        parse_params({"hist_quant": "fp4"})

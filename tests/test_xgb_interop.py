@@ -520,3 +520,40 @@ def test_real_xgboost_loads_gblinear_export(tmp_path):
     np.testing.assert_allclose(
         back.predict(x), real2.predict(xgb.DMatrix(x)), atol=1e-4
     )
+
+
+def test_real_xgboost_loads_gblinear_nonreg_objective_export(tmp_path):
+    """ADVICE r5: a non-reg:squarederror gblinear export must carry the
+    objective param block real xgboost's loader expects (here
+    softmax_multiclass_param with num_class for multi:softprob, and
+    binary:logistic's transform round trip) — the hardcoded reg_loss_param
+    made such files misload."""
+    xgb = pytest.importorskip("xgboost")
+    from xgboost_ray_tpu.linear import RayLinearBooster
+
+    rng = np.random.RandomState(9)
+    x = rng.randn(240, 4).astype(np.float32)
+    x[np.arange(240), rng.randint(0, 3, 240)] += 2.5
+    y = x[:, :3].argmax(axis=1).astype(np.float32)
+    bst = train({"objective": "multi:softprob", "num_class": 3,
+                 "booster": "gblinear", "eta": 0.5},
+                RayDMatrix(x, y), 12, ray_params=RP)
+    path = str(tmp_path / "lin_softprob.json")
+    bst.save_model(path)
+    real = xgb.Booster(model_file=path)
+    np.testing.assert_allclose(
+        real.predict(xgb.DMatrix(x)), bst.predict(x), atol=1e-4
+    )
+
+    yb = (x[:, 0] > 0).astype(np.float32)
+    bstb = train({"objective": "binary:logistic", "booster": "gblinear",
+                  "eta": 0.5}, RayDMatrix(x, yb), 12, ray_params=RP)
+    pathb = str(tmp_path / "lin_logistic.json")
+    bstb.save_model(pathb)
+    realb = xgb.Booster(model_file=pathb)
+    np.testing.assert_allclose(
+        realb.predict(xgb.DMatrix(x)), bstb.predict(x), atol=1e-4
+    )
+    # and the file round-trips back into this runtime unchanged
+    back = RayLinearBooster.load_model(pathb)
+    np.testing.assert_allclose(back.predict(x), bstb.predict(x), atol=1e-6)

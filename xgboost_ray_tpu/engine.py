@@ -32,8 +32,14 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from xgboost_ray_tpu.compat import shard_map_compat
 from xgboost_ray_tpu.models.booster import RayXGBoostBooster, stack_trees
 from xgboost_ray_tpu.ops import binning
+from xgboost_ray_tpu.ops.histogram import (
+    AllreduceBytes,
+    counting_psum,
+    quantized_hist_allreduce,
+)
 from xgboost_ray_tpu.ops.grow import (
     SALT_BYTREE,
     SALT_SUBSAMPLE,
@@ -57,10 +63,7 @@ from xgboost_ray_tpu.params import TrainParams
 
 logger = logging.getLogger(__name__)
 
-try:  # jax >= 0.4.35 exposes shard_map at top level
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+shard_map = shard_map_compat  # version-portable, replication check off
 
 
 def resolve_hist_impl(impl: str) -> str:
@@ -207,6 +210,8 @@ class TpuEngine:
             ),
             hist_impl=resolve_hist_impl(params.hist_impl),
             hist_precision=resolve_hist_precision(params.hist_precision),
+            hist_quant=params.hist_quant,
+            hist_quant_min_bytes=params.hist_quant_min_bytes,
             hist_chunk=params.hist_chunk,
             sibling_subtract=params.sibling_subtract,
             cat_features=self._cat_features,
@@ -471,6 +476,9 @@ class TpuEngine:
         self._step_fn_custom = None
         self._scan_fn = None
         self._dart_fn = None
+        # device-resident payload-byte counter of the latest round's tree
+        # allreduces (materialized lazily — see hist_allreduce_bytes_per_round)
+        self._ar_bytes_dev = None
         if self.dart:
             self._init_dart_forest()
         self.iteration_offset = (
@@ -708,13 +716,26 @@ class TpuEngine:
             sum(1 for e in self.evals if not e.is_train) if update_evals else 0
         )
         psum = lambda x: jax.lax.psum(x, "actors")
+        n_actors = self.n_devices
 
         is_survival = self.is_survival
 
         def tree_round(bins, valid, label, weight, margins, group_rows, gh_in,
                        rng, bounds, eval_bins, eval_margins):
             """One boosting round; gh_in is None unless a custom objective
-            supplied precomputed gradients."""
+            supplied precomputed gradients. Also returns the round's
+            measured tree-path allreduce payload bytes (AllreduceBytes)."""
+            # fresh per trace: counts the ring-model wire bytes of every
+            # tree-path allreduce (histograms + small exact reductions)
+            counter = AllreduceBytes(n_actors)
+            tree_psum = counting_psum("actors", counter)
+
+            def hist_ar(h):
+                return quantized_hist_allreduce(
+                    h, "actors", cfg.hist_quant, n_actors, counter,
+                    min_bytes=cfg.hist_quant_min_bytes,
+                )
+
             w_eff = weight * valid.astype(jnp.float32)
             if gh_in is not None:
                 g, h = gh_in
@@ -760,9 +781,11 @@ class TpuEngine:
                         level_rng=key if need_level_rng else None,
                         colsample_bylevel=params.colsample_bylevel,
                         colsample_bynode=params.colsample_bynode,
-                        allreduce=psum,
+                        allreduce=tree_psum,
                         feature_log_weights=self._log_fw,
                         feat_has_missing=self._feat_has_missing,
+                        hist_allreduce=hist_ar,
+                        ar_counter=counter,
                     )
                     trees.append(tree)
                     new_margins = new_margins.at[:, k].add(row_value / t_par)
@@ -775,7 +798,8 @@ class TpuEngine:
                             new_eval_margins[e].at[:, k].add(upd / t_par)
                         )
             forest = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
-            return new_margins, tuple(new_eval_margins), forest
+            return (new_margins, tuple(new_eval_margins), forest,
+                    counter.as_scalar())
 
         def metric_contribs(new_margins, new_eval_margins, label, w_eff,
                             train_group_rows, eval_data, bounds=None):
@@ -857,7 +881,7 @@ class TpuEngine:
                  bounds, eval_data):
             eval_bins = tuple(d.bins for d in eval_data)
             eval_margins = tuple(d.margins for d in eval_data)
-            new_margins, new_eval_margins, forest = tree_round(
+            new_margins, new_eval_margins, forest, ar_bytes = tree_round(
                 bins, valid, label, weight, margins, group_rows,
                 gh_in if custom else None, rng, bounds, eval_bins, eval_margins,
             )
@@ -866,7 +890,7 @@ class TpuEngine:
                 weight * valid.astype(jnp.float32), group_rows, eval_data,
                 bounds=bounds,
             )
-            return new_margins, new_eval_margins, forest, contribs
+            return new_margins, new_eval_margins, forest, contribs, ar_bytes
 
         eval_specs = self._eval_arr_specs()
         mapped = shard_map(
@@ -892,8 +916,8 @@ class TpuEngine:
                     tuple((P(), P()) for _ in self._device_metrics)
                     for _ in self.evals
                 ),
+                P(),  # allreduce payload bytes (identical on every shard)
             ),
-            check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=(4,))
 
@@ -916,7 +940,7 @@ class TpuEngine:
             def scan_body(carry, iteration):
                 margins_c, eval_margins_c = carry
                 rng = jax.random.fold_in(seed_key, iteration)
-                new_margins, new_eval_margins, forest = tree_round(
+                new_margins, new_eval_margins, forest, ar_bytes = tree_round(
                     bins, valid, label, weight, margins_c, group_rows, None,
                     rng, bounds, eval_bins, eval_margins_c,
                 )
@@ -925,12 +949,12 @@ class TpuEngine:
                     weight * valid.astype(jnp.float32), group_rows, eval_data,
                     bounds=bounds,
                 )
-                return (new_margins, new_eval_margins), (forest, contribs)
+                return (new_margins, new_eval_margins), (forest, contribs, ar_bytes)
 
-            (margins_out, eval_margins_out), (forests, contribs) = jax.lax.scan(
-                scan_body, (margins, eval_margins0), iterations
+            (margins_out, eval_margins_out), (forests, contribs, ar_bytes) = (
+                jax.lax.scan(scan_body, (margins, eval_margins0), iterations)
             )
-            return margins_out, eval_margins_out, forests, contribs
+            return margins_out, eval_margins_out, forests, contribs, ar_bytes
 
         eval_specs = self._eval_arr_specs()
         mapped = shard_map(
@@ -952,8 +976,8 @@ class TpuEngine:
                 tuple(P("actors") for _ in eval_specs),
                 P(),
                 tuple(tuple((P(), P()) for _ in self._device_metrics) for _ in self.evals),
+                P(),  # per-round allreduce payload bytes [n_rounds]
             ),
-            check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=(4,))
 
@@ -981,7 +1005,7 @@ class TpuEngine:
             self.group_rows if self.group_rows is not None else jnp.zeros((), jnp.int32)
         )
         bounds = self.bounds_dev if self.bounds_dev is not None else jnp.zeros((), jnp.float32)
-        new_margins, new_eval_margins, forests, contribs = self._scan_fn(
+        new_margins, new_eval_margins, forests, contribs, ar_bytes = self._scan_fn(
             self.bins,
             self.valid,
             self.label_dev,
@@ -992,6 +1016,9 @@ class TpuEngine:
             bounds,
             eval_data,
         )
+        # keep the device scalar; materialized lazily by the accessor so the
+        # steady-state step path adds NO host reads (transfer-count contract)
+        self._ar_bytes_dev = ar_bytes[0]
         self.margins = new_margins
         ei = 0
         for es in self.evals:
@@ -1080,7 +1107,7 @@ class TpuEngine:
         else:
             gh_in = jnp.zeros((), jnp.float32)
         bounds = self.bounds_dev if self.bounds_dev is not None else jnp.zeros((), jnp.float32)
-        new_margins, new_eval_margins, forest, contribs = fn(
+        new_margins, new_eval_margins, forest, contribs, ar_bytes = fn(
             self.bins,
             self.valid,
             self.label_dev,
@@ -1092,6 +1119,7 @@ class TpuEngine:
             bounds,
             eval_data,
         )
+        self._ar_bytes_dev = ar_bytes
         self.margins = new_margins
         ei = 0
         for es in self.evals:
@@ -1271,6 +1299,18 @@ class TpuEngine:
             self.trees.append(jax.tree.map(lambda a, _r=r: a[_r], stacked))
         self._trees_dev.clear()
 
+    def hist_allreduce_bytes_per_round(self) -> Optional[int]:
+        """Measured collective payload bytes of one boosting round's tree
+        path (histogram merges + small exact reductions), from the
+        device-side counter threaded through the compiled step. ``None``
+        before the first round. This is the ``hist_quant`` traffic metric:
+        int8 cuts it ~4x vs the f32 psum. Reading it costs one device->host
+        transfer, so callers (bench/driver) fetch it once after training,
+        never per round."""
+        if self._ar_bytes_dev is None:
+            return None
+        return int(np.asarray(self._ar_bytes_dev))
+
     @property
     def num_round_trees(self) -> int:
         """Rounds recorded so far (host-resident + pending device forests)."""
@@ -1362,7 +1402,7 @@ class TpuEngine:
                       bounds, forest, w_eff, w_post, new_w, slot, rng, eval_data):
             m_eff = forest_margin(forest, bins, static_margins, w_eff)
             eval_bins = tuple(d.bins for d in eval_data)
-            new_margins, _, round_forest = tree_round(
+            new_margins, _, round_forest, ar_bytes = tree_round(
                 bins, valid, label, weight, m_eff, group_rows, None, rng,
                 bounds, (), (),
             )
@@ -1390,7 +1430,8 @@ class TpuEngine:
                 weight * valid.astype(jnp.float32), group_rows, eval_data,
                 bounds=bounds,
             )
-            return m_full, tuple(new_eval_margins), forest, round_forest, contribs
+            return (m_full, tuple(new_eval_margins), forest, round_forest,
+                    contribs, ar_bytes)
 
         eval_specs = self._eval_arr_specs()
         mapped = shard_map(
@@ -1421,8 +1462,8 @@ class TpuEngine:
                     tuple((P(), P()) for _ in self._device_metrics)
                     for _ in self.evals
                 ),
+                P(),  # allreduce payload bytes
             ),
-            check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=(7,))
 
@@ -1480,7 +1521,7 @@ class TpuEngine:
         bounds = (
             self.bounds_dev if self.bounds_dev is not None else jnp.zeros((), jnp.float32)
         )
-        m_full, new_eval_margins, forest, round_forest, contribs = self._dart_fn(
+        m_full, new_eval_margins, forest, round_forest, contribs, ar_bytes = self._dart_fn(
             self.bins,
             self.valid,
             self.label_dev,
@@ -1497,6 +1538,7 @@ class TpuEngine:
             eval_data,
         )
         self.margins = m_full
+        self._ar_bytes_dev = ar_bytes
         self.dart_forest_dev = forest
         ei = 0
         for es in self.evals:

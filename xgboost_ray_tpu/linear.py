@@ -35,10 +35,7 @@ from xgboost_ray_tpu.ops.metrics import compute_metric, parse_metric_name
 from xgboost_ray_tpu.ops.objectives import get_objective
 from xgboost_ray_tpu.params import TrainParams
 
-try:  # jax >= 0.4.35
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from xgboost_ray_tpu.compat import shard_map_compat as shard_map
 
 
 class RayLinearBooster:
@@ -114,9 +111,16 @@ class RayLinearBooster:
             k for k in ("pred_contribs", "pred_interactions", "pred_leaf")
             if kwargs.get(k)
         ]
-        if kwargs.get("ntree_limit") or (
-            kwargs.get("iteration_range") not in (None, (0, 0))
-        ):
+        # normalize iteration_range first: [0, 0] lists and np-int (0, 0)
+        # tuples all mean "the full model" (a no-op for a linear model) and
+        # must not raise (ADVICE r5)
+        it_range = kwargs.get("iteration_range")
+        if it_range is not None:
+            try:
+                it_range = tuple(int(v) for v in it_range)
+            except (TypeError, ValueError):
+                it_range = kwargs.get("iteration_range")
+        if kwargs.get("ntree_limit") or it_range not in (None, (0, 0)):
             unsupported.append("iteration_range/ntree_limit")
         if unsupported:
             raise NotImplementedError(
@@ -152,6 +156,12 @@ class RayLinearBooster:
         f, k = self.weights.shape
         flat = np.concatenate(
             [self.weights.reshape(f * k), self.bias]).astype(float)
+        # per-objective param block shared with the tree exporter (a
+        # hardcoded reg_loss_param misloads multiclass/poisson/tweedie
+        # models in real xgboost — ADVICE r5)
+        from xgboost_ray_tpu.models.xgb_export import objective_param_entry
+
+        obj_name, pkey, pval = objective_param_entry(self.params)
         doc = {
             "learner": {
                 "attributes": dict(self._attrs),
@@ -173,8 +183,7 @@ class RayLinearBooster:
                     "num_feature": str(f),
                     "num_target": "1",
                 },
-                "objective": {"name": str(self.params.objective),
-                              "reg_loss_param": {"scale_pos_weight": "1"}},
+                "objective": {"name": obj_name, pkey: pval},
             },
             "version": [2, 0, 0],
         }
@@ -186,9 +195,25 @@ class RayLinearBooster:
 
     @classmethod
     def import_xgboost_json(cls, data) -> "RayLinearBooster":
-        doc = data if isinstance(data, dict) else json.loads(
-            open(data).read() if not str(data).lstrip().startswith("{")
-            else data)
+        """Load from a parsed dict, a JSON string, or a file path.
+
+        The three input forms are distinguished explicitly (dict type, then
+        path existence) — not by sniffing a leading ``{``, which misreads
+        brace-prefixed filenames and BOM-prefixed documents — and file
+        reads close their handle (ADVICE r5)."""
+        import os
+
+        if isinstance(data, dict):
+            doc = data
+        else:
+            text = os.fspath(data) if isinstance(data, os.PathLike) else data
+            if isinstance(text, bytes):
+                text = text.decode()
+            if os.path.exists(text):
+                with open(text) as fh:
+                    doc = json.load(fh)
+            else:
+                doc = json.loads(text)
         learner = doc["learner"]
         gb = learner["gradient_booster"]
         if gb.get("name") != "gblinear":

@@ -395,6 +395,21 @@ def _handle_queue(queue: Queue, checkpoint: _Checkpoint, callback_returns: Dict)
             callback_returns.setdefault(rank, []).append(item)
 
 
+def _record_allreduce_bytes(state, engine) -> None:
+    """Surface the engine's measured per-round collective payload bytes
+    (the ``hist_quant`` traffic metric) in additional_results. One host
+    read, after training only — never on the per-round path."""
+    getter = getattr(engine, "hist_allreduce_bytes_per_round", None)
+    if getter is None:
+        return
+    try:
+        val = getter()
+    except Exception:  # noqa: BLE001 - diagnostics must not fail training
+        return
+    if val is not None:
+        state.additional_results["hist_allreduce_bytes_per_round"] = val
+
+
 def _stop_profile_if_running():
     if not ENV.PROFILE_DIR:
         return
@@ -775,6 +790,7 @@ def _train(
             )
         _handle_queue(state.queue, state.checkpoint, callback_returns)
         state.additional_results["callback_returns"] = callback_returns
+        _record_allreduce_bytes(state, engine)
         _stop_profile_if_running()
         train_time = time.time() - train_started
         return booster, evals_result, {
@@ -912,6 +928,7 @@ def _train(
 
     _handle_queue(state.queue, state.checkpoint, callback_returns)
     state.additional_results["callback_returns"] = callback_returns
+    _record_allreduce_bytes(state, engine)
     _stop_profile_if_running()
 
     train_time = time.time() - train_started

@@ -39,10 +39,7 @@ def _spmd_margin_fn(devices, k, max_depth, npt, ntree_limit, has_tw,
     import jax
     from jax.sharding import Mesh, PartitionSpec as P
 
-    try:  # jax >= 0.4.35 exposes shard_map at top level
-        shard_map = jax.shard_map
-    except AttributeError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map  # type: ignore
+    from xgboost_ray_tpu.compat import shard_map_compat as shard_map
 
     key = (
         tuple(getattr(d, "id", i) for i, d in enumerate(devices)),

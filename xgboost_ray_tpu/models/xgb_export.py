@@ -134,6 +134,31 @@ _OBJECTIVE_PARAM_KEYS = {
 }
 
 
+def objective_param_entry(params) -> Tuple[str, str, Dict[str, str]]:
+    """``(objective_name, param_key, param_dict)`` for the xgboost JSON
+    schema's ``learner.objective`` block.
+
+    Real xgboost's objective loader expects a DIFFERENT param key per
+    objective family (``softmax_multiclass_param`` with ``num_class``,
+    ``poisson_regression_param``, ...); hardcoding ``reg_loss_param``
+    produces files that misload for anything beyond plain regression.
+    Shared by the tree exporter and ``RayLinearBooster.export_xgboost_json``
+    (ADVICE r5) so the mapping cannot diverge again."""
+    obj_name = str(params.objective)
+    pkey, pdefault = _OBJECTIVE_PARAM_KEYS.get(
+        obj_name, ("reg_loss_param", {"scale_pos_weight": "1"})
+    )
+    pval = dict(pdefault)
+    if pkey == "softmax_multiclass_param":
+        pval["num_class"] = str(int(params.num_class or 0))
+    if pkey == "aft_loss_param":
+        pval["aft_loss_distribution"] = str(params.aft_loss_distribution)
+        pval["aft_loss_distribution_scale"] = str(
+            params.aft_loss_distribution_scale
+        )
+    return obj_name, pkey, pval
+
+
 def export_xgboost_json(booster, fname: Optional[str] = None) -> str:
     """Serialize ``booster`` in the xgboost JSON model schema. Returns the
     JSON string; also writes it to ``fname`` when given."""
@@ -158,18 +183,7 @@ def export_xgboost_json(booster, fname: Optional[str] = None) -> str:
     rounds = max(1, n_trees // per_round)
     iteration_indptr = [r * per_round for r in range(rounds + 1)]
 
-    obj_name = str(booster.params.objective)
-    pkey, pdefault = _OBJECTIVE_PARAM_KEYS.get(
-        obj_name, ("reg_loss_param", {"scale_pos_weight": "1"})
-    )
-    pval = dict(pdefault)
-    if pkey == "softmax_multiclass_param":
-        pval["num_class"] = str(int(booster.params.num_class or 0))
-    if pkey == "aft_loss_param":
-        pval["aft_loss_distribution"] = str(booster.params.aft_loss_distribution)
-        pval["aft_loss_distribution_scale"] = str(
-            booster.params.aft_loss_distribution_scale
-        )
+    obj_name, pkey, pval = objective_param_entry(booster.params)
 
     gbtree_model = {
         "gbtree_model_param": {

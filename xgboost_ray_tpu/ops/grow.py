@@ -156,6 +156,13 @@ class GrowConfig:
     grow_policy: str = "depthwise"
     # leaf budget for lossguide (resolved by the engine: 0 -> 2^max_depth)
     max_leaves: int = 0
+    # wire format of the per-level histogram allreduce: "none" (f32 psum) |
+    # "int16" | "int8" (quantized collective, ops/histogram.py). The engine
+    # resolves this into the ``hist_allreduce`` callable; carried here so
+    # the jit-static config names the full histogram contract.
+    hist_quant: str = "none"
+    # sub-threshold payloads keep the exact f32 psum (latency-bound regime)
+    hist_quant_min_bytes: int = 32768
 
     @property
     def heap_size(self) -> int:
@@ -204,10 +211,20 @@ def build_tree(
     allreduce: Callable[[jnp.ndarray], jnp.ndarray] = lambda x: x,
     feature_log_weights: Optional[jnp.ndarray] = None,  # [F] log(fw), -inf at 0
     feat_has_missing: Optional[jnp.ndarray] = None,  # [F] bool, global
+    hist_allreduce: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = None,
+    ar_counter=None,  # AllreduceBytes: scan-scoped byte accounting
 ):
     """Grow one tree. Returns (Tree, row_value[N]) — row_value is the leaf
     value each row receives (learning-rate scaled), used to update margins
-    without re-walking the tree."""
+    without re-walking the tree.
+
+    ``hist_allreduce`` merges the per-level [n_nodes, F, nbt, 2] histogram
+    across shards (the hot collective; may be quantized per
+    ``cfg.hist_quant``). The small exact reductions — per-child row counts
+    and final-level node sums — always go through ``allreduce``, so leaf
+    weights and the sibling-subtraction child choice never carry
+    quantization error. Defaults to ``allreduce`` when not given."""
+    hist_ar = hist_allreduce if hist_allreduce is not None else allreduce
     if cfg.grow_policy == "lossguide":
         from xgboost_ray_tpu.ops.grow_lossguide import build_tree_lossguide
 
@@ -218,6 +235,8 @@ def build_tree(
             feature_mask=feature_mask,
             allreduce=allreduce,
             feat_has_missing=feat_has_missing,
+            hist_allreduce=hist_ar,
+            ar_counter=ar_counter,
         )
     n, num_features = bins.shape
     nbt = cfg.max_bin + 1
@@ -281,6 +300,41 @@ def build_tree(
         n_nodes = 1 << d
         base = n_nodes - 1
 
+        # Does THIS level's histogram cross the quantization size threshold?
+        # (Mirrors quantized_hist_allreduce's static decision on the built
+        # tensor.) Sub-threshold levels take the exact f32 psum, and then
+        # node totals also come from the histogram readout — bit-identical
+        # to hist_quant="none", so small problems are a provable no-op.
+        sib = cfg.sibling_subtract and d > 0
+        build_nodes = (n_nodes // 2) if sib else n_nodes
+        exact_totals = (
+            cfg.hist_quant != "none"
+            and build_nodes * num_features * nbt * 2 * 4
+            >= cfg.hist_quant_min_bytes
+        )
+
+        node_gh_exact = counts_live = None
+        if exact_totals:
+            # quantized histogram wire: node totals must stay full-precision
+            # (they become leaf weights -g/(h+lambda)), and the sibling-
+            # subtraction child choice needs exact live-row counts. ONE
+            # packed [n_nodes, 3] psum carries both — a single extra small
+            # collective per level regardless of mode.
+            gh_live = jnp.where(done[:, None], 0.0, gh)
+            packed = allreduce(
+                jnp.concatenate(
+                    [
+                        node_sums(gh_live, pos, n_nodes),
+                        jnp.zeros((n_nodes, 1), jnp.float32)
+                        .at[pos, 0]
+                        .add((~done).astype(jnp.float32)),
+                    ],
+                    axis=1,
+                )
+            )
+            node_gh_exact = packed[:, :2]
+            counts_live = packed[:, 2]
+
         def _build(gh_b, pos_b, order_b, counts_b, nn, rows_sel=None):
             """One histogram build over nn node slots with the configured impl.
 
@@ -342,9 +396,13 @@ def build_tree(
             # The choice must be identical on every shard, so it is made from
             # allreduced per-child row counts.
             n_par = n_nodes // 2
-            child_counts = allreduce(
-                jnp.zeros((n_nodes,), jnp.float32).at[pos].add(
-                    (~done).astype(jnp.float32)
+            child_counts = (
+                counts_live
+                if counts_live is not None
+                else allreduce(
+                    jnp.zeros((n_nodes,), jnp.float32).at[pos].add(
+                        (~done).astype(jnp.float32)
+                    )
                 )
             )
             # [n_par] True when the right child is the (weakly) smaller one
@@ -379,17 +437,17 @@ def build_tree(
 
                 if cfg.shards_may_skew:
                     fits = counts_sel.sum() <= rows.shape[0]
-                    hist_small = allreduce(
+                    hist_small = hist_ar(
                         jax.lax.cond(fits, _compacted, _zeroed, None)
                     )
                 else:
-                    hist_small = allreduce(_compacted(None))
+                    hist_small = hist_ar(_compacted(None))
             else:
                 parent_pos = pos >> 1
                 is_right = (pos & 1).astype(bool)
                 sel = (is_right == small_is_right[parent_pos]) & ~done
                 gh_sel = gh * sel[:, None].astype(gh.dtype)
-                hist_small = allreduce(
+                hist_small = hist_ar(
                     _build(gh_sel, parent_pos, None, None, n_par)
                 )
             hist_big = prev_hist - hist_small
@@ -400,7 +458,7 @@ def build_tree(
                 (n_nodes,) + hist_small.shape[1:]
             )
         else:
-            hist = allreduce(_build(gh, pos, order, counts, n_nodes))
+            hist = hist_ar(_build(gh, pos, order, counts, n_nodes))
         prev_hist = hist
         # [n_nodes, 2]: feature 0's buckets cover every row. Under
         # hist_precision="fast" these totals carry the regular bins' bf16
@@ -408,7 +466,14 @@ def build_tree(
         # bucket no longer re-balances the sum) — accepted as part of the
         # fast-precision accuracy/speed contract; use the default precision
         # when exact node totals matter.
-        node_gh = hist[:, 0, :, :].sum(axis=1)
+        # under a quantized wire the histogram's feature-0 totals carry the
+        # quantization rounding, which would land straight in the leaf
+        # weights -g/(h+lambda); the packed exact psum above keeps node
+        # totals full-precision while only the split *search* sees
+        # quantized bin sums
+        node_gh = (
+            node_gh_exact if exact_totals else hist[:, 0, :, :].sum(axis=1)
+        )
 
         fmask = feature_mask
         if colsample_bylevel < 1.0 and level_rng is not None:

@@ -35,7 +35,11 @@ from xgboost_ray_tpu.ops.grow import (
     empty_tree,
     route_right_binned,
 )
-from xgboost_ray_tpu.ops.histogram import hist_onehot, zero_phantom_missing
+from xgboost_ray_tpu.ops.histogram import (
+    hist_onehot,
+    node_sums,
+    zero_phantom_missing,
+)
 from xgboost_ray_tpu.ops.split import find_splits, leaf_weight
 
 
@@ -47,9 +51,17 @@ def build_tree_lossguide(
     feature_mask: Optional[jnp.ndarray] = None,  # [F] bool (colsample_bytree)
     allreduce: Callable[[jnp.ndarray], jnp.ndarray] = lambda x: x,
     feat_has_missing: Optional[jnp.ndarray] = None,
+    hist_allreduce: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = None,
+    ar_counter=None,  # AllreduceBytes: the scan body traces once, runs
+    #   leaves-1 times — the repeated() scope keeps byte accounting exact
 ):
     """Grow one leaf-wise tree. Returns (Tree, row_value[N]) — the same
-    contract as ``build_tree`` so the engine's round step is policy-blind."""
+    contract as ``build_tree`` so the engine's round step is policy-blind.
+
+    ``hist_allreduce`` merges the per-step 2-node histogram (may be
+    quantized per ``cfg.hist_quant``); exact node totals ride ``allreduce``
+    when quantization is on, mirroring the depthwise grower."""
+    hist_ar = hist_allreduce if hist_allreduce is not None else allreduce
     n, num_features = bins.shape
     nbt = cfg.max_bin + 1
     missing_bin = cfg.max_bin
@@ -68,14 +80,28 @@ def build_tree_lossguide(
             bins, gh_b, pos_b, nn, nbt,
             chunk=cfg.hist_chunk, precision=cfg.hist_precision,
         )
-        return zero_phantom_missing(allreduce(h), feat_has_missing)
+        return zero_phantom_missing(hist_ar(h), feat_has_missing)
+
+    def _node_gh(hist, gh_b, pos_b, nn):
+        # [nn, 2] totals: exact psum when the histogram wire is quantized
+        # (leaf weights must not carry quantization rounding), feature-0
+        # readout otherwise (free). Mirrors quantized_hist_allreduce's
+        # static size-threshold decision so sub-threshold trees stay
+        # bit-identical to hist_quant="none".
+        quantized = (
+            cfg.hist_quant != "none"
+            and nn * num_features * nbt * 2 * 4 >= cfg.hist_quant_min_bytes
+        )
+        if quantized:
+            return allreduce(node_sums(gh_b, pos_b, nn))
+        return hist[:, 0, :, :].sum(axis=1)
 
     tree = empty_tree(heap)
     pos = jnp.zeros((n,), jnp.int32)
 
     # --- root: evaluate its best split, seed the frontier -------------------
     root_hist = _hist(gh, pos, 1)  # [1, F, nbt, 2]
-    root_gh = root_hist[:, 0, :, :].sum(axis=1)  # [1, 2]
+    root_gh = _node_gh(root_hist, gh, pos, 1)  # [1, 2]
     sp0 = find_splits(root_hist, root_gh, cfg.split,
                       feature_mask=feature_mask, cat_mask=cat_mask)
     root_value = lr * leaf_weight(root_gh[:, 0], root_gh[:, 1], cfg.split)[0]
@@ -141,7 +167,7 @@ def build_tree_lossguide(
         gh_sel = gh * sel[:, None].astype(gh.dtype)
         pos2 = go_right.astype(jnp.int32)
         hist2 = _hist(gh_sel, pos2, 2)  # [2, F, nbt, 2]
-        child_gh = hist2[:, 0, :, :].sum(axis=1)  # [2, 2]
+        child_gh = _node_gh(hist2, gh_sel, pos2, 2)  # [2, 2]
         sp2 = find_splits(hist2, child_gh, cfg.split,
                           feature_mask=feature_mask, cat_mask=cat_mask)
         child_slots = jnp.stack([l_slot, r_slot])
@@ -187,9 +213,17 @@ def build_tree_lossguide(
                 ent_dl), None
 
     if leaves > 1:
+        import contextlib
+
         carry = (tree, pos, ent_pos, ent_active, ent_gain, ent_feat, ent_bin,
                  ent_dl)
-        carry, _ = jax.lax.scan(body, carry, jnp.arange(leaves - 1))
+        scope = (
+            ar_counter.repeated(leaves - 1)
+            if ar_counter is not None
+            else contextlib.nullcontext()
+        )
+        with scope:
+            carry, _ = jax.lax.scan(body, carry, jnp.arange(leaves - 1))
         tree, pos = carry[0], carry[1]
 
     row_value = tree.value[pos]

@@ -84,6 +84,16 @@ class TrainParams:
     # histogram MXU precision: auto (fast on accelerators, highest on CPU) |
     # highest (f32-exact) | fast (single bf16 pass, ~0.2% bin-sum rounding)
     hist_precision: str = "auto"
+    # histogram ALLREDUCE wire format: none (f32 psum, default) | int16 |
+    # int8 — quantized collective payloads (~4x fewer bytes for int8) with
+    # deterministic rounding and int32 accumulation; node totals / leaf
+    # weights stay exact. Orthogonal to hist_precision (which governs the
+    # on-chip BUILD, this governs the cross-chip MERGE).
+    hist_quant: str = "none"
+    # payloads under this many bytes psum in f32 even when hist_quant is on:
+    # small collectives are latency-bound (no byte win) and staying exact
+    # keeps small-problem tree structure invariant to the world size
+    hist_quant_min_bytes: int = 32768
     hist_chunk: int = 8192
     # build only the smaller child's histogram per parent, derive the sibling
     # by subtraction (xgboost hist-core behavior); disable for A/B debugging
@@ -259,6 +269,12 @@ def parse_params(params: Optional[Dict[str, Any]]) -> TrainParams:
         raise ValueError(
             f"Unknown hist_impl {out.hist_impl!r}; use auto | scatter | "
             f"onehot | partition | mixed.{extra}"
+        )
+
+    if out.hist_quant not in ("none", "int16", "int8"):
+        raise ValueError(
+            f"Unknown hist_quant {out.hist_quant!r}; use none | int16 | "
+            f"int8 (quantized histogram allreduce wire format)."
         )
 
     if out.grow_policy not in ("depthwise", "lossguide"):
