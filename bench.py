@@ -53,6 +53,11 @@ BASELINE_GPU_HIST_S = 120.0
 # factor vs the newest recorded BENCH_*.json of the same backend
 TRIPWIRE_RATIO = 1.2
 
+# serving p99 latency gets a looser band: tail latency on a shared CPU mesh
+# is noisier than steady per-round medians (scheduler jitter lands directly
+# in the p99), so 1.2x would fire on environmental noise alone
+SERVE_TRIPWIRE_RATIO = 1.5
+
 
 def _load_latest_bench_record(bench_dir):
     """Newest BENCH_*.json result dict (by round number, then mtime).
@@ -140,6 +145,161 @@ def round_time_tripwire(current_s, prev_rec, prev_name=None, backend=None,
             file=sys.stderr,
         )
     return out
+
+
+def serve_latency_tripwire(current_serve, prev_rec, prev_name=None,
+                           backend=None, threshold=SERVE_TRIPWIRE_RATIO):
+    """Compare this run's serve p99 against the newest recorded bench.
+
+    The serving analog of ``round_time_tripwire``: returns
+    ``{prev_p99_ms, prev_record, ratio, fired}`` or None when no comparable
+    record exists (different backend, no recorded ``serve`` section). Only
+    fires like-for-like — when the recorded run used a different closed-loop
+    config (clients / max_batch / deadline / request profile), the
+    comparison is still reported with ``config_mismatch`` set and ``fired``
+    False, since a p99 under different load is not a regression signal."""
+    if not isinstance(current_serve, dict):
+        return None
+    cur = current_serve.get("latency_p99_ms")
+    if not cur or not isinstance(prev_rec, dict):
+        return None
+    if backend and prev_rec.get("backend") and prev_rec["backend"] != backend:
+        return None
+    prev_serve = prev_rec.get("serve")
+    if not isinstance(prev_serve, dict):
+        return None
+    prev = prev_serve.get("latency_p99_ms")
+    if not prev:
+        return None
+    ratio = float(cur) / float(prev)
+    out = {
+        "prev_p99_ms": round(float(prev), 4),
+        "prev_record": prev_name,
+        "ratio": round(ratio, 3),
+        "fired": False,
+    }
+    if prev_serve.get("config") != current_serve.get("config"):
+        out["config_mismatch"] = True
+        return out
+    if ratio > threshold:
+        out["fired"] = True
+        print(
+            f"[bench] SERVE TRIPWIRE: p99 latency {cur:.2f}ms is "
+            f"{ratio:.2f}x the newest recorded run ({prev:.2f}ms in "
+            f"{prev_name or 'BENCH_*.json'}) — >{(threshold - 1) * 100:.0f}% "
+            f"regression. Investigate before trusting this build's serving "
+            f"tail.",
+            file=sys.stderr,
+        )
+    return out
+
+
+def run_serve_measurement():
+    """Closed-loop serving benchmark: train a small model, serve it over
+    loopback HTTP on the ambient mesh, drive it with concurrent clients,
+    and return the endpoint's /metrics snapshot (plus the loop config) as
+    the ``serve`` section of the bench record."""
+    import json as json_mod
+    import threading
+    import urllib.request
+
+    import jax
+
+    from xgboost_ray_tpu import RayDMatrix, RayParams, train
+    from xgboost_ray_tpu import serve as serve_mod
+
+    n_rows = int(os.environ.get("BENCH_SERVE_TRAIN_ROWS", 20_000))
+    rounds = int(os.environ.get("BENCH_SERVE_TRAIN_ROUNDS", 5))
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 16))
+    max_batch = int(os.environ.get("BENCH_SERVE_MAX_BATCH", 256))
+    max_delay_ms = float(os.environ.get("BENCH_SERVE_MAX_DELAY_MS", 2.0))
+    req_rows_max = int(os.environ.get("BENCH_SERVE_REQ_ROWS", 32))
+    duration_s = float(os.environ.get("BENCH_SERVE_SECONDS", 6.0))
+    warm_s = float(os.environ.get("BENCH_SERVE_WARM_SECONDS", 1.5))
+    n_feat = 28
+
+    x, y = make_higgs_like(n_rows, n_feat, seed=1)
+    bst = train(
+        {"objective": "binary:logistic", "max_depth": 6, "eta": 0.1,
+         "max_bin": 256, "tree_method": "tpu_hist"},
+        RayDMatrix(x, y), num_boost_round=rounds,
+        ray_params=RayParams(num_actors=max(1, len(jax.devices())),
+                             checkpoint_frequency=0),
+    )
+    handle = serve_mod.create_server(
+        bst, devices=jax.devices(), max_batch=max_batch,
+        max_delay_ms=max_delay_ms,
+    )
+    print(f"[bench] serve endpoint up at {handle.url} "
+          f"(devices={len(jax.devices())} max_batch={max_batch} "
+          f"max_delay_ms={max_delay_ms} clients={clients})", file=sys.stderr)
+
+    stop = threading.Event()
+    errors = []
+
+    def client(seed):
+        rng = np.random.RandomState(seed)
+        while not stop.is_set():
+            n = int(rng.randint(1, req_rows_max + 1))
+            lo = int(rng.randint(0, n_rows - n))
+            body = json_mod.dumps(
+                {"data": x[lo : lo + n].tolist()}
+            ).encode("utf-8")
+            req = urllib.request.Request(
+                handle.url + "/predict", body,
+                {"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=30.0) as r:
+                    r.read()
+            except Exception as exc:  # noqa: BLE001 - counted, loop on
+                if not stop.is_set():
+                    errors.append(repr(exc))
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(clients)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(warm_s)  # steady-state only: warmup traffic excluded
+        handle.metrics.reset()  # also re-baselines the recompile counter
+        del errors[:]  # client_errors must describe the measured window too
+        time.sleep(duration_s)
+        # recompile_count is since-reset, i.e. inside the measured window
+        # (the steady-state claim: this should be 0)
+        snap = handle.metrics.snapshot()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(10.0)
+        handle.shutdown()
+    section = {
+        k: snap[k]
+        for k in (
+            "requests", "rows", "errors", "qps", "rows_per_s", "batches",
+            "mean_batch_rows", "padding_waste", "latency_p50_ms",
+            "latency_p95_ms", "latency_p99_ms", "latency_mean_ms",
+            "recompile_count",
+        )
+    }
+    section["client_errors"] = len(errors)
+    section["config"] = {
+        "clients": clients,
+        "max_batch": max_batch,
+        "max_delay_ms": max_delay_ms,
+        "req_rows_max": req_rows_max,
+        "duration_s": duration_s,
+        "devices": len(jax.devices()),
+        # served-model size changes per-batch predict cost: part of
+        # like-for-like, so a different model never compares as "same run"
+        "train_rows": n_rows,
+        "train_rounds": rounds,
+        "max_depth": 6,
+    }
+    print(f"[bench] serve closed-loop: {section}", file=sys.stderr)
+    return section
 
 
 def make_higgs_like(n_rows: int, n_features: int, seed: int = 0):
@@ -389,6 +549,20 @@ def run_measurement():
         detail["hist_quant_ablation"] = abl
         print(f"[bench] hist_quant ablation: {abl}", file=sys.stderr)
 
+    # closed-loop serving benchmark (the online-inference counterpart of the
+    # training protocol). Default on for the CPU mesh; opt-in on TPU via
+    # BENCH_SERVE=1 (it adds a short extra training + a few seconds of
+    # serving traffic).
+    serve_env = os.environ.get("BENCH_SERVE")
+    if serve_env == "1" or (serve_env is None and not on_tpu):
+        serve_section = run_serve_measurement()
+        strip = serve_latency_tripwire(
+            serve_section, prev_rec, prev_name, backend=backend
+        )
+        if strip is not None:
+            serve_section["regression_tripwire"] = strip
+        detail["serve"] = serve_section
+
     # normalize to the full protocol (11M rows x 100 rounds) when a smaller
     # config was run, so the metric stays comparable across environments
     scale = (11_000_000 / n_rows) * (100 / rounds)
@@ -509,8 +683,41 @@ def main():
         sys.exit(1)
 
 
+def serve_only_main():
+    """``--serve``: run ONLY the closed-loop serving benchmark and print one
+    JSON line headlined by its QPS, with the full ``serve`` section. Runs on
+    the 8-device virtual CPU mesh unless BENCH_SERVE_ON_ACCEL=1 keeps the
+    ambient accelerator backend."""
+    if os.environ.get("BENCH_SERVE_ON_ACCEL") != "1":
+        _force_cpu_mesh()
+    import jax
+
+    backend = jax.default_backend()
+    section = run_serve_measurement()
+    prev_rec, prev_name = _load_latest_bench_record(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    trip = serve_latency_tripwire(section, prev_rec, prev_name,
+                                  backend=backend)
+    if trip is not None:
+        section["regression_tripwire"] = trip
+    print(
+        json.dumps(
+            {
+                "metric": "serve_closed_loop_qps",
+                "value": section["qps"],
+                "unit": "req/s",
+                "backend": backend,
+                "serve": section,
+            }
+        )
+    )
+
+
 if __name__ == "__main__":
-    if "--run" in sys.argv:
+    if "--serve" in sys.argv:
+        serve_only_main()
+    elif "--run" in sys.argv:
         run_measurement()
     else:
         main()

@@ -1,0 +1,80 @@
+"""Online inference serving: train, serve over HTTP, hot-swap a retrain.
+
+Demonstrates the ``xgboost_ray_tpu.serve`` subsystem end to end on the
+local mesh: a trained booster goes into a loopback HTTP endpoint
+(microbatched, padded-bucket compiled predictor), clients POST /predict,
+a retrained model is hot-swapped in with zero downtime, and /metrics
+reports QPS / latency percentiles / padding waste / recompile count.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+from sklearn import datasets
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+from xgboost_ray_tpu import serve
+
+
+def _post(url, path, doc):
+    req = urllib.request.Request(
+        url + path, json.dumps(doc).encode("utf-8"),
+        {"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30.0) as r:
+        return json.loads(r.read())
+
+
+def main():
+    data, labels = datasets.load_breast_cancer(return_X_y=True)
+    x = data.astype(np.float32)
+    y = labels.astype(np.float32)
+
+    bst = train(
+        {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3},
+        RayDMatrix(x, y), num_boost_round=8,
+        ray_params=RayParams(num_actors=2),
+    )
+
+    # serve it: ephemeral loopback port, 2 ms microbatch deadline
+    handle = serve.create_server(bst, max_batch=128, max_delay_ms=2.0)
+    print(f"serving at {handle.url}")
+
+    r = _post(handle.url, "/predict", {"data": x[:8].tolist()})
+    print(f"v{r['model_version']} predictions: "
+          f"{np.round(r['predictions'], 4).tolist()}")
+    assert np.allclose(r["predictions"], bst.predict(x[:8]))
+
+    # margins and SHAP contributions ride the same endpoint
+    r = _post(handle.url, "/predict", {"data": x[:2].tolist(),
+                                       "kind": "contribs"})
+    contribs = np.asarray(r["predictions"])
+    print(f"contribs rows sum to margins: "
+          f"{np.round(contribs.sum(axis=1), 4).tolist()}")
+
+    # retrain (e.g. on fresh data) and hot-swap: drains in-flight batches,
+    # then flips atomically — no restart, no dropped requests
+    bst2 = train(
+        {"objective": "binary:logistic", "max_depth": 4, "eta": 0.1},
+        RayDMatrix(x, y), num_boost_round=8,
+        ray_params=RayParams(num_actors=2),
+    )
+    v2 = handle.registry.load(bst2)
+    r = _post(handle.url, "/predict", {"data": x[:8].tolist()})
+    assert r["model_version"] == v2
+    assert np.allclose(r["predictions"], bst2.predict(x[:8]))
+    print(f"hot-swapped to v{v2}")
+
+    with urllib.request.urlopen(handle.url + "/metrics", timeout=10.0) as resp:
+        m = json.loads(resp.read())
+    print(f"metrics: qps={m['qps']} p50={m['latency_p50_ms']}ms "
+          f"p99={m['latency_p99_ms']}ms padding_waste={m['padding_waste']} "
+          f"recompiles={m['recompile_count']} swaps={m['model_swaps']}")
+
+    handle.shutdown()
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -60,6 +60,59 @@ def test_tripwire_none_without_comparable_record():
     assert bench.round_time_tripwire(None, {"metric": "m"}, "x") is None
 
 
+_SERVE_CFG = {"clients": 16, "max_batch": 256, "max_delay_ms": 2.0,
+              "req_rows_max": 32, "duration_s": 6.0, "devices": 8}
+
+
+def _serve_section(p99, cfg=None):
+    return {"latency_p99_ms": p99, "qps": 100.0,
+            "config": dict(cfg if cfg is not None else _SERVE_CFG)}
+
+
+def test_serve_tripwire_fires_on_p99_regression(capsys):
+    rec = {"metric": "m", "backend": "cpu", "serve": _serve_section(100.0)}
+    out = bench.serve_latency_tripwire(
+        _serve_section(200.0), rec, "BENCH_r06.json", backend="cpu"
+    )
+    assert out is not None and out["fired"]
+    assert out["ratio"] == 2.0
+    assert out["prev_p99_ms"] == 100.0
+    assert "SERVE TRIPWIRE" in capsys.readouterr().err
+
+
+def test_serve_tripwire_quiet_within_threshold(capsys):
+    rec = {"metric": "m", "backend": "cpu", "serve": _serve_section(100.0)}
+    out = bench.serve_latency_tripwire(
+        _serve_section(140.0), rec, "x", backend="cpu"
+    )
+    assert out is not None and not out["fired"]
+    assert "SERVE TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_serve_tripwire_reports_but_never_fires_on_config_mismatch(capsys):
+    """A p99 under different closed-loop load (client count, batch knobs) is
+    not like-for-like — reported with the mismatch named, never fired."""
+    other = dict(_SERVE_CFG, clients=4)
+    rec = {"metric": "m", "backend": "cpu",
+           "serve": _serve_section(100.0, other)}
+    out = bench.serve_latency_tripwire(
+        _serve_section(500.0), rec, "x", backend="cpu"
+    )
+    assert out is not None and not out["fired"]
+    assert out["config_mismatch"] is True
+    assert "SERVE TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_serve_tripwire_skips_cross_backend_and_missing_section():
+    cur = _serve_section(200.0)
+    rec_tpu = {"metric": "m", "backend": "tpu", "serve": _serve_section(100.0)}
+    assert bench.serve_latency_tripwire(cur, rec_tpu, "x", backend="cpu") is None
+    rec_none = {"metric": "m", "backend": "cpu"}  # pre-serve-era record
+    assert bench.serve_latency_tripwire(cur, rec_none, "x", backend="cpu") is None
+    assert bench.serve_latency_tripwire(None, rec_tpu, "x") is None
+    assert bench.serve_latency_tripwire({}, rec_tpu, "x") is None
+
+
 def test_load_latest_bench_record_picks_newest_round(tmp_path):
     for n, val in ((1, 0.9), (5, 1.44), (3, 0.8)):
         (tmp_path / f"BENCH_r{n:02d}.json").write_text(

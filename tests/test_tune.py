@@ -209,6 +209,56 @@ def test_tuner_concurrent_trials(tmp_path, xy):
     assert len(a) == len(jax.devices()) // 2
 
 
+def test_partition_devices_uses_every_device():
+    """Slot math must distribute the remainder instead of dropping trailing
+    devices when len(devices) % n_slots != 0 (tuner.py slot fix)."""
+    from xgboost_ray_tpu.tuner import _partition_devices
+
+    for n_dev in (8, 7, 5):
+        devs = list(range(n_dev))
+        for n_slots in (1, 2, 3, 4, 5):
+            slots = _partition_devices(devs, n_slots)
+            assert len(slots) == min(n_slots, n_dev)
+            flat = [d for s in slots for d in s]
+            assert flat == devs  # disjoint, ordered, nothing dropped
+            sizes = [len(s) for s in slots]
+            assert max(sizes) - min(sizes) <= 1  # near-even split
+
+
+def test_tuner_concurrent_trials_ragged_slots(tmp_path, xy):
+    """3 slots over the 8-device mesh: sizes 3/3/2, union == all devices."""
+    import jax
+
+    from xgboost_ray_tpu.tuner import Tuner, grid_search
+
+    x, y = xy
+    seen_devices = []
+
+    def trainable(config):
+        from xgboost_ray_tpu import tune as tune_mod
+
+        sess = tune_mod.get_session()
+        seen_devices.append(tuple(sess.devices))
+        train(
+            {"objective": "binary:logistic", "eta": config["eta"]},
+            RayDMatrix(x, y), 2,
+            ray_params=RayParams(num_actors=2),
+        )
+
+    tuner = Tuner(
+        trainable, {"eta": grid_search([0.1, 0.3, 0.5])},
+        metric="train-logloss", mode="min",
+        experiment_dir=str(tmp_path), max_concurrent_trials=3,
+    )
+    result = tuner.fit()
+    assert all(t.error is None for t in result.trials)
+    slices = set(seen_devices)
+    used = {d for s in slices for d in s}
+    assert used == set(jax.devices())  # no trailing devices idle
+    sizes = sorted(len(s) for s in slices)
+    assert max(sizes) - min(sizes) <= 1
+
+
 def test_asha_scheduler_unit():
     """ASHA rung logic: at rung r, values outside the top 1/eta stop."""
     from xgboost_ray_tpu.tuner import ASHAScheduler

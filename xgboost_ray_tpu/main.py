@@ -506,6 +506,7 @@ _KNOWN_TRAIN_KWARGS = {
     "verbose_eval",
     "xgb_model",
     "maximize",
+    "serve_registry",
 }
 
 
@@ -1066,6 +1067,19 @@ def train(
     if isinstance(evals, tuple) and len(evals) == 2 and isinstance(evals[1], str):
         evals = [evals]  # single (dm, name) tuple — normalize BEFORE remote ship
 
+    # online-serving handoff: when a serve.ModelRegistry is passed, the
+    # trained booster is hot-swapped into it on completion (drain-then-flip,
+    # see serve/registry.py) so a colocated endpoint picks up the retrain
+    # without a restart. Popped before the remote ship: a registry holds
+    # live locks/threads and cannot cross the process boundary.
+    serve_registry = kwargs.pop("serve_registry", None)
+    if serve_registry is not None and _remote:
+        raise ValueError(
+            "serve_registry cannot be combined with _remote=True: the "
+            "registry lives in this process. Train remotely, then call "
+            "registry.load(booster) on the result."
+        )
+
     if _remote:
         bst, remote_evals, remote_extra = _run_remote(
             "train",
@@ -1099,6 +1113,14 @@ def train(
     kwargs_callbacks = tune_mod._try_add_tune_callback(kwargs_callbacks)
 
     parsed = parse_params(params)  # early validation (tree_method etc.)
+    if serve_registry is not None and parsed.booster == "gblinear":
+        # fail BEFORE training, not after hours of boosting: the serve
+        # layer compiles the padded forest walk, which linear models lack
+        raise ValueError(
+            "serve_registry is not supported with booster='gblinear' "
+            "(the serving layer compiles tree-walk programs). Train "
+            "without serve_registry and serve the model another way."
+        )
     del parsed
 
     if ray_params.elastic_training and ray_params.max_failed_actors == 0:
@@ -1255,6 +1277,14 @@ def train(
             f"[RayXGBoost] Finished training after {total_time:.2f}s "
             f"({total_training_time:.2f}s pure training)."
         )
+    if serve_registry is not None:
+        state.additional_results["serve_model_version"] = serve_registry.load(
+            booster
+        )
+        if additional_results is not None:
+            additional_results["serve_model_version"] = state.additional_results[
+                "serve_model_version"
+            ]
     return booster
 
 

@@ -151,6 +151,31 @@ class RayXGBoostBooster:
         heap = self.forest.feature.shape[1]
         return int(np.log2(heap + 1)) - 1
 
+    def signature(self) -> tuple:
+        """Structural identity for compiled-program caching (the serve
+        layer's cache key): everything that changes the traced prediction
+        program — forest/feature shapes, static walk parameters, and the
+        objective envelope that drives the margin transform — but NOT the
+        array contents, so a hot-swap to a same-shaped retrain reuses every
+        compiled program."""
+        p = self.params
+        return (
+            "gbtree",
+            int(self.forest.feature.shape[0]),  # trees
+            int(self.forest.feature.shape[1]),  # heap slots
+            self.num_features,
+            self.num_outputs,
+            self.max_depth,
+            p.num_parallel_tree,
+            self.tree_weights is not None,
+            self.cat_features,
+            p.objective,
+            p.num_class,
+            float(p.scale_pos_weight),
+            tuple(p.quantile_alpha) if isinstance(
+                p.quantile_alpha, (list, tuple)) else p.quantile_alpha,
+        )
+
     def num_boosted_rounds(self) -> int:
         per_round = self.num_outputs * self.params.num_parallel_tree
         return int(self.forest.feature.shape[0] // per_round)

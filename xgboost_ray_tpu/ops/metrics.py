@@ -131,7 +131,12 @@ _ELEMENTWISE: Dict[str, Callable] = {
 #   histograms are psum-merged and the area computed from the merged CDF with
 #   midrank (trapezoid) tie handling inside the bin. Distributed xgboost is
 #   itself approximate here (it averages per-worker AUCs); 4096 bins is
-#   tighter than that.
+#   tighter than that. When the binning error matters (reporting, paper
+#   numbers), request "auc_exact": the exact sort-based rank statistic,
+#   deliberately NOT a device metric — it runs on the host gather path
+#   (per-round stepping; on multi-host meshes it degrades to the reference's
+#   per-worker weighted mean). tests/test_metrics_device.py pins the binned
+#   metric's error bound against it.
 # * ndcg/map: computed per query group on the padded [NG, G] group layout the
 #   ranking gradients already use (groups never straddle shards), reduced to
 #   psum-able (sum over groups, group count).
@@ -446,7 +451,7 @@ def parse_metric_name(name: str) -> Tuple[str, Optional[float]]:
 
 def is_maximize_metric(name: str) -> bool:
     base, _ = parse_metric_name(name)
-    return base in ("auc", "ndcg", "map", "aucpr")
+    return base in ("auc", "ndcg", "map", "aucpr", "auc_exact")
 
 
 def compute_metric(
@@ -504,9 +509,9 @@ def compute_metric(
         num, den = float(num), float(den)
         val = num / max(den, 1e-12)
         return float(np.sqrt(val)) if base in ("rmse", "rmsle") else val
-    if base in ("auc", "aucpr"):
+    if base in ("auc", "aucpr", "auc_exact"):
         score = margin[:, 0] if margin.shape[1] == 1 else margin[:, 1]
-        fn = _auc_np if base == "auc" else _aucpr_np
+        fn = _aucpr_np if base == "aucpr" else _auc_np
         return fn(score.astype(np.float64), label, weight.astype(np.float64))
     if base in ("ndcg", "map"):
         if group_ptr is None:

@@ -1,0 +1,54 @@
+"""Online inference serving for TPU-native GBDT models.
+
+The batch path (``xgboost_ray_tpu.predict``) walks a whole RayDMatrix once;
+this subsystem serves *online* traffic: a compiled-predictor cache with
+power-of-two padded batch buckets (zero recompiles in steady state), a
+microbatching queue coalescing concurrent requests under a latency
+deadline, a model registry with drain-then-flip hot-swap, and a threaded
+stdlib HTTP front-end with /predict, /healthz and /metrics.
+
+Typical use::
+
+    from xgboost_ray_tpu import serve
+
+    bst = train(params, dtrain, ray_params=RayParams(num_actors=8))
+    handle = serve.create_server(bst, port=8000, max_batch=256,
+                                 max_delay_ms=2.0)
+    ...
+    handle.registry.load(new_bst)   # atomic hot-swap, drains in-flight
+    handle.shutdown()
+
+or publish straight from training::
+
+    reg = serve.ModelRegistry()
+    train(params, dtrain, ray_params=rp, serve_registry=reg)
+"""
+
+from xgboost_ray_tpu.serve.batcher import MicroBatcher
+from xgboost_ray_tpu.serve.http import ServeHandle, create_server
+from xgboost_ray_tpu.serve.metrics import ServeMetrics
+from xgboost_ray_tpu.serve.predictor import (
+    KINDS,
+    CompiledPredictor,
+    bucket_rows,
+    compile_count,
+)
+from xgboost_ray_tpu.serve.registry import (
+    ModelRegistry,
+    NoModelError,
+    coerce_model,
+)
+
+__all__ = [
+    "KINDS",
+    "CompiledPredictor",
+    "MicroBatcher",
+    "ModelRegistry",
+    "NoModelError",
+    "ServeHandle",
+    "ServeMetrics",
+    "bucket_rows",
+    "coerce_model",
+    "compile_count",
+    "create_server",
+]

@@ -1,0 +1,209 @@
+"""Microbatching queue: coalesce concurrent requests into padded batches.
+
+Dedicated GBDT inference engines get their throughput from batched,
+layout-specialized tree traversal (Booster, arXiv:2011.02022; the GPU
+prediction kernel of arXiv:1806.11248); on TPU the analog is feeding the
+jit-compiled padded-bucket walk batches as large as latency allows. This
+queue implements the standard two-knob policy:
+
+* ``max_batch`` — flush as soon as the pending rows for one output kind
+  reach this many (throughput bound);
+* ``max_delay_ms`` — flush when the OLDEST pending request has waited this
+  long (latency bound), even if the batch is small.
+
+Requests of different output kinds never share a batch (their programs
+differ); within a kind, rows are concatenated in arrival order, executed
+against one leased model snapshot, and sliced back per request — so every
+response is wholly from one model version, with that version reported back.
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from xgboost_ray_tpu.serve.predictor import KINDS
+from xgboost_ray_tpu.serve.registry import ModelRegistry
+
+
+class _Pending:
+    __slots__ = ("x", "kind", "event", "result", "version", "error", "t_in")
+
+    def __init__(self, x: np.ndarray, kind: str):
+        self.x = x
+        self.kind = kind
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.version: int = 0
+        self.error: Optional[BaseException] = None
+        self.t_in = time.monotonic()
+
+
+class MicroBatcher:
+    """Request queue + background flusher over a ``ModelRegistry``."""
+
+    def __init__(
+        self,
+        registry: ModelRegistry,
+        max_batch: int = 256,
+        max_delay_ms: float = 2.0,
+        metrics=None,
+    ):
+        self.registry = registry
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_ms) / 1000.0
+        self.metrics = metrics
+        self._cond = threading.Condition(threading.Lock())
+        self._queues: Dict[str, List[_Pending]] = {k: [] for k in KINDS}
+        self._depth = 0  # pending requests across kinds (queue_depth gauge)
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._flusher, name="serve-flusher", daemon=True
+        )
+        self._thread.start()
+
+    # -- client side -------------------------------------------------------
+
+    def submit(
+        self, x: np.ndarray, kind: str = "value", timeout: float = 30.0
+    ) -> Tuple[np.ndarray, int]:
+        """Enqueue one [N, F] request; block until its batch executes.
+        Returns ``(result, model_version)``."""
+        if kind not in KINDS:
+            raise ValueError(
+                f"unknown serve output kind {kind!r}; one of {KINDS}"
+            )
+        req = _Pending(np.asarray(x, np.float32), kind)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("batcher is shut down")
+            self._queues[kind].append(req)
+            self._depth += 1
+            self._cond.notify_all()
+        if not req.event.wait(timeout):
+            # shed the request if it is still queued, so an abandoned
+            # client's rows don't occupy device time later and deepen the
+            # overload (mid-execution requests can't be recalled)
+            with self._cond:
+                q = self._queues[kind]
+                if req in q:
+                    q.remove(req)
+                    self._depth -= 1
+            raise TimeoutError(
+                f"serve request did not complete within {timeout}s"
+            )
+        if req.error is not None:
+            raise req.error
+        if self.metrics is not None:
+            self.metrics.observe_request(
+                time.monotonic() - req.t_in, int(req.x.shape[0])
+            )
+        return req.result, req.version
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return self._depth
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        # fail any stragglers rather than leaving clients blocked
+        with self._cond:
+            for q in self._queues.values():
+                for req in q:
+                    req.error = RuntimeError("batcher shut down")
+                    req.event.set()
+                q.clear()
+            self._depth = 0
+
+    # -- flusher side ------------------------------------------------------
+
+    def _ready_kind(self) -> Tuple[Optional[str], float]:
+        """(kind to flush now, seconds until the next deadline). Called
+        under the lock. A kind is ready when it has ``max_batch`` rows
+        pending or its oldest request is past the delay deadline; among
+        ready kinds the one with the OLDEST waiter wins, so sustained
+        max_batch traffic of one kind cannot starve another past its
+        deadline."""
+        now = time.monotonic()
+        ready_kind, ready_oldest = None, float("inf")
+        next_wait = float("inf")
+        for kind, q in self._queues.items():
+            if not q:
+                continue
+            rows = sum(r.x.shape[0] for r in q)
+            deadline = q[0].t_in + self.max_delay_s
+            if rows >= self.max_batch or now >= deadline:
+                if q[0].t_in < ready_oldest:
+                    ready_kind, ready_oldest = kind, q[0].t_in
+            else:
+                next_wait = min(next_wait, deadline - now)
+        if ready_kind is not None:
+            return ready_kind, 0.0
+        return None, next_wait
+
+    def _flusher(self) -> None:
+        while True:
+            with self._cond:
+                kind, wait = self._ready_kind()
+                while kind is None and not self._closed:
+                    self._cond.wait(None if wait == float("inf") else wait)
+                    kind, wait = self._ready_kind()
+                if self._closed:
+                    return
+                batch: List[_Pending] = []
+                rows = 0
+                q = self._queues[kind]
+                # take whole requests up to max_batch rows (never split a
+                # request; a single oversized request flushes alone)
+                while q and (not batch or rows + q[0].x.shape[0] <= self.max_batch):
+                    r = q.pop(0)
+                    batch.append(r)
+                    rows += int(r.x.shape[0])
+                self._depth -= len(batch)
+            self._execute(kind, batch)
+
+    def _execute(self, kind: str, batch: List[_Pending]) -> None:
+        try:
+            with self.registry.lease() as entry:
+                # per-request feature validation against the LEASED model:
+                # a hot-swap between an HTTP-level check and batch
+                # execution may change num_features; fail only the
+                # mismatched requests, not the whole batch
+                f = entry.booster.num_features
+                bad = [r for r in batch if r.x.shape[1] != f]
+                for r in bad:
+                    r.error = ValueError(
+                        f"feature shape mismatch: model v{entry.version} "
+                        f"expects {f}, got {r.x.shape[1]}"
+                    )
+                    r.event.set()
+                batch = [r for r in batch if r.x.shape[1] == f]
+                if not batch:
+                    return
+                x = (
+                    np.concatenate([r.x for r in batch], axis=0)
+                    if len(batch) > 1 else batch[0].x
+                )
+                out, bucket = entry.predictor.predict_with_bucket(x, kind)
+                version = entry.version
+            if self.metrics is not None:
+                self.metrics.observe_batch(int(x.shape[0]), bucket)
+            lo = 0
+            for r in batch:
+                hi = lo + int(r.x.shape[0])
+                r.result = out[lo:hi]
+                r.version = version
+                lo = hi
+        except BaseException as exc:  # noqa: BLE001 - marshal to waiters
+            # not counted here: the error surfaces from submit() and is
+            # counted once per failed request by the front-end (a batch
+            # observe here would double-count every failure)
+            for r in batch:
+                r.error = exc
+        finally:
+            for r in batch:
+                r.event.set()

@@ -1,0 +1,211 @@
+"""Threaded stdlib HTTP front-end for online inference.
+
+Endpoints (JSON in/out, loopback-friendly, no extra dependencies):
+
+* ``POST /predict`` — body ``{"data": [[...], ...], "kind": "value"}``;
+  responds ``{"predictions": [...], "model_version": v, "latency_ms": t}``.
+  ``kind`` is one of ``value | margin | leaf | contribs`` (default value).
+* ``POST /models`` — hot-swap: body ``{"path": "..."}`` (saved native or
+  xgboost JSON model) or ``{"model_json": {...}}``; drains in-flight
+  batches, responds ``{"model_version": v}``.
+* ``GET /healthz`` — 200 ``{"status": "ok", "model_version": v}`` once a
+  model is registered, 503 before.
+* ``GET /metrics`` — the ``ServeMetrics.snapshot()`` dict: qps, queue
+  depth, p50/p95/p99 latency, padding-waste fraction, recompile count —
+  the serving analog of the ``AllreduceBytes``-through-additional_results
+  counter pattern.
+
+Each HTTP request runs on its own thread (``ThreadingHTTPServer``); the
+threads rendezvous in the microbatcher, which is where concurrency turns
+into padded-bucket batches.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from xgboost_ray_tpu.serve.batcher import MicroBatcher
+from xgboost_ray_tpu.serve.metrics import ServeMetrics
+from xgboost_ray_tpu.serve.predictor import compile_count
+from xgboost_ray_tpu.serve.registry import ModelRegistry, NoModelError
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # set by the server factory
+    serve_handle: "ServeHandle" = None
+
+    def log_message(self, fmt, *args):  # silence per-request stderr spam
+        pass
+
+    def _reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        raw = self.rfile.read(length) if length else b"{}"
+        return json.loads(raw.decode("utf-8"))
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        h = self.serve_handle
+        if self.path == "/healthz":
+            if h.registry.has_model:
+                self._reply(200, {
+                    "status": "ok", "model_version": h.registry.version,
+                })
+            else:
+                self._reply(503, {"status": "no_model"})
+            return
+        if self.path == "/metrics":
+            self._reply(200, h.metrics.snapshot())
+            return
+        self._reply(404, {"error": f"unknown path {self.path!r}"})
+
+    def do_POST(self):  # noqa: N802 - http.server API
+        h = self.serve_handle
+        try:
+            doc = self._read_json()
+        except (ValueError, UnicodeDecodeError) as exc:
+            self._reply(400, {"error": f"bad JSON body: {exc}"})
+            return
+        if self.path == "/predict":
+            self._do_predict(h, doc)
+            return
+        if self.path == "/models":
+            self._do_models(h, doc)
+            return
+        self._reply(404, {"error": f"unknown path {self.path!r}"})
+
+    def _do_predict(self, h: "ServeHandle", doc: dict) -> None:
+        t0 = time.monotonic()
+        data = doc.get("data")
+        if data is None:
+            self._reply(400, {"error": "missing 'data'"})
+            return
+        kind = doc.get("kind", "value")
+        try:
+            x = np.asarray(data, np.float32)
+            if x.ndim == 1:
+                x = x[None, :]
+            if x.ndim != 2:
+                raise ValueError(f"'data' must be [rows, features]; got "
+                                 f"ndim={x.ndim}")
+            # feature-count validation happens in the batcher against the
+            # LEASED model (hot-swap safe); its ValueError maps to 400 below
+            result, version = h.batcher.submit(x, kind)
+        except NoModelError as exc:
+            self._reply(503, {"error": str(exc)})
+            return
+        except (ValueError, TypeError) as exc:
+            h.metrics.observe_error()
+            self._reply(400, {"error": str(exc)})
+            return
+        except TimeoutError as exc:
+            h.metrics.observe_error()
+            self._reply(504, {"error": str(exc)})
+            return
+        except Exception as exc:  # noqa: BLE001 - XLA/runtime failures etc.
+            # anything marshalled out of the batch (device runtime errors,
+            # a racing shutdown) must still produce a structured response,
+            # not a dropped connection
+            h.metrics.observe_error()
+            self._reply(500, {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        self._reply(200, {
+            "predictions": np.asarray(result).tolist(),
+            "model_version": version,
+            "kind": kind,
+            "latency_ms": round((time.monotonic() - t0) * 1000.0, 3),
+        })
+
+    def _do_models(self, h: "ServeHandle", doc: dict) -> None:
+        model = doc.get("path") or doc.get("model_json")
+        if model is None:
+            self._reply(400, {"error": "body must carry 'path' or "
+                                       "'model_json'"})
+            return
+        try:
+            version = h.registry.load(model)
+        except (OSError, ValueError, TypeError, KeyError) as exc:
+            self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        self._reply(200, {"model_version": version})
+
+
+class ServeHandle:
+    """One serving endpoint: registry + batcher + metrics + HTTP server."""
+
+    def __init__(
+        self,
+        model=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        devices=None,
+        max_batch: int = 256,
+        max_delay_ms: float = 2.0,
+        min_bucket: int = 8,
+        warm_kinds: tuple = ("value",),
+    ):
+        self.metrics = ServeMetrics(recompile_count_fn=compile_count)
+        self.registry = ModelRegistry(
+            devices=devices,
+            min_bucket=min_bucket,
+            warm_kinds=warm_kinds,
+            warm_max_batch=max_batch,
+            metrics=self.metrics,
+        )
+        # the two steps that can fail (port bind, bad model) run BEFORE the
+        # batcher spawns its flusher thread, so a raising __init__ leaks no
+        # thread the caller has no handle to shut down
+        handler = type("_BoundHandler", (_Handler,), {"serve_handle": self})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self._server_thread: Optional[threading.Thread] = None
+        try:
+            if model is not None:
+                self.registry.load(model)
+            self.batcher = MicroBatcher(
+                self.registry,
+                max_batch=max_batch,
+                max_delay_ms=max_delay_ms,
+                metrics=self.metrics,
+            )
+        except BaseException:
+            self._httpd.server_close()
+            raise
+        self.metrics.queue_depth_fn = self.batcher.queue_depth
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ServeHandle":
+        self._server_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serve-http", daemon=True
+        )
+        self._server_thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._server_thread is not None:
+            self._server_thread.join(5.0)
+        self.batcher.shutdown()
+
+
+def create_server(model=None, host: str = "127.0.0.1", port: int = 0,
+                  **config) -> ServeHandle:
+    """Build and start a serving endpoint; returns its ``ServeHandle``
+    (``.url`` for clients, ``.registry.load()`` for hot-swaps,
+    ``.shutdown()`` when done)."""
+    return ServeHandle(model=model, host=host, port=port, **config).start()

@@ -1,0 +1,173 @@
+"""Serving-side observability counters.
+
+The training path surfaces its one wire counter (``AllreduceBytes``) as a
+plain number threaded through ``additional_results`` (PR 1); the serving
+path follows the same pattern — every gauge here is a host-side Python
+counter, updated under one lock on the request completion path and exported
+as a flat dict by ``snapshot()`` (the payload of the HTTP ``/metrics``
+endpoint and of the bench ``serve`` section). Nothing touches the device.
+
+Latency percentiles come from a fixed log-spaced histogram (60 buckets,
+0.05 ms .. ~170 s at ~1.26x spacing) rather than a reservoir: constant
+memory, O(1) record, and the p50/p95/p99 read is a cumulative walk with
+linear interpolation inside the bucket — the same resolution/overhead
+trade Prometheus client histograms make.
+"""
+
+import math
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+# log-spaced latency bucket upper bounds (ms)
+_BUCKET_BASE_MS = 0.05
+_BUCKET_FACTOR = 1.26
+_N_BUCKETS = 60
+_BOUNDS_MS = [
+    _BUCKET_BASE_MS * _BUCKET_FACTOR ** i for i in range(_N_BUCKETS)
+]
+
+
+class LatencyHistogram:
+    """Fixed log-bucket latency histogram with interpolated percentiles."""
+
+    def __init__(self):
+        self.counts = [0] * (_N_BUCKETS + 1)  # +1 overflow bucket
+        self.total = 0
+        self.sum_ms = 0.0
+
+    def record(self, ms: float) -> None:
+        if ms <= _BOUNDS_MS[0]:
+            idx = 0
+        elif ms > _BOUNDS_MS[-1]:
+            idx = _N_BUCKETS
+        else:
+            idx = int(
+                math.ceil(math.log(ms / _BUCKET_BASE_MS) / math.log(_BUCKET_FACTOR))
+            )
+            idx = min(max(idx, 0), _N_BUCKETS)
+        self.counts[idx] += 1
+        self.total += 1
+        self.sum_ms += ms
+
+    def percentile(self, q: float) -> float:
+        """Interpolated latency at quantile ``q`` in [0, 1]; 0.0 when empty."""
+        if self.total == 0:
+            return 0.0
+        target = q * self.total
+        seen = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if seen + c >= target:
+                hi = _BOUNDS_MS[i] if i < _N_BUCKETS else _BOUNDS_MS[-1] * _BUCKET_FACTOR
+                lo = _BOUNDS_MS[i - 1] if 0 < i <= _N_BUCKETS else 0.0
+                frac = (target - seen) / c
+                return lo + frac * (hi - lo)
+            seen += c
+        return _BOUNDS_MS[-1]
+
+
+class ServeMetrics:
+    """Thread-safe counters for one serving endpoint.
+
+    ``queue_depth_fn`` is injected by the batcher so the gauge reads the
+    live queue without a reverse dependency; ``recompile_count_fn`` reads
+    the predictor layer's trace counter the same way.
+    """
+
+    def __init__(
+        self,
+        queue_depth_fn: Optional[Callable[[], int]] = None,
+        recompile_count_fn: Optional[Callable[[], int]] = None,
+    ):
+        self._lock = threading.Lock()
+        self._started = time.monotonic()
+        self._hist = LatencyHistogram()
+        self.requests = 0
+        self.rows = 0
+        self.errors = 0
+        self.batches = 0
+        self.batch_rows = 0
+        self.padded_rows = 0  # padding rows added on top of batch_rows
+        self.model_swaps = 0
+        self.queue_depth_fn = queue_depth_fn
+        self.recompile_count_fn = recompile_count_fn
+        # the compile counter is process-global (the program cache is shared
+        # so hot-swaps reuse programs); report compiles SINCE this endpoint
+        # came up (re-baselined by reset()), not the process total
+        self._recompile_base = int(recompile_count_fn()) if recompile_count_fn else 0
+
+    def reset(self) -> None:
+        """Zero every counter and restart the clock — used by the closed-loop
+        bench to exclude its warmup traffic from the measured window."""
+        with self._lock:
+            self._started = time.monotonic()
+            self._hist = LatencyHistogram()
+            self.requests = 0
+            self.rows = 0
+            self.errors = 0
+            self.batches = 0
+            self.batch_rows = 0
+            self.padded_rows = 0
+            self.model_swaps = 0
+            if self.recompile_count_fn is not None:
+                self._recompile_base = int(self.recompile_count_fn())
+
+    def observe_request(self, latency_s: float, n_rows: int) -> None:
+        with self._lock:
+            self.requests += 1
+            self.rows += n_rows
+            self._hist.record(latency_s * 1000.0)
+
+    def observe_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def observe_batch(self, n_rows: int, bucket: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.batch_rows += n_rows
+            self.padded_rows += max(bucket - n_rows, 0)
+
+    def observe_swap(self) -> None:
+        with self._lock:
+            self.model_swaps += 1
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            elapsed = max(time.monotonic() - self._started, 1e-9)
+            issued = self.batch_rows + self.padded_rows
+            snap = {
+                "uptime_s": round(elapsed, 3),
+                "requests": self.requests,
+                "rows": self.rows,
+                "errors": self.errors,
+                "qps": round(self.requests / elapsed, 3),
+                "rows_per_s": round(self.rows / elapsed, 3),
+                "batches": self.batches,
+                "mean_batch_rows": round(
+                    self.batch_rows / max(self.batches, 1), 3
+                ),
+                "padding_waste": round(
+                    self.padded_rows / max(issued, 1), 5
+                ),
+                "latency_p50_ms": round(self._hist.percentile(0.50), 4),
+                "latency_p95_ms": round(self._hist.percentile(0.95), 4),
+                "latency_p99_ms": round(self._hist.percentile(0.99), 4),
+                "latency_mean_ms": round(
+                    self._hist.sum_ms / max(self._hist.total, 1), 4
+                ),
+                "model_swaps": self.model_swaps,
+            }
+        if self.queue_depth_fn is not None:
+            snap["queue_depth"] = int(self.queue_depth_fn())
+        if self.recompile_count_fn is not None:
+            snap["recompile_count"] = (
+                int(self.recompile_count_fn()) - self._recompile_base
+            )
+        return snap
+
+    def latency_buckets(self) -> List[int]:
+        with self._lock:
+            return list(self._hist.counts)

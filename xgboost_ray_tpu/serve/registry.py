@@ -1,0 +1,157 @@
+"""Model registry: the serving layer's single mutable cell.
+
+Holds the current ``(version, booster, CompiledPredictor)`` triple and
+swaps it atomically: a swap first builds (and optionally warms) the new
+model's predictor entirely OUTSIDE the lock — compiles happen before the
+swap is visible — then blocks new leases, **drains in-flight batches**, and
+flips the pointer. Every batch executes against the entry its ``lease()``
+snapshotted, so a response is always wholly from one model version; the
+drain guarantees the swap returns only once no batch is still running on
+the old model (the reference semantics of replacing a Ray Serve replica's
+model object).
+
+Models load from any of the shapes the driver produces: a trained
+``RayXGBoostBooster`` (the ``train()`` result / checkpoint payload), a
+pickled checkpoint ``bytes`` blob, a saved native-JSON path, or an xgboost
+JSON document/path (``import_xgboost_json`` interop surface).
+"""
+
+import json
+import pickle
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from xgboost_ray_tpu.models.booster import RayXGBoostBooster
+from xgboost_ray_tpu.serve.predictor import KINDS, CompiledPredictor
+
+
+class NoModelError(RuntimeError):
+    """A request arrived before any model was registered."""
+
+
+@dataclass
+class ModelEntry:
+    version: int
+    booster: RayXGBoostBooster
+    predictor: CompiledPredictor
+    name: str = ""
+
+
+def coerce_model(model: Any) -> RayXGBoostBooster:
+    """Accept the model shapes the driver hands around (see module doc)."""
+    if isinstance(model, RayXGBoostBooster):
+        return model
+    if isinstance(model, bytes):
+        return pickle.loads(model)
+    if isinstance(model, dict):
+        doc = model
+    elif isinstance(model, str):
+        # explicit path-existence dispatch (not brace-sniffing, which
+        # misreads BOM-prefixed documents — same fix as linear.py's import)
+        import os
+
+        if os.path.exists(model):
+            with open(model) as f:
+                doc = json.load(f)
+        else:
+            try:
+                doc = json.loads(model)
+            except ValueError as exc:
+                raise ValueError(
+                    f"serve model string is neither an existing file path "
+                    f"nor valid JSON: {model[:80]!r}"
+                ) from exc
+    else:
+        raise TypeError(
+            f"cannot serve a model of type {type(model).__name__} (gblinear "
+            f"boosters have no padded forest walk to compile); pass a tree "
+            f"RayXGBoostBooster, checkpoint bytes, a saved model path, or "
+            f"an xgboost JSON document."
+        )
+    if doc.get("format") == "xgboost_ray_tpu.booster":
+        return RayXGBoostBooster._from_dict(doc)
+    return RayXGBoostBooster.import_xgboost_json(doc)
+
+
+@dataclass
+class ModelRegistry:
+    """Thread-safe current-model cell with drain-before-swap semantics."""
+
+    devices: Optional[list] = None
+    min_bucket: int = 8
+    #: kinds precompiled on load (before the swap becomes visible)
+    warm_kinds: tuple = ("value",)
+    #: largest batch the warmup covers; align with the batcher's max_batch
+    warm_max_batch: int = 256
+    metrics: Optional[Any] = None  # ServeMetrics, for the swap counter
+
+    _cond: threading.Condition = field(
+        default_factory=lambda: threading.Condition(threading.Lock()),
+        repr=False,
+    )
+    _current: Optional[ModelEntry] = field(default=None, repr=False)
+    _inflight: int = field(default=0, repr=False)
+    _swapping: bool = field(default=False, repr=False)
+    _version: int = field(default=0, repr=False)
+
+    def load(self, model: Any, name: str = "", warm: bool = True) -> int:
+        """Register ``model`` and atomically make it current; returns the
+        new version. Compiles (warmup) happen before the old model stops
+        serving, and in-flight batches drain before the flip."""
+        booster = coerce_model(model)
+        predictor = CompiledPredictor(
+            booster, devices=self.devices, min_bucket=self.min_bucket
+        )
+        if warm and self.warm_kinds:
+            kinds = [k for k in self.warm_kinds if k in KINDS]
+            predictor.warmup(kinds=kinds, max_batch=self.warm_max_batch)
+        with self._cond:
+            # serialize swaps; each waits for the previous flip to finish
+            while self._swapping:
+                self._cond.wait()
+            self._swapping = True
+            while self._inflight:
+                self._cond.wait()
+            self._version += 1
+            entry = ModelEntry(self._version, booster, predictor, name=name)
+            was_live = self._current is not None
+            self._current = entry
+            self._swapping = False
+            self._cond.notify_all()
+        if was_live and self.metrics is not None:
+            self.metrics.observe_swap()
+        return entry.version
+
+    @contextmanager
+    def lease(self):
+        """Snapshot the current entry and hold it in-flight for the scope.
+        Blocks briefly while a swap is draining (so the drain terminates),
+        then yields a consistent entry the swap cannot mutate."""
+        with self._cond:
+            while self._swapping:
+                self._cond.wait()
+            if self._current is None:
+                raise NoModelError(
+                    "no model registered; POST /models or call "
+                    "ModelRegistry.load() first."
+                )
+            entry = self._current
+            self._inflight += 1
+        try:
+            yield entry
+        finally:
+            with self._cond:
+                self._inflight -= 1
+                self._cond.notify_all()
+
+    @property
+    def version(self) -> int:
+        with self._cond:
+            return self._current.version if self._current else 0
+
+    @property
+    def has_model(self) -> bool:
+        with self._cond:
+            return self._current is not None

@@ -25,6 +25,21 @@ from xgboost_ray_tpu import tune as tune_mod
 logger = logging.getLogger(__name__)
 
 
+def _partition_devices(devs: List[Any], n_slots: int) -> List[List[Any]]:
+    """Split ``devs`` into ``n_slots`` contiguous slices covering EVERY
+    device: the first ``len % n_slots`` slots take one extra device, so no
+    trailing devices are dropped when the mesh doesn't divide evenly (the
+    old ``len // n_slots``-sized slices silently idled the remainder)."""
+    n_slots = max(1, min(n_slots, len(devs)))
+    base, extra = divmod(len(devs), n_slots)
+    out, pos = [], 0
+    for j in range(n_slots):
+        k = base + (1 if j < extra else 0)
+        out.append(list(devs[pos : pos + k]))
+        pos += k
+    return out
+
+
 # --- search space primitives -------------------------------------------------
 
 
@@ -319,8 +334,7 @@ class Tuner:
 
         devs = jax.devices()
         n_slots = min(self.max_concurrent_trials, max(1, len(devs)))
-        per = max(1, len(devs) // n_slots)
-        slot_devices = [devs[j * per : (j + 1) * per] for j in range(n_slots)]
+        slot_devices = _partition_devices(devs, n_slots)
         slots: "queue_mod.Queue" = queue_mod.Queue()
         for s in slot_devices:
             slots.put(s)
