@@ -58,6 +58,9 @@ TRIPWIRE_RATIO = 1.2
 # in the p99), so 1.2x would fire on environmental noise alone
 SERVE_TRIPWIRE_RATIO = 1.5
 
+# chaos recovery: flag >20% time-to-recover regressions across snapshots
+CHAOS_TRIPWIRE_RATIO = 1.2
+
 
 def _load_latest_bench_record(bench_dir):
     """Newest BENCH_*.json result dict (by round number, then mtime).
@@ -192,6 +195,188 @@ def serve_latency_tripwire(current_serve, prev_rec, prev_name=None,
             file=sys.stderr,
         )
     return out
+
+
+def chaos_recovery_tripwire(current_chaos, prev_rec, prev_name=None,
+                            backend=None, threshold=CHAOS_TRIPWIRE_RATIO):
+    """Compare this run's time-to-recover against the newest recorded bench.
+
+    The recovery analog of ``round_time_tripwire``: returns
+    ``{prev_time_to_recover_s, prev_record, ratio, fired}`` or None when no
+    comparable record exists (different backend, no recorded ``chaos``
+    section). Like-for-like only: a different chaos config (rows / rounds /
+    actors / fault schedule) is reported with ``config_mismatch`` set and
+    never fires."""
+    if not isinstance(current_chaos, dict):
+        return None
+    cur = current_chaos.get("time_to_recover_s")
+    if not cur or not isinstance(prev_rec, dict):
+        return None
+    if backend and prev_rec.get("backend") and prev_rec["backend"] != backend:
+        return None
+    prev_chaos = prev_rec.get("chaos")
+    if not isinstance(prev_chaos, dict):
+        return None
+    prev = prev_chaos.get("time_to_recover_s")
+    if not prev:
+        return None
+    ratio = float(cur) / float(prev)
+    out = {
+        "prev_time_to_recover_s": round(float(prev), 4),
+        "prev_record": prev_name,
+        "ratio": round(ratio, 3),
+        "fired": False,
+    }
+    if prev_chaos.get("config") != current_chaos.get("config"):
+        out["config_mismatch"] = True
+        return out
+    if ratio > threshold:
+        out["fired"] = True
+        print(
+            f"[bench] CHAOS TRIPWIRE: time-to-recover {cur:.2f}s is "
+            f"{ratio:.2f}x the newest recorded run ({prev:.2f}s in "
+            f"{prev_name or 'BENCH_*.json'}) — >{(threshold - 1) * 100:.0f}% "
+            f"regression. Investigate the recovery path before trusting "
+            f"this build's fault tolerance.",
+            file=sys.stderr,
+        )
+    return out
+
+
+def run_chaos_measurement():
+    """Deterministic chaos soak on the ambient mesh: one training run with a
+    mid-run rank kill plus a straggler delay (driven by a ``FaultPlan``, no
+    sleep-and-kill races), checked bit-identical against the uninterrupted
+    run; then a corrupt-newest-checkpoint resume through the retention
+    fallback. Returns the ``chaos`` section: time-to-recover, rounds
+    replayed, restart count, and the two identity verdicts."""
+    import tempfile
+
+    import jax
+
+    from xgboost_ray_tpu import RayDMatrix, RayParams, faults, train
+    from xgboost_ray_tpu.launcher import (
+        load_round_checkpoint,
+        save_round_checkpoint,
+    )
+
+    n_rows = int(os.environ.get("BENCH_CHAOS_ROWS", 20_000))
+    rounds = int(os.environ.get("BENCH_CHAOS_ROUNDS", 12))
+    actors = int(os.environ.get("BENCH_CHAOS_ACTORS",
+                                max(1, len(jax.devices()))))
+    straggle_s = float(os.environ.get("BENCH_CHAOS_STRAGGLE_S", 0.25))
+    # kill on an ODD round: with checkpoint_frequency=2 the newest
+    # checkpoint then trails the kill by one round, so the soak measurably
+    # replays work (rounds_replayed >= 1) instead of resuming for free
+    kill_round = max(1, rounds // 3) | 1
+    straggle_round = max(kill_round + 1, (2 * rounds) // 3)
+    # short, bounded backoff: the soak measures recovery, not the storm guard
+    os.environ.setdefault("RXGB_RESTART_BACKOFF_BASE_S", "0.05")
+
+    x, y = make_higgs_like(n_rows, 28, seed=2)
+    params = {
+        "objective": "binary:logistic", "eval_metric": ["logloss"],
+        "max_depth": 6, "eta": 0.1, "max_bin": 256,
+        "tree_method": "tpu_hist",
+    }
+    print(
+        f"[bench] chaos soak: rows={n_rows} rounds={rounds} actors={actors} "
+        f"kill@r{kill_round} straggle@r{straggle_round} (+{straggle_s}s)",
+        file=sys.stderr,
+    )
+
+    # uninterrupted reference — run it under a never-firing plan targeting
+    # the same site so BOTH runs take the per-round path (bit-identity must
+    # not compare a fused-scan forest against a per-round one)
+    noop_plan = faults.FaultPlan(rules=[{
+        "site": "actor.train_round", "action": "raise",
+        "match": {"round": -1},
+    }])
+    with faults.active_plan(noop_plan):
+        ref = train(
+            params, RayDMatrix(x, y), rounds,
+            ray_params=RayParams(num_actors=actors, checkpoint_frequency=2),
+        )
+    ref_margin = ref.predict(x, output_margin=True)
+
+    plan = faults.FaultPlan(rules=[
+        {"site": "actor.train_round", "action": "raise",
+         "match": {"round": kill_round}, "ranks": [actors - 1],
+         "message": "chaos: scheduled rank kill"},
+        {"site": "actor.train_round", "action": "delay",
+         "match": {"round": straggle_round}, "delay_s": straggle_s},
+    ])
+    res = {}
+    soak_started = time.time()
+    with faults.active_plan(plan):
+        bst = train(
+            params, RayDMatrix(x, y), rounds,
+            additional_results=res,
+            ray_params=RayParams(num_actors=actors, checkpoint_frequency=2,
+                                 max_actor_restarts=2),
+        )
+    soak_s = time.time() - soak_started
+    rob = res.get("robustness", {})
+    # the restart recomputes resume margins from the checkpoint forest — a
+    # different f32 summation order than the uninterrupted run's incremental
+    # accumulation — so the match is pinned at atol=1e-5 (NOT bitwise), with
+    # the observed max divergence reported alongside (structural drift shows
+    # up as >> 1e-5). Chaos-vs-chaos reruns of the same plan ARE bitwise
+    # identical (pinned by tests/test_faults.py).
+    chaos_margin = bst.predict(x, output_margin=True)
+    model_max_abs_diff = float(np.max(np.abs(chaos_margin - ref_margin)))
+    model_matches = bool(np.allclose(chaos_margin, ref_margin, atol=1e-5))
+
+    # corrupt-newest-checkpoint resume: bank two retained checkpoints from
+    # the reference forest, corrupt the newest via the checkpoint.save fault
+    # site, and resume through the retention fallback to the full model
+    with tempfile.TemporaryDirectory() as td:
+        ckpt = os.path.join(td, "ckpt.json")
+        k = rounds - 2
+        corrupt_plan = faults.FaultPlan(rules=[{
+            "site": "checkpoint.save", "action": "corrupt", "at": 2,
+            "nbytes": 64,
+        }], seed=13)
+        with faults.active_plan(corrupt_plan):
+            save_round_checkpoint(ref.slice_rounds(0, k - 1), ckpt, k - 2)
+            save_round_checkpoint(ref.slice_rounds(0, k), ckpt, k - 1)
+        fb, fb_rounds = load_round_checkpoint(ckpt)
+        resume_matches = False
+        if fb is not None:
+            noop_plan.reset()
+            with faults.active_plan(noop_plan):  # per-round path, as above
+                resumed = train(
+                    params, RayDMatrix(x, y), rounds - fb_rounds,
+                    xgb_model=fb,
+                    ray_params=RayParams(num_actors=actors,
+                                         checkpoint_frequency=0),
+                )
+            # the on-disk JSON roundtrip is not bit-exact (float reprs), so
+            # the file-resume check uses the same tolerance as the
+            # launcher resume test (1e-4, vs the soak's in-memory 1e-5)
+            resume_matches = bool(np.allclose(
+                resumed.predict(x, output_margin=True), ref_margin,
+                atol=1e-4,
+            ))
+
+    section = {
+        "restarts": rob.get("restarts", 0),
+        "rounds_replayed": rob.get("rounds_replayed", 0),
+        "time_to_recover_s": rob.get("time_to_recover_s", 0.0),
+        "backoff_s": rob.get("backoff_s", 0.0),
+        "soak_train_time_s": round(soak_s, 2),
+        "model_matches": model_matches,  # vs uninterrupted, atol=1e-5
+        "model_max_abs_diff": model_max_abs_diff,
+        "ckpt_fallback_rounds": fb_rounds,
+        "ckpt_resume_matches": resume_matches,  # vs uninterrupted, atol=1e-4
+        "config": {
+            "rows": n_rows, "rounds": rounds, "actors": actors,
+            "kill_round": kill_round, "straggle_round": straggle_round,
+            "straggle_s": straggle_s, "max_depth": 6,
+        },
+    }
+    print(f"[bench] chaos section: {section}", file=sys.stderr)
+    return section
 
 
 def run_serve_measurement():
@@ -563,6 +748,20 @@ def run_measurement():
             serve_section["regression_tripwire"] = strip
         detail["serve"] = serve_section
 
+    # deterministic chaos soak (the recovery counterpart of the protocol
+    # run). Default on for the CPU mesh so every recorded BENCH_*.json
+    # snapshot carries a `chaos` section for the time-to-recover tripwire
+    # to compare against; opt-in on TPU via BENCH_CHAOS=1.
+    chaos_env = os.environ.get("BENCH_CHAOS")
+    if chaos_env == "1" or (chaos_env is None and not on_tpu):
+        chaos_section = run_chaos_measurement()
+        ctrip = chaos_recovery_tripwire(
+            chaos_section, prev_rec, prev_name, backend=backend
+        )
+        if ctrip is not None:
+            chaos_section["regression_tripwire"] = ctrip
+        detail["chaos"] = chaos_section
+
     # normalize to the full protocol (11M rows x 100 rounds) when a smaller
     # config was run, so the metric stays comparable across environments
     scale = (11_000_000 / n_rows) * (100 / rounds)
@@ -683,6 +882,44 @@ def main():
         sys.exit(1)
 
 
+def chaos_only_main():
+    """``--chaos``: run ONLY the chaos soak and print one JSON line headlined
+    by its time-to-recover, with the full ``chaos`` section and the >20%
+    recovery-regression tripwire vs the newest BENCH_*.json. Runs on the
+    8-device virtual CPU mesh unless BENCH_CHAOS_ON_ACCEL=1 keeps the
+    ambient accelerator backend."""
+    if os.environ.get("BENCH_CHAOS_ON_ACCEL") != "1":
+        _force_cpu_mesh()
+    import jax
+
+    backend = jax.default_backend()
+    section = run_chaos_measurement()
+    prev_rec, prev_name = _load_latest_bench_record(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    trip = chaos_recovery_tripwire(section, prev_rec, prev_name,
+                                   backend=backend)
+    if trip is not None:
+        section["regression_tripwire"] = trip
+    ok = section["model_matches"] and section["ckpt_resume_matches"]
+    print(
+        json.dumps(
+            {
+                "metric": "chaos_time_to_recover_s",
+                "value": section["time_to_recover_s"],
+                "unit": "s",
+                "backend": backend,
+                "chaos": section,
+            }
+        )
+    )
+    if not ok:
+        # a chaos soak whose recovered model DIFFERS from the uninterrupted
+        # run is a correctness failure, not a slow recovery — fail the run
+        print("[bench] chaos soak FAILED identity checks", file=sys.stderr)
+        sys.exit(1)
+
+
 def serve_only_main():
     """``--serve``: run ONLY the closed-loop serving benchmark and print one
     JSON line headlined by its QPS, with the full ``serve`` section. Runs on
@@ -717,6 +954,8 @@ def serve_only_main():
 if __name__ == "__main__":
     if "--serve" in sys.argv:
         serve_only_main()
+    elif "--chaos" in sys.argv:
+        chaos_only_main()
     elif "--run" in sys.argv:
         run_measurement()
     else:

@@ -8,8 +8,11 @@ python -m pytest tests/test_ops.py -v -x
 python -m pytest tests/test_engine.py -v -x
 python -m pytest tests/test_end_to_end.py -v -x
 python -m pytest tests/test_fault_tolerance.py -v -x
+python -m pytest tests/test_faults.py -v -x
 python -m pytest tests/test_xgboost_api.py -v -x
 python -m pytest tests/test_tune.py -v -x
 python -m pytest tests/test_sklearn.py -v -x
 echo "================= Running smoke benchmark ================="
 python tests/release/benchmark_tpu.py 2 10 8 --smoke-test
+echo "================= Running chaos smoke (bench --chaos) ================="
+BENCH_CHAOS_ROWS=2000 BENCH_CHAOS_ROUNDS=6 python bench.py --chaos

@@ -14,6 +14,20 @@ import signal
 import threading
 
 
+def quick_worker(ctx):
+    """Minimal worker for launcher-mechanics tests: heartbeat, return rank.
+    Chaos comes from the RXGB_FAULT_PLAN env (fired in _launcher_worker)."""
+    ctx.heartbeat()
+    return ctx.process_id
+
+
+def exit_zero_without_result(ctx):
+    """Violates the worker contract: exits 0 without ever returning, so no
+    result file is written — the launcher must surface this, not return a
+    partial world."""
+    os._exit(0)
+
+
 def train_worker(ctx, data_path):
     import numpy as np
 
@@ -63,6 +77,7 @@ def train_worker(ctx, data_path):
             eng.step(i)
         finally:
             timer.cancel()
+        ctx.heartbeat()  # per-round liveness for the launcher watchdog
         if ctx.process_id == 0 and ctx.checkpoint_path:
             save_round_checkpoint(
                 eng.get_booster(), ctx.checkpoint_path, done + i

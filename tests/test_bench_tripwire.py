@@ -113,6 +113,57 @@ def test_serve_tripwire_skips_cross_backend_and_missing_section():
     assert bench.serve_latency_tripwire({}, rec_tpu, "x") is None
 
 
+_CHAOS_CFG = {"rows": 20000, "rounds": 12, "actors": 8, "kill_round": 5,
+              "straggle_round": 8, "straggle_s": 0.25, "max_depth": 6}
+
+
+def _chaos_section(ttr, cfg=None):
+    return {"time_to_recover_s": ttr, "restarts": 1, "rounds_replayed": 1,
+            "config": dict(cfg if cfg is not None else _CHAOS_CFG)}
+
+
+def test_chaos_tripwire_fires_on_recovery_regression(capsys):
+    rec = {"metric": "m", "backend": "cpu", "chaos": _chaos_section(10.0)}
+    out = bench.chaos_recovery_tripwire(
+        _chaos_section(12.5), rec, "BENCH_r06.json", backend="cpu"
+    )
+    assert out is not None and out["fired"]
+    assert out["ratio"] == 1.25
+    assert out["prev_time_to_recover_s"] == 10.0
+    assert "CHAOS TRIPWIRE" in capsys.readouterr().err
+
+
+def test_chaos_tripwire_quiet_within_20pct(capsys):
+    rec = {"metric": "m", "backend": "cpu", "chaos": _chaos_section(10.0)}
+    out = bench.chaos_recovery_tripwire(
+        _chaos_section(11.5), rec, "x", backend="cpu"
+    )
+    assert out is not None and not out["fired"]
+    assert "CHAOS TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_chaos_tripwire_reports_but_never_fires_on_config_mismatch(capsys):
+    other = dict(_CHAOS_CFG, rounds=6)
+    rec = {"metric": "m", "backend": "cpu",
+           "chaos": _chaos_section(10.0, other)}
+    out = bench.chaos_recovery_tripwire(
+        _chaos_section(50.0), rec, "x", backend="cpu"
+    )
+    assert out is not None and not out["fired"]
+    assert out["config_mismatch"] is True
+    assert "CHAOS TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_chaos_tripwire_skips_incomparable_records():
+    cur = _chaos_section(20.0)
+    rec_tpu = {"metric": "m", "backend": "tpu", "chaos": _chaos_section(10.0)}
+    assert bench.chaos_recovery_tripwire(cur, rec_tpu, "x", backend="cpu") is None
+    rec_none = {"metric": "m", "backend": "cpu"}  # pre-chaos-era record
+    assert bench.chaos_recovery_tripwire(cur, rec_none, "x", backend="cpu") is None
+    assert bench.chaos_recovery_tripwire(None, rec_tpu, "x") is None
+    assert bench.chaos_recovery_tripwire({}, rec_tpu, "x") is None
+
+
 def test_load_latest_bench_record_picks_newest_round(tmp_path):
     for n, val in ((1, 0.9), (5, 1.44), (3, 0.8)):
         (tmp_path / f"BENCH_r{n:02d}.json").write_text(

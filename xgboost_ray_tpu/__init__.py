@@ -39,6 +39,7 @@ from xgboost_ray_tpu.matrix import (
 from xgboost_ray_tpu.data_sources import RayFileType
 from xgboost_ray_tpu.models.booster import Booster, RayXGBoostBooster
 from xgboost_ray_tpu.callback import DistributedCallback, TrainingCallback
+from xgboost_ray_tpu import faults
 from xgboost_ray_tpu.launcher import (
     LaunchContext,
     LaunchResult,
@@ -66,6 +67,7 @@ __all__ = [
     "RayXGBoostActor",
     "DistributedCallback",
     "TrainingCallback",
+    "faults",
     "LaunchContext",
     "LaunchResult",
     "launch_distributed",

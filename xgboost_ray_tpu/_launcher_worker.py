@@ -28,6 +28,24 @@ def main() -> int:
     with open(payload_path, "rb") as f:
         payload = pickle.load(f)
     ctx = payload["ctx"]
+    if hasattr(ctx, "heartbeat"):
+        # first touch BEFORE the slow imports: the watchdog's stall clock
+        # should start at bootstrap, not at spawn + interpreter startup
+        ctx.heartbeat()
+
+    # chaos hook (kill/hang/straggle this process, RXGB_FAULT_PLAN env).
+    # A plain package import is correct here: unpickling ctx above already
+    # imported xgboost_ray_tpu.launcher (LaunchContext's defining module)
+    # and with it the whole package — importing jax modules does not
+    # initialize a backend, so jax.distributed.initialize below still runs
+    # first. Using the package's own faults instance keeps ONE plan/counter
+    # state per process (a standalone copy would double-parse the env plan).
+    from xgboost_ray_tpu import faults
+
+    faults.fire(
+        "launcher.worker", process_id=ctx.process_id, attempt=ctx.attempt
+    )
+
     fn, args = pickle.loads(payload["fn_args"])
 
     import jax
@@ -37,6 +55,9 @@ def main() -> int:
         num_processes=ctx.num_processes,
         process_id=ctx.process_id,
     )
+    if hasattr(ctx, "heartbeat"):
+        # first post-join liveness touch; worker fns take over per round
+        ctx.heartbeat()
 
     result = fn(ctx, *args)
 

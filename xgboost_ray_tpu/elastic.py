@@ -113,6 +113,12 @@ def _maybe_schedule_new_actors(
         training_state.pending_actors[rank] = pending
         scheduled = True
         logger.debug(f"[RayXGBoost] Re-scheduled worker with rank {rank}.")
+    if started:
+        # recovery observability: how often the elastic scheduler had to act
+        rob = training_state.additional_results.setdefault("robustness", {})
+        rob["elastic_reschedules"] = (
+            rob.get("elastic_reschedules", 0) + len(started)
+        )
     return scheduled
 
 

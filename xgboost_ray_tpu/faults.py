@@ -1,0 +1,335 @@
+"""Deterministic, seedable fault injection for chaos testing.
+
+The FT surfaces of this repo (driver retry loop, elastic reintegration,
+launcher world restart, serve degradation) were previously exercised only by
+orchestrated-timeline tests; there was no way to deterministically inject a
+straggler, a corrupt checkpoint, a hung process, or a serve overload. This
+module is that missing layer: a registry of **named fault sites** threaded
+through all three layers, driven by a :class:`FaultPlan` that schedules an
+action at the k-th occurrence of a site — so every chaos scenario is a
+reproducible unit test instead of a sleep-and-kill race.
+
+Fault sites (where ``fire()`` is called from, and the context it carries):
+
+====================  ==========================================  ==============
+site                  fired from                                  ctx keys
+====================  ==========================================  ==============
+actor.train_round     driver round loop (``main._train``)         ``round``
+actor.load_shard      ``RayXGBoostActor.load_data``               ``rank``
+checkpoint.save       ``launcher.save_round_checkpoint``          ``round, path``
+checkpoint.load       ``launcher.load_round_checkpoint``          ``path``
+launcher.worker       ``_launcher_worker`` bootstrap              ``process_id,
+                                                                  attempt``
+serve.predict         ``MicroBatcher._execute``                   ``kind, rows``
+registry.swap         ``ModelRegistry.load``                      ``version``
+====================  ==========================================  ==============
+
+Actions: ``raise`` (an exception — ``RayActorError`` when ``ranks`` is set),
+``kill`` (SIGKILL the current process — real-process sites), ``delay`` /
+``hang`` (sleep ``delay_s``; hang defaults to an hour), and the file actions
+``corrupt`` / ``truncate`` applied by ``fire_file()`` to the site's file
+(checkpoints) with plan-seeded byte positions.
+
+A plan installs programmatically (``install_plan`` / ``active_plan``) or via
+the ``RXGB_FAULT_PLAN`` env var carrying the plan JSON — the env form is
+inherited by spawned launcher workers, so one env var scripts a whole
+cross-process chaos scenario. With no plan installed every ``fire()`` is a
+near-free no-op.
+
+This module must stay import-light (no jax/numpy): the launcher worker fires
+its site before any jax-touching import.
+"""
+
+import json
+import os
+import random
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Union
+
+__all__ = [
+    "SITES",
+    "FaultRule",
+    "FaultPlan",
+    "install_plan",
+    "clear_plan",
+    "get_plan",
+    "active_plan",
+    "plan_targets",
+    "fire",
+    "fire_file",
+]
+
+#: the fault-site catalogue (kept in sync with the table above; ``FaultRule``
+#: validates against it so a typo'd site fails at plan build, not silently)
+SITES = (
+    "actor.train_round",
+    "actor.load_shard",
+    "checkpoint.save",
+    "checkpoint.load",
+    "launcher.worker",
+    "serve.predict",
+    "registry.swap",
+)
+
+_ENV_PLAN = "RXGB_FAULT_PLAN"
+
+
+def _exception_types() -> Dict[str, type]:
+    from xgboost_ray_tpu.exceptions import RayActorError, RayTaskError
+
+    return {
+        "RuntimeError": RuntimeError,
+        "ValueError": ValueError,
+        "OSError": OSError,
+        "TimeoutError": TimeoutError,
+        "RayActorError": RayActorError,
+        "RayTaskError": RayTaskError,
+    }
+
+
+@dataclass
+class FaultRule:
+    """One scheduled fault: ``action`` at the ``at``-th matching occurrence
+    of ``site`` (1-based), for ``times`` consecutive matching occurrences
+    (``times=0`` = every occurrence from ``at`` on).
+
+    ``match`` filters occurrences by ctx equality (e.g. ``{"round": 3}`` or
+    ``{"process_id": 1, "attempt": 0}``) — only matching occurrences advance
+    this rule's counter, so "the 2nd time rank 1 loads a shard" is
+    expressible without knowing the global call order.
+    """
+
+    site: str
+    action: str  # raise | kill | delay | hang | corrupt | truncate
+    at: int = 1
+    times: int = 1
+    match: Optional[Dict[str, Any]] = None
+    # action parameters
+    ranks: Optional[List[int]] = None  # raise -> RayActorError(ranks=...)
+    exc: str = "RuntimeError"  # raise without ranks: exception type name
+    message: str = ""
+    delay_s: float = 0.0  # delay; hang defaults to 3600 when unset
+    nbytes: int = 0  # corrupt: bytes to flip (default 16); truncate: keep
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(
+                f"unknown fault site {self.site!r}; one of {SITES}"
+            )
+        if self.action not in (
+            "raise", "kill", "delay", "hang", "corrupt", "truncate"
+        ):
+            raise ValueError(f"unknown fault action {self.action!r}")
+        if self.at < 1:
+            raise ValueError("`at` is 1-based; must be >= 1")
+
+    def matches(self, ctx: Dict[str, Any]) -> bool:
+        if not self.match:
+            return True
+        return all(ctx.get(k) == v for k, v in self.match.items())
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"site": self.site, "action": self.action}
+        for key in ("at", "times"):
+            if getattr(self, key) != 1:
+                out[key] = getattr(self, key)
+        for key in ("match", "ranks", "message"):
+            if getattr(self, key):
+                out[key] = getattr(self, key)
+        if self.exc != "RuntimeError":
+            out["exc"] = self.exc
+        if self.delay_s:
+            out["delay_s"] = self.delay_s
+        if self.nbytes:
+            out["nbytes"] = self.nbytes
+        return out
+
+
+@dataclass
+class FaultPlan:
+    """An ordered set of :class:`FaultRule` with deterministic counters.
+
+    Every rule keeps its own occurrence counter (advanced only by matching
+    ``fire()`` calls), and every file-corrupting rule draws byte positions
+    from ``random.Random(seed, rule_index)`` — two runs of the same plan over
+    the same workload inject byte-identical faults. ``reset()`` rewinds the
+    counters so one plan object can drive repeated runs.
+    """
+
+    rules: List[FaultRule] = field(default_factory=list)
+    seed: int = 0
+
+    def __post_init__(self):
+        self.rules = [
+            r if isinstance(r, FaultRule) else FaultRule(**r)
+            for r in self.rules
+        ]
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        self._seen = [0] * len(self.rules)
+        self._rngs = [
+            random.Random(self.seed * 1000003 + i)
+            for i in range(len(self.rules))
+        ]
+
+    # -- serialization ------------------------------------------------------
+
+    def to_json(self) -> str:
+        doc: Dict[str, Any] = {"rules": [r.to_dict() for r in self.rules]}
+        if self.seed:
+            doc["seed"] = self.seed
+        return json.dumps(doc)
+
+    @classmethod
+    def from_json(cls, raw: Union[str, Dict[str, Any]]) -> "FaultPlan":
+        doc = json.loads(raw) if isinstance(raw, str) else dict(raw)
+        return cls(rules=doc.get("rules", []), seed=int(doc.get("seed", 0)))
+
+    # -- firing -------------------------------------------------------------
+
+    def targets(self, site: str) -> bool:
+        return any(r.site == site for r in self.rules)
+
+    def _due(self, site: str, ctx: Dict[str, Any]) -> List[int]:
+        """Advance matching counters under the lock; return indices of rules
+        whose action is due at this occurrence."""
+        due = []
+        with self._lock:
+            for i, rule in enumerate(self.rules):
+                if rule.site != site or not rule.matches(ctx):
+                    continue
+                self._seen[i] += 1
+                n = self._seen[i]
+                if n >= rule.at and (
+                    rule.times == 0 or n < rule.at + rule.times
+                ):
+                    due.append(i)
+        return due
+
+    def fire(self, site: str, **ctx) -> None:
+        for i in self._due(site, ctx):
+            self._perform(self.rules[i], site, ctx)
+
+    def fire_file(self, site: str, path: str, **ctx) -> None:
+        ctx = dict(ctx, path=path)
+        for i in self._due(site, ctx):
+            rule = self.rules[i]
+            if rule.action in ("corrupt", "truncate"):
+                self._damage_file(rule, path, self._rngs[i])
+            else:
+                self._perform(rule, site, ctx)
+
+    def _perform(self, rule: FaultRule, site: str, ctx: Dict[str, Any]):
+        msg = rule.message or f"injected fault at {site} ({ctx})"
+        if rule.action == "raise":
+            if rule.ranks is not None:
+                from xgboost_ray_tpu.exceptions import RayActorError
+
+                raise RayActorError(msg, ranks=rule.ranks)
+            exc_type = _exception_types().get(rule.exc, RuntimeError)
+            raise exc_type(msg)
+        if rule.action == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        if rule.action in ("delay", "hang"):
+            time.sleep(
+                rule.delay_s or (3600.0 if rule.action == "hang" else 0.0)
+            )
+            return
+        if rule.action in ("corrupt", "truncate"):
+            raise ValueError(
+                f"file action {rule.action!r} at non-file site {site!r}; "
+                f"use a site that calls fire_file()"
+            )
+
+    @staticmethod
+    def _damage_file(rule: FaultRule, path: str, rng: random.Random) -> None:
+        size = os.path.getsize(path)
+        if rule.action == "truncate":
+            keep = rule.nbytes if rule.nbytes else size // 2
+            with open(path, "rb+") as f:
+                f.truncate(min(keep, size))
+            return
+        n = rule.nbytes or 16
+        with open(path, "rb+") as f:
+            for _ in range(min(n, size)):
+                pos = rng.randrange(size)
+                f.seek(pos)
+                byte = f.read(1)
+                f.seek(pos)
+                f.write(bytes([byte[0] ^ 0xFF]))
+
+
+# ---------------------------------------------------------------------------
+# Process-global plan: programmatic install wins over the env var.
+# ---------------------------------------------------------------------------
+
+_PLAN: Optional[FaultPlan] = None
+_ENV_CACHE = (None, None)  # (raw env string, parsed plan)
+
+
+def install_plan(plan: Union[FaultPlan, Dict, str, None]) -> Optional[FaultPlan]:
+    global _PLAN
+    if plan is not None and not isinstance(plan, FaultPlan):
+        plan = FaultPlan.from_json(plan)
+    _PLAN = plan
+    return plan
+
+
+def clear_plan() -> None:
+    install_plan(None)
+
+
+def get_plan() -> Optional[FaultPlan]:
+    global _ENV_CACHE
+    if _PLAN is not None:
+        return _PLAN
+    raw = os.environ.get(_ENV_PLAN)
+    if not raw:
+        return None
+    if _ENV_CACHE[0] != raw:
+        _ENV_CACHE = (raw, FaultPlan.from_json(raw))
+    return _ENV_CACHE[1]
+
+
+class active_plan:
+    """``with faults.active_plan(plan):`` — install for the scope, always
+    clear after (the test-friendly form; leaks no plan into later tests)."""
+
+    def __init__(self, plan: Union[FaultPlan, Dict, str]):
+        self.plan = plan
+
+    def __enter__(self) -> FaultPlan:
+        return install_plan(self.plan)
+
+    def __exit__(self, *exc_info) -> None:
+        clear_plan()
+
+
+def plan_targets(site: str) -> bool:
+    """True when the active plan has any rule for ``site`` — used by the
+    driver to disable the fused-scan fast path so round-granular faults hit
+    exact rounds."""
+    plan = get_plan()
+    return plan is not None and plan.targets(site)
+
+
+def fire(site: str, **ctx) -> None:
+    """Hit a fault site. No-op without an active plan; otherwise the plan
+    may sleep, raise, or SIGKILL per its matching rules."""
+    plan = get_plan()
+    if plan is not None:
+        plan.fire(site, **ctx)
+
+
+def fire_file(site: str, path: str, **ctx) -> None:
+    """Hit a file-owning fault site: corrupt/truncate rules damage ``path``
+    in place (deterministically, from the plan seed); other actions behave
+    as in ``fire()``."""
+    plan = get_plan()
+    if plan is not None:
+        plan.fire_file(site, path, **ctx)

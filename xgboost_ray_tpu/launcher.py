@@ -36,15 +36,22 @@ what they receive. The canonical training worker:
 """
 
 import dataclasses
+import glob
+import hashlib
 import logging
 import os
 import pickle
+import re
+import shutil
 import socket
 import subprocess
 import sys
 import tempfile
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from xgboost_ray_tpu import faults
+from xgboost_ray_tpu.util import restart_backoff_s
 
 logger = logging.getLogger(__name__)
 
@@ -68,6 +75,20 @@ class LaunchContext:
     coordinator_address: str
     attempt: int  # 0 on the first try, +1 per world restart
     checkpoint_path: Optional[str]
+    # per-process liveness file for the launcher's hang watchdog; workers
+    # call ``ctx.heartbeat()`` each round (cheap mtime touch)
+    heartbeat_path: Optional[str] = None
+
+    def heartbeat(self) -> None:
+        """Touch this process's heartbeat file (no-op when the launcher did
+        not arm the watchdog). Must never fail the worker."""
+        if not self.heartbeat_path:
+            return
+        try:
+            with open(self.heartbeat_path, "w") as f:
+                f.write(str(time.time()))
+        except OSError:  # pragma: no cover - liveness is best-effort
+            pass
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,6 +101,12 @@ class ProcessFailure:
     # False when it died on its own (the injected fault, the coordination
     # service's survivor termination, or a surfaced Python exception)
     forced: bool = False
+    # why this process went down: "crashed" (nonzero exit on its own),
+    # "hung" (killed because the world's heartbeats stalled past
+    # hang_timeout_s — world-level: a wedged collective stalls every
+    # member), "slow" (the whole-world timeout_s expired), or "torn_down"
+    # (healthy peer killed while the launcher tore a crashed world down)
+    reason: str = "crashed"
 
 
 @dataclasses.dataclass
@@ -103,45 +130,174 @@ def _free_port() -> int:
     return port
 
 
-def save_round_checkpoint(booster, path: str, completed_round: int) -> None:
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _history_path(path: str, completed_round: int) -> str:
+    return f"{path}.r{int(completed_round):06d}"
+
+
+def _history_candidates(path: str) -> List[str]:
+    """Retained history checkpoints for ``path``, newest round first."""
+    pat = re.compile(re.escape(os.path.basename(path)) + r"\.r(\d{6})$")
+    out = []
+    for p in glob.glob(glob.escape(path) + ".r??????"):
+        m = pat.match(os.path.basename(p))
+        if m:
+            out.append((int(m.group(1)), p))
+    return [p for _, p in sorted(out, reverse=True)]
+
+
+def save_round_checkpoint(
+    booster, path: str, completed_round: int, keep_last: Optional[int] = None
+) -> None:
     """Atomically persist ``booster`` + the round it completed (the driver's
     rank-0 checkpoint role, reference ``main.py:612-626``). The MODEL rename
     is the single commit point — the ``.round`` marker is advisory (humans /
     monitoring) and never read back, so a death between the two renames
-    cannot desynchronize resume arithmetic."""
+    cannot desynchronize resume arithmetic.
+
+    Integrity + retention (the hardened resume path): every commit also
+    writes a ``.sha256`` sidecar and retains the last ``keep_last``
+    checkpoints as independent ``{path}.rNNNNNN`` copies (default
+    ``RXGB_CHECKPOINT_KEEP``, 2; 0 disables retention) — so a corrupt or
+    truncated newest checkpoint makes ``load_round_checkpoint`` fall back
+    to the previous good one instead of killing the resume path."""
+    if keep_last is None:
+        keep_last = int(os.environ.get("RXGB_CHECKPOINT_KEEP", "2"))
     tmp = f"{path}.tmp"
     booster.save_model(tmp)
+    digest = _sha256_file(tmp)
     os.replace(tmp, path)
+    stmp = f"{path}.sha256.tmp"
+    with open(stmp, "w") as f:
+        f.write(digest)
+    os.replace(stmp, f"{path}.sha256")
     rtmp = f"{path}.round.tmp"
     with open(rtmp, "w") as f:
         f.write(str(int(completed_round)))
     os.replace(rtmp, f"{path}.round")
+    if keep_last > 0:
+        # independent COPY (not a hardlink): single-inode corruption of the
+        # live file must not take the retained fallback down with it
+        hist = _history_path(path, completed_round)
+        shutil.copyfile(path, f"{hist}.tmp")
+        os.replace(f"{hist}.tmp", hist)
+        with open(f"{hist}.sha256.tmp", "w") as f:
+            f.write(digest)
+        os.replace(f"{hist}.sha256.tmp", f"{hist}.sha256")
+        for stale in _history_candidates(path)[keep_last:]:
+            for victim in (stale, f"{stale}.sha256"):
+                try:
+                    os.remove(victim)
+                except OSError:
+                    pass
+    # chaos hook LAST: a corrupt/truncate rule damages the COMMITTED newest
+    # checkpoint (post-write disk corruption), which load must survive
+    faults.fire_file("checkpoint.save", path, round=int(completed_round))
+
+
+def _checkpoint_sha_ok(path: str) -> Optional[bool]:
+    """True/False against the ``.sha256`` sidecar, None when there is no
+    (readable) sidecar to check against."""
+    sidecar = f"{path}.sha256"
+    if not os.path.exists(sidecar):
+        return None
+    try:
+        with open(sidecar) as f:
+            expected = f.read().strip()
+        if not expected:
+            return None
+        return _sha256_file(path) == expected
+    except OSError:
+        return None
+
+
+def _parse_checkpoint(path: str) -> Optional[Any]:
+    """Parse one checkpoint file; None when it is corrupt/truncated."""
+    import json
+
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        # dispatch on the document's booster (gblinear checkpoints carry the
+        # xgboost gblinear learner schema, trees our native format)
+        name = doc.get("learner", {}).get("gradient_booster", {}).get("name")
+        if name == "gblinear":
+            from xgboost_ray_tpu.linear import RayLinearBooster
+
+            return RayLinearBooster.import_xgboost_json(doc)
+        from xgboost_ray_tpu.models.booster import RayXGBoostBooster
+
+        return RayXGBoostBooster._from_dict(doc)
+    except Exception as exc:  # noqa: BLE001 - any parse failure -> fallback
+        logger.warning(
+            "[RayXGBoost] checkpoint %s is unreadable (%s: %s); treating "
+            "as corrupt.", path, type(exc).__name__, exc,
+        )
+        return None
 
 
 def load_round_checkpoint(path: Optional[str]) -> Tuple[Optional[Any], int]:
-    """(booster, completed_rounds) from the newest checkpoint, or (None, 0)
-    when none exists yet. ``completed_rounds`` comes from the atomically
-    committed model itself (``num_boosted_rounds``), never the advisory
-    ``.round`` file — a kill between the checkpoint's two renames must not
-    make the resumed world recount."""
-    if not path or not os.path.exists(path):
+    """(booster, completed_rounds) from the newest GOOD checkpoint, or
+    (None, 0) when none exists yet. ``completed_rounds`` comes from the
+    atomically committed model itself (``num_boosted_rounds``), never the
+    advisory ``.round`` file — a kill between the checkpoint's two renames
+    must not make the resumed world recount.
+
+    A corrupt/truncated/sha-mismatched newest checkpoint falls back to the
+    newest retained ``{path}.rNNNNNN`` copy that validates (replaying the
+    rounds in between) instead of crashing the resume path; only when every
+    candidate is bad does the world restart from scratch — loudly."""
+    if not path:
         return None, 0
-    import json
-
-    with open(path) as f:
-        doc = json.load(f)
-    # dispatch on the document's booster (gblinear checkpoints carry the
-    # xgboost gblinear learner schema, trees our native format)
-    name = doc.get("learner", {}).get("gradient_booster", {}).get("name")
-    if name == "gblinear":
-        from xgboost_ray_tpu.linear import RayLinearBooster
-
-        booster = RayLinearBooster.import_xgboost_json(doc)
-    else:
-        from xgboost_ray_tpu.models.booster import RayXGBoostBooster
-
-        booster = RayXGBoostBooster._from_dict(doc)
-    return booster, booster.num_boosted_rounds()
+    faults.fire("checkpoint.load", path=path)
+    candidates = [path] + _history_candidates(path)
+    existing = [c for c in candidates if os.path.exists(c)]
+    sha_mismatched: List[str] = []
+    for cand in existing:
+        if _checkpoint_sha_ok(cand) is False:
+            logger.warning(
+                "[RayXGBoost] checkpoint %s fails its sha256 sidecar; "
+                "treating as corrupt.", cand,
+            )
+            sha_mismatched.append(cand)
+            continue
+        booster = _parse_checkpoint(cand)
+        if booster is not None:
+            if cand != path:
+                logger.warning(
+                    "[RayXGBoost] newest checkpoint %s is corrupt; resuming "
+                    "from retained fallback %s (%d rounds).",
+                    path, cand, booster.num_boosted_rounds(),
+                )
+            return booster, booster.num_boosted_rounds()
+    # no candidate passed integrity. A sha mismatch can also be a STALE
+    # sidecar (a kill between the model rename and the sidecar rename), so
+    # before abandoning the run to round 0, accept the newest mismatched
+    # candidate that still parses — a valid checkpoint beats none.
+    for cand in sha_mismatched:
+        booster = _parse_checkpoint(cand)
+        if booster is not None:
+            logger.warning(
+                "[RayXGBoost] no checkpoint for %s passes integrity; "
+                "resuming from sha-mismatched but parseable %s (%d rounds) "
+                "— likely a torn sidecar write.",
+                path, cand, booster.num_boosted_rounds(),
+            )
+            return booster, booster.num_boosted_rounds()
+    if existing:
+        logger.error(
+            "[RayXGBoost] every checkpoint candidate for %s is corrupt "
+            "(%d tried); restarting training from round 0.",
+            path, len(existing),
+        )
+    return None, 0
 
 
 def _tail(path: str, limit: int = 4000) -> str:
@@ -168,6 +324,7 @@ def launch_distributed(
     timeout_s: float = 900.0,
     poll_interval: float = 0.25,
     survivor_grace_s: float = 150.0,
+    hang_timeout_s: Optional[float] = None,
 ) -> LaunchResult:
     """Run ``worker_fn(ctx, *args)`` in a ``num_processes``-process
     ``jax.distributed`` world, restarting the WHOLE world from the latest
@@ -189,6 +346,15 @@ def launch_distributed(
     Python-level surfaced failure exits sooner) before being force-killed — so ``failures`` records
     whether each process surfaced the failure itself (``forced=False``) or
     had to be torn down (``forced=True``).
+
+    ``hang_timeout_s`` arms the heartbeat watchdog: workers call
+    ``ctx.heartbeat()`` each round, and a world whose heartbeats stall
+    longer than this is flagged ``hung`` and restarted long before the
+    global ``timeout_s`` — set it above the worst-case round (plus compile)
+    time. ``failures[*].reason`` distinguishes ``hung`` / ``slow`` (global
+    timeout) / ``crashed`` / ``torn_down``. Between attempts the launcher
+    backs off exponentially with jitter (``RXGB_RESTART_BACKOFF_*``;
+    base 0 disables) so a persistent fault cannot crash-loop storm.
     """
     if num_processes < 1:
         raise ValueError("num_processes must be >= 1")
@@ -225,6 +391,7 @@ def launch_distributed(
             payload_fn, num_processes, local_ids, checkpoint_path,
             coordinator_address, env, fn_mod_dir, scratch, timeout_s,
             poll_interval, survivor_grace_s, max_restarts, failures,
+            hang_timeout_s,
         )
     finally:
         import shutil
@@ -239,20 +406,34 @@ def _run_attempts(
     payload_fn, num_processes, local_ids, checkpoint_path,
     coordinator_address, env, fn_mod_dir, scratch, timeout_s,
     poll_interval, survivor_grace_s, max_restarts, failures,
+    hang_timeout_s=None,
 ) -> LaunchResult:
     restarts = 0
     attempt = 0
+    consecutive_failures = 0
+    # an attempt that ran at least this long before dying is an isolated
+    # failure, not a crash loop — its restart rewinds the backoff escalation
+    healthy_uptime_s = 2.0 * float(
+        os.environ.get("RXGB_RESTART_BACKOFF_MAX_S", "30")
+    )
     while True:
         coord = coordinator_address or f"127.0.0.1:{_free_port()}"
         procs: List[subprocess.Popen] = []
         paths = []
+        spawned_at = time.time()
+        attempt_started = time.monotonic()
         for pid_ in local_ids:
+            heartbeat_path = os.path.join(scratch, f"a{attempt}_p{pid_}.hb")
+            with open(heartbeat_path, "w") as f:
+                # baseline: the hang clock starts at spawn, not first touch
+                f.write(str(spawned_at))
             ctx = LaunchContext(
                 process_id=pid_,
                 num_processes=num_processes,
                 coordinator_address=coord,
                 attempt=attempt,
                 checkpoint_path=checkpoint_path,
+                heartbeat_path=heartbeat_path,
             )
             payload_path = os.path.join(scratch, f"a{attempt}_p{pid_}.pkl")
             result_path = os.path.join(scratch, f"a{attempt}_p{pid_}.result")
@@ -287,11 +468,12 @@ def _run_attempts(
                 )
             )
             log_f.close()
-            paths.append((result_path, log_path, pid_))
+            paths.append((result_path, log_path, heartbeat_path, pid_))
 
         deadline = time.monotonic() + timeout_s
         attempt_failed = False
         timed_out = False
+        hung_ids = set()
         while True:
             codes = [p.poll() for p in procs]
             if any(c is not None and c != 0 for c in codes):
@@ -303,19 +485,36 @@ def _run_attempts(
                 attempt_failed = True
                 timed_out = True
                 break
+            if hang_timeout_s:
+                now = time.time()
+                for p, (_, _, hb_path, pid_) in zip(procs, paths):
+                    if p.poll() is not None:
+                        continue
+                    try:
+                        last = os.path.getmtime(hb_path)
+                    except OSError:
+                        last = spawned_at
+                    if now - last > hang_timeout_s:
+                        hung_ids.add(pid_)
+                if hung_ids:
+                    # a stalled world never trips the coordination service
+                    # (nobody died) — flag it long before the global timeout
+                    attempt_failed = True
+                    break
             time.sleep(poll_interval)
 
         if attempt_failed:
             # give survivors the chance to exit on their own (coordination-
             # service termination / surfaced exception) so `forced` records
-            # who actually surfaced the failure; hung worlds skip the grace
-            if not timed_out and survivor_grace_s > 0:
+            # who actually surfaced the failure; hung/timed-out worlds skip
+            # the grace (nobody is going to exit on their own)
+            if not timed_out and not hung_ids and survivor_grace_s > 0:
                 grace_end = time.monotonic() + survivor_grace_s
                 while (any(p.poll() is None for p in procs)
                        and time.monotonic() < grace_end):
                     time.sleep(poll_interval)
             forced_ids = set()
-            for p, (_, _, pid_) in zip(procs, paths):
+            for p, (_, _, _, pid_) in zip(procs, paths):
                 if p.poll() is None:
                     forced_ids.add(pid_)
                     p.kill()
@@ -324,16 +523,35 @@ def _run_attempts(
                     p.wait(timeout=30)
                 except subprocess.TimeoutExpired:  # pragma: no cover
                     pass
-            for p, (_, log_path, pid_) in zip(procs, paths):
+            for p, (_, log_path, _, pid_) in zip(procs, paths):
                 rc = p.returncode if p.returncode is not None else -1
                 if rc != 0:
+                    # a heartbeat stall is detected at WORLD level (the
+                    # first process to cross the threshold trips the
+                    # teardown while its equally-stalled peers may be
+                    # milliseconds short) — every process killed in a hang
+                    # teardown was part of the stalled world
+                    if hung_ids and pid_ in forced_ids:
+                        reason = "hung"
+                    elif timed_out:
+                        reason = "slow"
+                    elif pid_ in forced_ids:
+                        reason = "torn_down"
+                    else:
+                        reason = "crashed"
                     failures.append(
                         ProcessFailure(
                             attempt, pid_, rc, _tail(log_path),
                             forced=pid_ in forced_ids,
+                            reason=reason,
                         )
                     )
-            why = "timed out" if timed_out else "process death"
+            if hung_ids:
+                why = f"heartbeats stalled > {hang_timeout_s}s"
+            elif timed_out:
+                why = "timed out"
+            else:
+                why = "process death"
             if restarts >= max_restarts:
                 raise LaunchFailedError(
                     f"distributed world failed ({why}) on attempt {attempt} "
@@ -348,20 +566,37 @@ def _run_attempts(
                 )
             restarts += 1
             attempt += 1
+            if time.monotonic() - attempt_started > healthy_uptime_s:
+                consecutive_failures = 0
+            consecutive_failures += 1
+            backoff = restart_backoff_s(consecutive_failures - 1)
             logger.warning(
                 "[RayXGBoost] distributed world died (%s, attempt %d); "
-                "restarting from checkpoint %r (restart %d/%d).",
+                "restarting from checkpoint %r (restart %d/%d, backoff "
+                "%.2fs).",
                 why, attempt - 1, checkpoint_path, restarts, max_restarts,
+                backoff,
             )
+            if backoff > 0:
+                time.sleep(backoff)
             continue
 
         results = []
-        for result_path, log_path, pid_ in paths:
+        for result_path, log_path, _, pid_ in paths:
             try:
                 with open(result_path, "rb") as f:
                     results.append(pickle.load(f))
-            except OSError:
-                results.append(None)
+            except (OSError, EOFError, pickle.UnpicklingError) as exc:
+                # a zero-exit worker that left no (readable) result is a
+                # broken contract, not a partial success — surface it with
+                # the worker's log instead of silently returning None
+                raise LaunchFailedError(
+                    f"worker {pid_} exited 0 but its result file is "
+                    f"missing/unreadable ({type(exc).__name__}: {exc}); "
+                    f"refusing to return a partial world. Log tail:\n"
+                    f"{_tail(log_path)}",
+                    failures,
+                )
         return LaunchResult(
             results=results, restarts=restarts, failures=failures
         )

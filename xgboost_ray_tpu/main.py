@@ -33,6 +33,7 @@ from xgboost_ray_tpu.callback import (
     DistributedCallbackContainer,
     TrainingCallback,
 )
+from xgboost_ray_tpu import faults
 from xgboost_ray_tpu.engine import TpuEngine
 from xgboost_ray_tpu.exceptions import (
     RayActorError,
@@ -51,7 +52,7 @@ from xgboost_ray_tpu.matrix import (
 from xgboost_ray_tpu.models.booster import RayXGBoostBooster
 from xgboost_ray_tpu.params import parse_params
 from xgboost_ray_tpu import session as session_mod
-from xgboost_ray_tpu.util import Event, Queue
+from xgboost_ray_tpu.util import Event, Queue, restart_backoff_s
 
 logger = logging.getLogger(__name__)
 
@@ -236,6 +237,7 @@ class RayXGBoostActor:
     def load_data(self, data: RayDMatrix):
         if data in self._data:
             return
+        faults.fire("actor.load_shard", rank=self.rank)
         self._distributed_callbacks.before_data_loading(self, data)
         shard = data.get_data(self.rank, self.num_actors)
         n = shard["data"].shape[0] if shard["data"] is not None else 0
@@ -282,6 +284,31 @@ class _TrainingState:
     last_resource_check_at: float = 0.0
 
     training_started_at: float = 0.0
+
+    # robustness accounting: rounds completed inside the CURRENT attempt
+    # (replay arithmetic), when the last failure was detected (so the next
+    # attempt's first completed round closes the time-to-recover clock),
+    # and failures since the last real forward progress (backoff index —
+    # an isolated failure in a long job must not inherit an escalated wait)
+    rounds_this_attempt: int = 0
+    recover_started_at: Optional[float] = None
+    consecutive_failures: int = 0
+
+
+def _mark_recovered(state: "_TrainingState") -> None:
+    """First forward progress after a restart: close the recovery clock and
+    rewind the backoff escalation."""
+    state.consecutive_failures = 0
+    if state.recover_started_at is None:
+        return
+    rob = state.additional_results.get("robustness")
+    if rob is not None:
+        rob["time_to_recover_s"] = round(
+            rob.get("time_to_recover_s", 0.0)
+            + (time.time() - state.recover_started_at),
+            4,
+        )
+    state.recover_started_at = None
 
 
 def _create_actor(
@@ -714,6 +741,7 @@ def _train(
     # compiled multi-round programs (lax.scan inside shard_map; see
     # engine.step_many). Scan length is bounded by ENV.SCAN_MAX_CHUNK and
     # clamped so no scan crosses a checkpoint boundary.
+    state.rounds_this_attempt = 0
     use_batched = (
         not callbacks
         and obj is None
@@ -721,6 +749,9 @@ def _train(
         and early_stopping_rounds is None
         and engine.can_batch_rounds()
         and boost_rounds_left > 1
+        # round-granular fault injection needs the per-round path so a
+        # scheduled fault hits its exact round, not a fused-chunk boundary
+        and not faults.plan_targets("actor.train_round")
     )
     if use_batched:
         # chunk size decoupled from checkpoint_frequency: scans never fuse
@@ -739,6 +770,8 @@ def _train(
             chunk_started = time.time()
             chunk_results = engine.step_many(completed, n)
             round_times.extend([(time.time() - chunk_started) / n] * n)
+            state.rounds_this_attempt += n
+            _mark_recovered(state)
             for ri, round_metrics in enumerate(chunk_results):
                 for set_name, metrics in round_metrics.items():
                     for metric_name, value in metrics.items():
@@ -810,6 +843,10 @@ def _train(
             if hasattr(model_cb, "before_iteration"):
                 model_cb.before_iteration(proxy, i, evals_result)
 
+        faults.fire(
+            "actor.train_round", round=engine.iteration_offset + i
+        )
+
         round_started = time.time()
         gh_custom = None
         if obj is not None:
@@ -824,6 +861,8 @@ def _train(
 
         round_metrics = engine.step(i, gh_custom=gh_custom)
         completed += 1
+        state.rounds_this_attempt += 1
+        _mark_recovered(state)
         round_times.append(time.time() - round_started)
 
         # custom metric (feval) computed per process on its local rows, then
@@ -1174,6 +1213,40 @@ def train(
     final_evals_result: Dict = {}
     booster: Optional[RayXGBoostBooster] = None
 
+    # recovery observability: restarts taken, rounds replayed after each
+    # restart-from-checkpoint, and failure->first-new-round latency. Present
+    # (all zeros) even on clean runs so dashboards have a stable shape.
+    robustness = state.additional_results.setdefault(
+        "robustness",
+        {
+            "restarts": 0,
+            "elastic_restarts": 0,
+            "rounds_replayed": 0,
+            "time_to_recover_s": 0.0,
+            "backoff_s": 0.0,
+        },
+    )
+
+    def _xgb_base_rounds() -> int:
+        return xgb_model.num_boosted_rounds() if xgb_model else 0
+
+    def _account_failure() -> None:
+        """Called on every restart-causing exception: rounds progressed past
+        the surviving checkpoint will be replayed by the next attempt."""
+        progressed = (
+            num_boost_round - boost_rounds_left
+        ) + state.rounds_this_attempt
+        if state.checkpoint.value:
+            covered = (
+                _deserialize_booster(state.checkpoint.value).num_boosted_rounds()
+                - _xgb_base_rounds()
+            )
+        else:
+            covered = 0
+        robustness["rounds_replayed"] += max(0, progressed - covered)
+        state.rounds_this_attempt = 0
+        state.recover_started_at = time.time()
+
     while tries <= max_actor_restarts:
         # restart-from-checkpoint round arithmetic (mirror main.py:1606-1612)
         if state.checkpoint.value and state.checkpoint.value != last_checkpoint_value:
@@ -1184,6 +1257,9 @@ def train(
             boost_rounds_left = num_boost_round - done_rounds
             last_checkpoint_value = state.checkpoint.value
             if boost_rounds_left <= 0:
+                # the checkpoint already covers every round: the restart IS
+                # the recovery — close the clock before leaving the loop
+                _mark_recovered(state)
                 break
 
         try:
@@ -1208,6 +1284,8 @@ def train(
             # elastic reintegration: free restart (mirror main.py:1661-1673)
             logger.info(f"[RayXGBoost] {exc} Restarting from checkpoint with "
                         f"reintegrated workers.")
+            robustness["elastic_restarts"] += 1
+            _account_failure()
             _promote_pending_actors(state)
             state.queue = Queue()
             state.stop_event = Event()
@@ -1218,6 +1296,12 @@ def train(
             if state.training_started_at:
                 total_training_time += time.time() - state.training_started_at
                 state.training_started_at = 0.0
+            robustness["restarts"] += 1
+            _account_failure()
+            # only REAL failures escalate the backoff exponent — the elastic
+            # reintegration restart above replays rounds but is a planned
+            # event, not a crash
+            state.consecutive_failures += 1
             alive = _apply_failure(state, exc)
             if ray_params.elastic_training:
                 dead = ray_params.num_actors - alive
@@ -1251,6 +1335,21 @@ def train(
             state.queue = Queue()
             state.stop_event = Event()
             _rewire_actors(state)
+            # exponential backoff + jitter before the retry so a persistent
+            # fault cannot crash-loop at full speed; indexed by CONSECUTIVE
+            # failures (rewound on forward progress), so an isolated failure
+            # hours into a job waits only the base delay
+            # (RXGB_RESTART_BACKOFF_* to tune; base 0 disables)
+            backoff = restart_backoff_s(state.consecutive_failures - 1)
+            if backoff > 0:
+                logger.warning(
+                    f"[RayXGBoost] Backing off {backoff:.2f}s before "
+                    f"restart {robustness['restarts']}."
+                )
+                robustness["backoff_s"] = round(
+                    robustness["backoff_s"] + backoff, 4
+                )
+                time.sleep(backoff)
             tries += 1
             continue
         except BaseException:

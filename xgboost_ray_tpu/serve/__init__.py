@@ -24,7 +24,11 @@ or publish straight from training::
     train(params, dtrain, ray_params=rp, serve_registry=reg)
 """
 
-from xgboost_ray_tpu.serve.batcher import MicroBatcher
+from xgboost_ray_tpu.serve.batcher import (
+    MicroBatcher,
+    OverloadedError,
+    ShuttingDownError,
+)
 from xgboost_ray_tpu.serve.http import ServeHandle, create_server
 from xgboost_ray_tpu.serve.metrics import ServeMetrics
 from xgboost_ray_tpu.serve.predictor import (
@@ -45,7 +49,9 @@ __all__ = [
     "MicroBatcher",
     "ModelRegistry",
     "NoModelError",
+    "OverloadedError",
     "ServeHandle",
+    "ShuttingDownError",
     "ServeMetrics",
     "bucket_rows",
     "coerce_model",

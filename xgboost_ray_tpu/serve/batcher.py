@@ -23,8 +23,18 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from xgboost_ray_tpu import faults
 from xgboost_ray_tpu.serve.predictor import KINDS
-from xgboost_ray_tpu.serve.registry import ModelRegistry
+from xgboost_ray_tpu.serve.registry import ModelRegistry, NoModelError
+
+
+class OverloadedError(RuntimeError):
+    """The queue is at its ``max_queue_rows`` cap: the request is shed
+    (HTTP 429) instead of queueing unboundedly behind a slow predictor."""
+
+
+class ShuttingDownError(RuntimeError):
+    """The batcher is shut down / shutting down; no new requests (HTTP 503)."""
 
 
 class _Pending:
@@ -49,14 +59,25 @@ class MicroBatcher:
         max_batch: int = 256,
         max_delay_ms: float = 2.0,
         metrics=None,
+        max_queue_rows: int = 0,
+        breaker_threshold: int = 5,
     ):
         self.registry = registry
         self.max_batch = int(max_batch)
         self.max_delay_s = float(max_delay_ms) / 1000.0
         self.metrics = metrics
+        # load shedding: reject (429) once this many rows are queued
+        # (0 = unbounded, the pre-hardening behavior)
+        self.max_queue_rows = int(max_queue_rows)
+        # degradation breaker: this many consecutive failed batches flips
+        # /healthz to "degraded" (a success closes it again)
+        self.breaker_threshold = int(breaker_threshold)
         self._cond = threading.Condition(threading.Lock())
         self._queues: Dict[str, List[_Pending]] = {k: [] for k in KINDS}
         self._depth = 0  # pending requests across kinds (queue_depth gauge)
+        self._queued_rows = 0  # pending ROWS across kinds (shedding cap)
+        self._executing = 0  # batches currently running on the device
+        self._consecutive_failures = 0
         self._closed = False
         self._thread = threading.Thread(
             target=self._flusher, name="serve-flusher", daemon=True
@@ -75,11 +96,26 @@ class MicroBatcher:
                 f"unknown serve output kind {kind!r}; one of {KINDS}"
             )
         req = _Pending(np.asarray(x, np.float32), kind)
+        n_rows = int(req.x.shape[0])
         with self._cond:
+            # the closed check and the append are one atomic block: a
+            # request can never slip in between shutdown's closed-flip and
+            # its straggler sweep and then sit out its full client timeout
             if self._closed:
-                raise RuntimeError("batcher is shut down")
+                raise ShuttingDownError("batcher is shut down")
+            if (
+                self.max_queue_rows
+                and self._queued_rows + n_rows > self.max_queue_rows
+            ):
+                if self.metrics is not None:
+                    self.metrics.observe_shed()
+                raise OverloadedError(
+                    f"serve queue is full ({self._queued_rows} rows queued, "
+                    f"cap {self.max_queue_rows}); request shed"
+                )
             self._queues[kind].append(req)
             self._depth += 1
+            self._queued_rows += n_rows
             self._cond.notify_all()
         if not req.event.wait(timeout):
             # shed the request if it is still queued, so an abandoned
@@ -90,6 +126,11 @@ class MicroBatcher:
                 if req in q:
                     q.remove(req)
                     self._depth -= 1
+                    self._queued_rows -= n_rows
+                closed = self._closed
+            if closed:
+                # a shutdown racing this wait is a drain, not a timeout
+                raise ShuttingDownError("batcher shut down while waiting")
             raise TimeoutError(
                 f"serve request did not complete within {timeout}s"
             )
@@ -105,19 +146,57 @@ class MicroBatcher:
         with self._cond:
             return self._depth
 
+    def queued_rows(self) -> int:
+        with self._cond:
+            return self._queued_rows
+
+    def executing_batches(self) -> int:
+        """Batches currently running on the device (drain barometer)."""
+        with self._cond:
+            return self._executing
+
+    def consecutive_failures(self) -> int:
+        with self._cond:
+            return self._consecutive_failures
+
+    @property
+    def breaker_open(self) -> bool:
+        """True once ``breaker_threshold`` batches failed in a row — the
+        endpoint reports itself ``degraded`` (requests still flow, so one
+        success can close the breaker again)."""
+        with self._cond:
+            return (
+                self.breaker_threshold > 0
+                and self._consecutive_failures >= self.breaker_threshold
+            )
+
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Block until nothing is queued or executing (graceful-shutdown
+        step 2); True when fully drained within ``timeout``."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._cond:
+                if self._depth == 0 and self._executing == 0:
+                    return True
+            time.sleep(0.005)
+        with self._cond:
+            return self._depth == 0 and self._executing == 0
+
     def shutdown(self, timeout: float = 5.0) -> None:
+        # closed-flip and the straggler sweep are one atomic block, so a
+        # queued request is failed promptly instead of waiting out its
+        # client timeout (mid-execution batches still complete normally)
         with self._cond:
             self._closed = True
-            self._cond.notify_all()
-        self._thread.join(timeout)
-        # fail any stragglers rather than leaving clients blocked
-        with self._cond:
             for q in self._queues.values():
                 for req in q:
-                    req.error = RuntimeError("batcher shut down")
+                    req.error = ShuttingDownError("batcher shut down")
                     req.event.set()
                 q.clear()
             self._depth = 0
+            self._queued_rows = 0
+            self._cond.notify_all()
+        self._thread.join(timeout)
 
     # -- flusher side ------------------------------------------------------
 
@@ -164,10 +243,21 @@ class MicroBatcher:
                     batch.append(r)
                     rows += int(r.x.shape[0])
                 self._depth -= len(batch)
-            self._execute(kind, batch)
+                self._queued_rows -= rows
+                self._executing += 1
+            try:
+                self._execute(kind, batch)
+            finally:
+                with self._cond:
+                    self._executing -= 1
 
     def _execute(self, kind: str, batch: List[_Pending]) -> None:
         try:
+            faults.fire(
+                "serve.predict",
+                kind=kind,
+                rows=sum(int(r.x.shape[0]) for r in batch),
+            )
             with self.registry.lease() as entry:
                 # per-request feature validation against the LEASED model:
                 # a hot-swap between an HTTP-level check and batch
@@ -198,10 +288,16 @@ class MicroBatcher:
                 r.result = out[lo:hi]
                 r.version = version
                 lo = hi
+            with self._cond:
+                self._consecutive_failures = 0  # breaker half-open -> closed
         except BaseException as exc:  # noqa: BLE001 - marshal to waiters
             # not counted here: the error surfaces from submit() and is
             # counted once per failed request by the front-end (a batch
             # observe here would double-count every failure)
+            if not isinstance(exc, NoModelError):
+                # NoModelError is an empty endpoint, not a broken predictor
+                with self._cond:
+                    self._consecutive_failures += 1
             for r in batch:
                 r.error = exc
         finally:

@@ -8,8 +8,9 @@ Endpoints (JSON in/out, loopback-friendly, no extra dependencies):
 * ``POST /models`` — hot-swap: body ``{"path": "..."}`` (saved native or
   xgboost JSON model) or ``{"model_json": {...}}``; drains in-flight
   batches, responds ``{"model_version": v}``.
-* ``GET /healthz`` — 200 ``{"status": "ok", "model_version": v}`` once a
-  model is registered, 503 before.
+* ``GET /healthz`` — 200 ``{"status": "ok", "model_version": v}`` when
+  serving; 503 with ``status`` ``no_model`` / ``draining`` (graceful
+  shutdown) / ``degraded`` (consecutive-predictor-failure breaker open).
 * ``GET /metrics`` — the ``ServeMetrics.snapshot()`` dict: qps, queue
   depth, p50/p95/p99 latency, padding-waste fraction, recompile count —
   the serving analog of the ``AllreduceBytes``-through-additional_results
@@ -28,7 +29,11 @@ from typing import Optional
 
 import numpy as np
 
-from xgboost_ray_tpu.serve.batcher import MicroBatcher
+from xgboost_ray_tpu.serve.batcher import (
+    MicroBatcher,
+    OverloadedError,
+    ShuttingDownError,
+)
 from xgboost_ray_tpu.serve.metrics import ServeMetrics
 from xgboost_ray_tpu.serve.predictor import compile_count
 from xgboost_ray_tpu.serve.registry import ModelRegistry, NoModelError
@@ -57,12 +62,25 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 - http.server API
         h = self.serve_handle
         if self.path == "/healthz":
-            if h.registry.has_model:
+            # 503 is reserved for the take-me-out-of-rotation states:
+            # draining (graceful shutdown), no model yet, and degraded
+            # (consecutive-predictor-failure breaker open). Requests still
+            # flow while degraded so one success can close the breaker.
+            if h.draining:
+                self._reply(503, {"status": "draining"})
+            elif not h.registry.has_model:
+                self._reply(503, {"status": "no_model"})
+            elif h.batcher.breaker_open:
+                self._reply(503, {
+                    "status": "degraded",
+                    "consecutive_predictor_failures":
+                        h.batcher.consecutive_failures(),
+                    "model_version": h.registry.version,
+                })
+            else:
                 self._reply(200, {
                     "status": "ok", "model_version": h.registry.version,
                 })
-            else:
-                self._reply(503, {"status": "no_model"})
             return
         if self.path == "/metrics":
             self._reply(200, h.metrics.snapshot())
@@ -86,6 +104,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _do_predict(self, h: "ServeHandle", doc: dict) -> None:
         t0 = time.monotonic()
+        if h.draining:
+            # graceful shutdown step 1: stop ACCEPTING before draining
+            self._reply(503, {"error": "endpoint is draining"})
+            return
         data = doc.get("data")
         if data is None:
             self._reply(400, {"error": "missing 'data'"})
@@ -101,7 +123,11 @@ class _Handler(BaseHTTPRequestHandler):
             # feature-count validation happens in the batcher against the
             # LEASED model (hot-swap safe); its ValueError maps to 400 below
             result, version = h.batcher.submit(x, kind)
-        except NoModelError as exc:
+        except OverloadedError as exc:
+            # shed counted once, in the batcher, when the cap rejected it
+            self._reply(429, {"error": str(exc)})
+            return
+        except (NoModelError, ShuttingDownError) as exc:
             self._reply(503, {"error": str(exc)})
             return
         except (ValueError, TypeError) as exc:
@@ -137,6 +163,11 @@ class _Handler(BaseHTTPRequestHandler):
         except (OSError, ValueError, TypeError, KeyError) as exc:
             self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
             return
+        except Exception as exc:  # noqa: BLE001 - compile/warmup failures
+            # an XLA compile error (or an injected registry.swap fault) must
+            # produce a structured 500, not a dropped connection
+            self._reply(500, {"error": f"{type(exc).__name__}: {exc}"})
+            return
         self._reply(200, {"model_version": version})
 
 
@@ -153,7 +184,10 @@ class ServeHandle:
         max_delay_ms: float = 2.0,
         min_bucket: int = 8,
         warm_kinds: tuple = ("value",),
+        max_queue_rows: int = 0,
+        breaker_threshold: int = 5,
     ):
+        self._draining = False
         self.metrics = ServeMetrics(recompile_count_fn=compile_count)
         self.registry = ModelRegistry(
             devices=devices,
@@ -177,16 +211,27 @@ class ServeHandle:
                 max_batch=max_batch,
                 max_delay_ms=max_delay_ms,
                 metrics=self.metrics,
+                max_queue_rows=max_queue_rows,
+                breaker_threshold=breaker_threshold,
             )
         except BaseException:
             self._httpd.server_close()
             raise
         self.metrics.queue_depth_fn = self.batcher.queue_depth
+        self.metrics.breaker_fn = lambda: {
+            "breaker_open": int(self.batcher.breaker_open),
+            "consecutive_predictor_failures":
+                self.batcher.consecutive_failures(),
+        }
 
     @property
     def url(self) -> str:
         host, port = self._httpd.server_address[:2]
         return f"http://{host}:{port}"
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     def start(self) -> "ServeHandle":
         self._server_thread = threading.Thread(
@@ -195,7 +240,11 @@ class ServeHandle:
         self._server_thread.start()
         return self
 
-    def shutdown(self) -> None:
+    def shutdown(self, drain_timeout_s: float = 5.0) -> None:
+        """Graceful: stop accepting (503 on new /predict), drain queued and
+        in-flight batches, then close the server and the batcher."""
+        self._draining = True
+        self.batcher.drain(drain_timeout_s)
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._server_thread is not None:

@@ -80,6 +80,7 @@ class ServeMetrics:
         self,
         queue_depth_fn: Optional[Callable[[], int]] = None,
         recompile_count_fn: Optional[Callable[[], int]] = None,
+        breaker_fn: Optional[Callable[[], Dict[str, int]]] = None,
     ):
         self._lock = threading.Lock()
         self._started = time.monotonic()
@@ -87,12 +88,16 @@ class ServeMetrics:
         self.requests = 0
         self.rows = 0
         self.errors = 0
+        self.shed = 0  # requests rejected at the max_queue_rows cap (429)
         self.batches = 0
         self.batch_rows = 0
         self.padded_rows = 0  # padding rows added on top of batch_rows
         self.model_swaps = 0
         self.queue_depth_fn = queue_depth_fn
         self.recompile_count_fn = recompile_count_fn
+        # injected by the front-end: live degradation-breaker state
+        # {"breaker_open": 0|1, "consecutive_predictor_failures": n}
+        self.breaker_fn = breaker_fn
         # the compile counter is process-global (the program cache is shared
         # so hot-swaps reuse programs); report compiles SINCE this endpoint
         # came up (re-baselined by reset()), not the process total
@@ -107,6 +112,7 @@ class ServeMetrics:
             self.requests = 0
             self.rows = 0
             self.errors = 0
+            self.shed = 0
             self.batches = 0
             self.batch_rows = 0
             self.padded_rows = 0
@@ -123,6 +129,10 @@ class ServeMetrics:
     def observe_error(self) -> None:
         with self._lock:
             self.errors += 1
+
+    def observe_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
 
     def observe_batch(self, n_rows: int, bucket: int) -> None:
         with self._lock:
@@ -143,6 +153,7 @@ class ServeMetrics:
                 "requests": self.requests,
                 "rows": self.rows,
                 "errors": self.errors,
+                "shed": self.shed,
                 "qps": round(self.requests / elapsed, 3),
                 "rows_per_s": round(self.rows / elapsed, 3),
                 "batches": self.batches,
@@ -162,6 +173,8 @@ class ServeMetrics:
             }
         if self.queue_depth_fn is not None:
             snap["queue_depth"] = int(self.queue_depth_fn())
+        if self.breaker_fn is not None:
+            snap.update(self.breaker_fn())
         if self.recompile_count_fn is not None:
             snap["recompile_count"] = (
                 int(self.recompile_count_fn()) - self._recompile_base

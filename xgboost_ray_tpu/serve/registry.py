@@ -23,6 +23,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from xgboost_ray_tpu import faults
 from xgboost_ray_tpu.models.booster import RayXGBoostBooster
 from xgboost_ray_tpu.serve.predictor import KINDS, CompiledPredictor
 
@@ -100,6 +101,7 @@ class ModelRegistry:
         """Register ``model`` and atomically make it current; returns the
         new version. Compiles (warmup) happen before the old model stops
         serving, and in-flight batches drain before the flip."""
+        faults.fire("registry.swap", version=self._version + 1)
         booster = coerce_model(model)
         predictor = CompiledPredictor(
             booster, devices=self.devices, min_bucket=self.min_bucket

@@ -63,3 +63,34 @@ class MultiActorTask:
 
     def is_ready(self) -> bool:
         return all(check() for check in self._checks)
+
+
+def restart_backoff_s(
+    restart_index: int,
+    base: Optional[float] = None,
+    cap: Optional[float] = None,
+    jitter: Optional[float] = None,
+) -> float:
+    """Delay before restart number ``restart_index`` (0-based, counted over
+    CONSECUTIVE failures — callers reset their index once recovery makes
+    real forward progress): full jitter on an exponential schedule,
+    ``base * 2^i`` capped at ``cap``, scaled by ``1 + U(0, jitter)``.
+    Shared by the driver retry loop and the launcher so a persistent fault
+    cannot crash-loop storm. Env-tunable: ``RXGB_RESTART_BACKOFF_BASE_S``
+    (default 0.5; 0 disables), ``RXGB_RESTART_BACKOFF_MAX_S`` (default 30),
+    ``RXGB_RESTART_BACKOFF_JITTER`` (fraction, default 0.1)."""
+    import os
+    import random
+
+    if base is None:
+        base = float(os.environ.get("RXGB_RESTART_BACKOFF_BASE_S", "0.5"))
+    if base <= 0:
+        return 0.0
+    if cap is None:
+        cap = float(os.environ.get("RXGB_RESTART_BACKOFF_MAX_S", "30"))
+    if jitter is None:
+        jitter = float(os.environ.get("RXGB_RESTART_BACKOFF_JITTER", "0.1"))
+    delay = min(cap, base * (2.0 ** max(0, int(restart_index))))
+    if jitter > 0:
+        delay *= 1.0 + random.random() * jitter
+    return delay
