@@ -164,6 +164,96 @@ def test_chaos_tripwire_skips_incomparable_records():
     assert bench.chaos_recovery_tripwire({}, rec_tpu, "x") is None
 
 
+_SAMP_CFG = {"rows": 200000, "features": 28, "rounds": 20, "actors": 8,
+             "max_depth": 6, "subsample_rate": 0.5, "goss_top_rate": 0.1,
+             "goss_other_rate": 0.1}
+
+
+def _sampling_section(sub_per_round, cfg=None):
+    return {
+        "rounds": 20,
+        "full": {"per_round_s": 5.0, "final_logloss": 0.513},
+        "subsample": {"per_round_s": sub_per_round, "final_logloss": 0.513},
+        "goss": {"per_round_s": 1.35, "final_logloss": 0.513},
+        "config": dict(cfg if cfg is not None else _SAMP_CFG),
+    }
+
+
+def test_sampling_tripwire_fires_on_sampled_round_regression(capsys):
+    rec = {"metric": "m", "backend": "cpu",
+           "sampling": _sampling_section(3.0)}
+    out = bench.sampling_round_time_tripwire(
+        _sampling_section(6.0), rec, "BENCH_r06.json", backend="cpu"
+    )
+    assert out is not None and out["fired"]
+    assert out["ratio"] == 2.0
+    assert out["prev_per_round_s"] == 3.0
+    assert "SAMPLING TRIPWIRE" in capsys.readouterr().err
+
+
+def test_sampling_tripwire_quiet_within_20pct(capsys):
+    rec = {"metric": "m", "backend": "cpu",
+           "sampling": _sampling_section(3.0)}
+    out = bench.sampling_round_time_tripwire(
+        _sampling_section(3.5), rec, "x", backend="cpu"
+    )
+    assert out is not None and not out["fired"]
+    assert "SAMPLING TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_sampling_tripwire_reports_but_never_fires_on_config_mismatch(capsys):
+    other = dict(_SAMP_CFG, rows=20000)
+    rec = {"metric": "m", "backend": "cpu",
+           "sampling": _sampling_section(3.0, other)}
+    out = bench.sampling_round_time_tripwire(
+        _sampling_section(9.0), rec, "x", backend="cpu"
+    )
+    assert out is not None and not out["fired"]
+    assert out["config_mismatch"] is True
+    assert "SAMPLING TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_sampling_tripwire_skips_incomparable_records():
+    cur = _sampling_section(6.0)
+    rec_tpu = {"metric": "m", "backend": "tpu",
+               "sampling": _sampling_section(3.0)}
+    assert bench.sampling_round_time_tripwire(
+        cur, rec_tpu, "x", backend="cpu") is None
+    rec_none = {"metric": "m", "backend": "cpu"}  # pre-sampling-era record
+    assert bench.sampling_round_time_tripwire(
+        cur, rec_none, "x", backend="cpu") is None
+    assert bench.sampling_round_time_tripwire(None, rec_tpu, "x") is None
+    assert bench.sampling_round_time_tripwire({}, rec_tpu, "x") is None
+
+
+def test_r4_paired_recheck_verdict_environmental():
+    detail = {
+        "hist_quant_ablation": {"none": {"per_round_s": 4.1}},
+        "sampling": {"full": {"per_round_s": 4.2}},
+    }
+    out = bench.r4_paired_recheck(detail)
+    assert out is not None
+    assert out["pair_ratio"] < 1.05
+    # recorded 1.89x is far outside the in-process pair band
+    assert out["verdict"] == "environmental"
+
+
+def test_r4_paired_recheck_inconclusive_when_pair_is_noisy():
+    detail = {
+        "hist_quant_ablation": {"none": {"per_round_s": 2.0}},
+        "sampling": {"full": {"per_round_s": 3.8}},
+    }
+    out = bench.r4_paired_recheck(detail)
+    assert out is not None and out["verdict"] == "inconclusive"
+
+
+def test_r4_paired_recheck_none_without_both_arms():
+    assert bench.r4_paired_recheck({}) is None
+    assert bench.r4_paired_recheck(
+        {"sampling": {"full": {"per_round_s": 4.0}}}
+    ) is None
+
+
 def test_load_latest_bench_record_picks_newest_round(tmp_path):
     for n, val in ((1, 0.9), (5, 1.44), (3, 0.8)):
         (tmp_path / f"BENCH_r{n:02d}.json").write_text(

@@ -1,0 +1,389 @@
+"""Row-sampling subsystem tests (ops/sampling.py + engine compaction).
+
+Pins the sampling contract from four sides: (1) sampling OFF is a provable
+no-op — default params and explicit ``subsample=1.0`` trace the same
+program and produce bitwise-identical models; (2) the selection mechanics —
+fixed budgets, distinct rows, rate-unbiased selection under padding,
+GOSS's deterministic top fraction and unbiased remainder amplification —
+on pinned fixtures;
+(3) sampled training is deterministic in (seed, iteration) and lands
+within a documented accuracy tolerance of full-row training on the
+HIGGS-shaped synthetic; (4) chaos compatibility — a sampled run killed
+mid-training resumes from checkpoint to the same model as the
+uninterrupted sampled run (selections replay from the fold-in streams).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, faults, train
+from xgboost_ray_tpu.ops import sampling
+from xgboost_ray_tpu.params import parse_params
+
+
+def _higgs_like(n=6000, f=12, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.standard_normal((n, f)).astype(np.float32)
+    logits = 0.8 * x[:, 0] - 0.6 * x[:, 1] + 0.4 * x[:, 2] * x[:, 3]
+    y = (logits + rng.standard_normal(n).astype(np.float32) > 0).astype(
+        np.float32
+    )
+    return x, y
+
+
+_BASE = {
+    "objective": "binary:logistic",
+    "eval_metric": ["logloss"],
+    "max_depth": 4,
+    "eta": 0.3,
+    "max_bin": 64,
+}
+
+
+def _fit(params, x, y, rounds=8, actors=2, **train_kw):
+    er = {}
+    bst = train(
+        dict(_BASE, **params),
+        RayDMatrix(x, y),
+        rounds,
+        evals=[(RayDMatrix(x, y), "train")],
+        evals_result=er,
+        ray_params=RayParams(num_actors=actors, checkpoint_frequency=0),
+        **train_kw,
+    )
+    return bst, er["train"]["logloss"][-1]
+
+
+# ---------------------------------------------------------------------------
+# spec resolution + param surface
+# ---------------------------------------------------------------------------
+
+
+def test_spec_is_none_when_sampling_off():
+    p = parse_params(dict(_BASE))
+    assert sampling.spec_from_params(p) is None
+    p = parse_params(dict(_BASE, sampling_method="uniform", subsample=1.0))
+    assert sampling.spec_from_params(p) is None
+
+
+def test_spec_resolution():
+    p = parse_params(dict(_BASE, subsample=0.5))
+    spec = sampling.spec_from_params(p)
+    assert spec.policy == "uniform" and spec.rate == 0.5
+    p = parse_params(
+        dict(_BASE, sampling_method="gradient_based", top_rate=0.3,
+             other_rate=0.2)
+    )
+    spec = sampling.spec_from_params(p)
+    assert spec.policy == "gradient_based"
+    assert spec.top_rate == 0.3 and spec.other_rate == 0.2
+
+
+def test_param_validation():
+    with pytest.raises(ValueError, match="sampling_method"):
+        parse_params(dict(_BASE, sampling_method="goss"))
+    with pytest.raises(ValueError, match="subsample"):
+        parse_params(dict(_BASE, subsample=0.0))
+    with pytest.raises(ValueError, match="subsample"):
+        parse_params(dict(_BASE, subsample=1.5))
+    with pytest.raises(ValueError, match="top_rate"):
+        parse_params(
+            dict(_BASE, sampling_method="gradient_based", top_rate=1.2)
+        )
+    with pytest.raises(ValueError, match="top_rate \\+ other_rate"):
+        parse_params(
+            dict(_BASE, sampling_method="gradient_based", top_rate=0.8,
+                 other_rate=0.8)
+        )
+    with pytest.raises(ValueError, match="ambiguous"):
+        parse_params(
+            dict(_BASE, sampling_method="gradient_based", subsample=0.5,
+                 top_rate=0.2)
+        )
+    with pytest.raises(NotImplementedError, match="gblinear"):
+        parse_params(
+            dict(_BASE, booster="gblinear",
+                 sampling_method="gradient_based", top_rate=0.2)
+        )
+    # without explicit rates the same config is xgboost's warned no-op,
+    # so a gblinear drop-in keeps training
+    p = parse_params(
+        dict(_BASE, booster="gblinear", sampling_method="gradient_based")
+    )
+    assert p.sampling_method == "uniform"
+
+
+def test_xgboost_compat_gradient_based_subsample_maps_to_goss_budget():
+    """The documented xgboost gpu_hist recipe — gradient_based driven BY
+    subsample, no GOSS rate names — must stay a drop-in: the rate maps
+    onto the GOSS budget (half deterministic, half amplified-sampled)."""
+    p = parse_params(
+        dict(_BASE, sampling_method="gradient_based", subsample=0.5)
+    )
+    assert p.subsample == 1.0  # consumed by the mapping
+    spec = sampling.spec_from_params(p)
+    assert spec.policy == "gradient_based"
+    assert spec.top_rate == 0.25 and spec.other_rate == 0.25
+    x, y = _higgs_like(800, 6)
+    _, ll = _fit(
+        {"sampling_method": "gradient_based", "subsample": 0.5}, x, y,
+        rounds=5,
+    )
+    assert np.isfinite(ll)
+
+
+def test_xgboost_compat_gradient_based_without_rates_is_noop():
+    """xgboost parity: gradient_based with subsample left at 1.0 and no
+    GOSS rates samples nothing there — here it must warn and train
+    identically to no sampling, not silently drop to the 0.2/0.1
+    defaults."""
+    p = parse_params(dict(_BASE, sampling_method="gradient_based"))
+    assert sampling.spec_from_params(p) is None
+    x, y = _higgs_like(800, 6)
+    bst_a, _ = _fit({}, x, y, rounds=4)
+    bst_b, _ = _fit({"sampling_method": "gradient_based"}, x, y, rounds=4)
+    np.testing.assert_array_equal(
+        bst_a.predict(x, output_margin=True),
+        bst_b.predict(x, output_margin=True),
+    )
+
+
+def test_rates_without_gradient_based_warn(caplog):
+    """Explicit GOSS rates with the default uniform policy are inert —
+    must warn (no silent drops), not pass unremarked."""
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="xgboost_ray_tpu.params"):
+        p = parse_params(dict(_BASE, top_rate=0.1, other_rate=0.1))
+    assert "no effect" in caplog.text
+    assert sampling.spec_from_params(p) is None
+
+
+def test_none_valued_sampling_params_mean_unset():
+    """None means 'unset' across xgboost-adjacent APIs: explicit Nones must
+    resolve to the defaults (not crash range checks), and top_rate=None
+    must NOT count as an explicit rate for the subsample-ambiguity check."""
+    p = parse_params(dict(_BASE, subsample=None, sampling_method=None,
+                          top_rate=None, other_rate=None))
+    assert p.subsample == 1.0 and p.sampling_method == "uniform"
+    assert sampling.spec_from_params(p) is None
+    p = parse_params(dict(_BASE, sampling_method="gradient_based",
+                          subsample=0.5, top_rate=None))
+    assert p.top_rate == 0.25 and p.other_rate == 0.25  # compat mapping
+
+
+def test_sklearn_estimator_passthrough():
+    pytest.importorskip("sklearn")
+    from sklearn.base import clone
+
+    from xgboost_ray_tpu.sklearn import RayXGBClassifier
+
+    clf = RayXGBClassifier(
+        n_estimators=3, max_depth=3, sampling_method="gradient_based",
+        top_rate=0.3, other_rate=0.3, random_state=0,
+    )
+    # explicit ctor params: clone() (GridSearchCV/Pipeline) must carry the
+    # GOSS config — kwargs-only params would silently degrade to the
+    # no-rates no-op on every CV fold
+    params = clone(clf).get_xgb_params()
+    assert params["sampling_method"] == "gradient_based"
+    assert params["top_rate"] == 0.3 and params["other_rate"] == 0.3
+    x, y = _higgs_like(400, 6)
+    clf.fit(x, y, ray_params=RayParams(num_actors=2))
+    assert clf.predict(x[:8]).shape == (8,)
+
+
+# ---------------------------------------------------------------------------
+# selection mechanics (pinned fixtures, pure sample_rows)
+# ---------------------------------------------------------------------------
+
+
+def test_uniform_fixed_budget_distinct_rows_and_zeroed_padding():
+    n = 100
+    gh = jnp.ones((n, 2), jnp.float32)
+    valid = jnp.arange(n) < 40  # only 40 real rows
+    spec = sampling.SamplingSpec("uniform", rate=0.35)
+    assert sampling.row_budget(n, spec) == 35
+    rows, gh_sel = sampling.sample_rows(
+        gh, valid, jax.random.PRNGKey(0), spec
+    )
+    rows = np.asarray(rows)
+    assert rows.shape == (35,) and len(set(rows.tolist())) == 35
+    # selected padding slots contribute nothing; valid slots keep exact gh
+    contrib = np.asarray(gh_sel)[:, 0]
+    np.testing.assert_array_equal(contrib, (rows < 40).astype(np.float32))
+
+
+def test_uniform_keep_rate_unbiased_under_padding():
+    """Every VALID row must be kept with probability ~ rate regardless of
+    how much of the shard block is padding — a heavily padded shard must
+    not silently keep all its rows (that would overweight its data vs the
+    Bernoulli semantics this replaces; no amplification compensates on the
+    uniform path)."""
+    n, n_valid, rate = 100, 40, 0.35
+    gh = jnp.ones((n, 2), jnp.float32)
+    valid = jnp.arange(n) < n_valid
+    spec = sampling.SamplingSpec("uniform", rate=rate)
+    kept = []
+    for s in range(200):
+        rows, gh_sel = sampling.sample_rows(
+            gh, valid, jax.random.PRNGKey(s), spec
+        )
+        kept.append(float(np.asarray(gh_sel)[:, 0].sum()))
+    mean_kept = np.mean(kept)
+    # E[kept valid rows] = m * n_valid / n = rate * n_valid = 14
+    np.testing.assert_allclose(mean_kept, rate * n_valid, rtol=0.05)
+
+
+def test_goss_keeps_top_gradient_rows_and_amplifies_rest():
+    n = 100
+    rng = np.random.RandomState(3)
+    g = rng.standard_normal(n).astype(np.float32)
+    g[:10] = 50.0 + rng.rand(10)  # unmistakable top rows
+    h = np.ones(n, np.float32)
+    gh = jnp.asarray(np.stack([g, h], axis=1))
+    spec = sampling.SamplingSpec(
+        "gradient_based", top_rate=0.1, other_rate=0.2
+    )
+    top_n, rand_n = sampling.goss_counts(n, spec)
+    assert (top_n, rand_n) == (10, 20)
+    rows, gh_sel = sampling.sample_rows(
+        gh, jnp.ones((n,), bool), jax.random.PRNGKey(0), spec
+    )
+    rows = np.asarray(rows)
+    assert rows.shape == (30,)
+    assert set(rows[:10].tolist()) == set(range(10))  # the planted top rows
+    # top rows keep exact gh (score-sorted order); sampled remainder is
+    # amplified by pool/rand_n
+    np.testing.assert_allclose(np.asarray(gh_sel)[:10, 0], g[rows[:10]])
+    amp = (n - top_n) / rand_n
+    np.testing.assert_allclose(
+        np.asarray(gh_sel)[10:, 0], g[rows[10:]] * amp, rtol=1e-6
+    )
+
+
+def test_goss_amplification_unbiased_on_pinned_fixture():
+    """E[sum(gh_sel)] == sum(gh): the amplified remainder is an unbiased
+    estimator of the non-top mass (pinned seed set, 3% tolerance)."""
+    n = 100
+    rng = np.random.RandomState(7)
+    gh_np = np.abs(rng.standard_normal((n, 2))).astype(np.float32)
+    gh = jnp.asarray(gh_np)
+    valid = jnp.ones((n,), bool)
+    spec = sampling.SamplingSpec(
+        "gradient_based", top_rate=0.2, other_rate=0.2
+    )
+    sums = []
+    for s in range(300):
+        _, gh_sel = sampling.sample_rows(
+            gh, valid, jax.random.PRNGKey(s), spec
+        )
+        sums.append(np.asarray(gh_sel).sum(axis=0))
+    mean_sum = np.mean(sums, axis=0)
+    np.testing.assert_allclose(mean_sum, gh_np.sum(axis=0), rtol=0.03)
+
+
+# ---------------------------------------------------------------------------
+# training-level contracts
+# ---------------------------------------------------------------------------
+
+
+def test_subsample_one_bitwise_identical_to_default():
+    """The compaction path must be a no-op when sampling is off: explicit
+    uniform/1.0 params trace the same program as the defaults and the
+    models match BITWISE (the acceptance gate for HEAD~ parity)."""
+    x, y = _higgs_like(1200, 8)
+    bst_a, _ = _fit({}, x, y, rounds=5)
+    bst_b, _ = _fit({"sampling_method": "uniform", "subsample": 1.0}, x, y,
+                    rounds=5)
+    np.testing.assert_array_equal(
+        bst_a.predict(x, output_margin=True),
+        bst_b.predict(x, output_margin=True),
+    )
+
+
+def test_goss_deterministic_in_seed_and_iteration():
+    x, y = _higgs_like(1500, 8)
+    goss = {"sampling_method": "gradient_based", "top_rate": 0.2,
+            "other_rate": 0.2, "seed": 11}
+    bst_a, _ = _fit(goss, x, y, rounds=5)
+    bst_b, _ = _fit(goss, x, y, rounds=5)
+    np.testing.assert_array_equal(
+        bst_a.predict(x, output_margin=True),
+        bst_b.predict(x, output_margin=True),
+    )
+    bst_c, _ = _fit(dict(goss, seed=12), x, y, rounds=5)
+    assert not np.array_equal(
+        bst_a.predict(x, output_margin=True),
+        bst_c.predict(x, output_margin=True),
+    )
+
+
+def test_sampled_accuracy_within_tolerance_of_full():
+    """Documented tolerance (README "Row sampling"): final train logloss of
+    subsample=0.5 and GOSS a=b=0.1 within 0.05 absolute of full-row
+    training on the HIGGS-shaped synthetic."""
+    x, y = _higgs_like(6000, 12)
+    _, full_ll = _fit({}, x, y, rounds=10)
+    _, sub_ll = _fit({"subsample": 0.5}, x, y, rounds=10)
+    _, goss_ll = _fit(
+        {"sampling_method": "gradient_based", "top_rate": 0.1,
+         "other_rate": 0.1}, x, y, rounds=10,
+    )
+    assert abs(sub_ll - full_ll) < 0.05, (full_ll, sub_ll)
+    assert abs(goss_ll - full_ll) < 0.05, (full_ll, goss_ll)
+
+
+def test_uniform_subsample_still_learns_lossguide():
+    x, y = _higgs_like(1500, 8)
+    _, ll = _fit(
+        {"grow_policy": "lossguide", "max_leaves": 8, "subsample": 0.5},
+        x, y, rounds=8,
+    )
+    assert ll < 0.5
+
+
+def test_sampled_training_resumes_after_chaos_kill(monkeypatch):
+    """Sampled training under a FaultPlan rank kill resumes from checkpoint
+    to the same model as the uninterrupted sampled run — selections are
+    deterministic in (seed, iteration, actor), so replayed rounds redraw
+    the same rows (atol mirrors test_faults: resume margins are
+    resummed in a different f32 order)."""
+    monkeypatch.setenv("RXGB_RESTART_BACKOFF_BASE_S", "0")
+    x, y = _higgs_like(800, 6)
+    goss = dict(
+        _BASE, sampling_method="gradient_based", top_rate=0.2,
+        other_rate=0.2,
+    )
+    noop = faults.FaultPlan(rules=[{
+        "site": "actor.train_round", "action": "raise",
+        "match": {"round": -1},
+    }])
+    try:
+        with faults.active_plan(noop):
+            ref = train(
+                goss, RayDMatrix(x, y), 8,
+                ray_params=RayParams(num_actors=2, checkpoint_frequency=2),
+            )
+        plan = faults.FaultPlan(rules=[{
+            "site": "actor.train_round", "action": "raise", "ranks": [1],
+            "match": {"round": 5},
+        }])
+        res = {}
+        with faults.active_plan(plan):
+            bst = train(
+                goss, RayDMatrix(x, y), 8, additional_results=res,
+                ray_params=RayParams(num_actors=2, max_actor_restarts=1,
+                                     checkpoint_frequency=2),
+            )
+    finally:
+        faults.clear_plan()
+    assert res["robustness"]["restarts"] == 1
+    np.testing.assert_allclose(
+        bst.predict(x, output_margin=True),
+        ref.predict(x, output_margin=True),
+        atol=1e-5,
+    )

@@ -42,6 +42,7 @@ from xgboost_ray_tpu.ops.histogram import (
 )
 from xgboost_ray_tpu.ops.grow import (
     SALT_BYTREE,
+    SALT_GOSS,
     SALT_SUBSAMPLE,
     GrowConfig,
     Tree,
@@ -49,6 +50,7 @@ from xgboost_ray_tpu.ops.grow import (
     predict_tree_binned,
     sample_feature_mask,
 )
+from xgboost_ray_tpu.ops import sampling
 from xgboost_ray_tpu.ops.metrics import (
     compute_metric,
     device_metric_contrib,
@@ -720,6 +722,11 @@ class TpuEngine:
 
         is_survival = self.is_survival
 
+        # row sampling (ops/sampling.py): None when off — the None path
+        # traces the exact pre-sampling program, so default params stay
+        # bit-identical to builds that predate the compaction machinery
+        samp_spec = sampling.spec_from_params(params)
+
         def tree_round(bins, valid, label, weight, margins, group_rows, gh_in,
                        rng, bounds, eval_bins, eval_margins):
             """One boosting round; gh_in is None unless a custom objective
@@ -752,15 +759,29 @@ class TpuEngine:
                 for t in range(t_par):
                     key = jax.random.fold_in(rng, k * t_par + t)
                     ghk = jnp.stack([g[:, k], h[:, k]], axis=1)
-                    if params.subsample < 1.0:
+                    bins_t = bins
+                    if samp_spec is not None:
+                        # compact the round's rows to the fixed M-row budget
+                        # so EVERY level's histogram build / partition update
+                        # runs over M rows, not N (the tree walk below is
+                        # then the only full-row work per tree). Per-actor
+                        # key fold: same stream structure as the old
+                        # Bernoulli mask, so selections are deterministic in
+                        # (seed, iteration, actor) and replay identically
+                        # after a checkpoint resume.
+                        salt = (
+                            SALT_GOSS
+                            if samp_spec.policy == "gradient_based"
+                            else SALT_SUBSAMPLE
+                        )
                         skey = jax.random.fold_in(
-                            jax.random.fold_in(key, SALT_SUBSAMPLE),
+                            jax.random.fold_in(key, salt),
                             jax.lax.axis_index("actors"),
                         )
-                        keep = (
-                            jax.random.uniform(skey, (ghk.shape[0],)) < params.subsample
+                        rows_sel, ghk = sampling.sample_rows(
+                            ghk, valid, skey, samp_spec
                         )
-                        ghk = ghk * keep[:, None]
+                        bins_t = bins[rows_sel]
                     fmask = None
                     if params.colsample_bytree < 1.0:
                         fkey = jax.random.fold_in(key, SALT_BYTREE)
@@ -773,7 +794,7 @@ class TpuEngine:
                         or params.colsample_bynode < 1.0
                     )
                     tree, row_value = build_tree(
-                        bins,
+                        bins_t,
                         ghk,
                         self.cuts,
                         cfg,
@@ -788,6 +809,16 @@ class TpuEngine:
                         ar_counter=counter,
                     )
                     trees.append(tree)
+                    if samp_spec is not None:
+                        # the compacted build only knows the sampled rows'
+                        # leaf values; ALL rows need their margin update (the
+                        # next round's gradients cover every row), so walk
+                        # the finished tree over the full binned matrix —
+                        # the same once-per-tree device walk eval sets use.
+                        row_value = predict_tree_binned(
+                            tree, bins, cfg.max_depth, missing_bin,
+                            cat_features=cfg.cat_features,
+                        )
                     new_margins = new_margins.at[:, k].add(row_value / t_par)
                     for e in range(n_evals_dev):
                         upd = predict_tree_binned(

@@ -12,6 +12,7 @@ from xgboost_ray_tpu.ops.binning import (
 )
 from xgboost_ray_tpu.ops.grow import GrowConfig, Tree, build_tree
 from xgboost_ray_tpu.ops.objectives import Objective, get_objective
+from xgboost_ray_tpu.ops.sampling import SamplingSpec, sample_rows
 from xgboost_ray_tpu.ops.split import SplitParams
 
 __all__ = [
@@ -23,5 +24,7 @@ __all__ = [
     "build_tree",
     "Objective",
     "get_objective",
+    "SamplingSpec",
+    "sample_rows",
     "SplitParams",
 ]

@@ -60,6 +60,7 @@ SALT_SUBSAMPLE = 0x51D1
 SALT_BYTREE = 0x51D2
 SALT_BYLEVEL = 0x51D3
 SALT_BYNODE = 0x51D4
+SALT_GOSS = 0x51D5  # gradient_based row sampling (ops/sampling.py)
 
 
 def route_right_binned(bin_vals, split_bin, default_left, is_cat, missing_bin):
@@ -200,8 +201,12 @@ def empty_tree(heap_size: int) -> Tree:
 
 
 def build_tree(
-    bins: jnp.ndarray,  # [N, F] int bins (max_bin == missing bucket)
-    gh: jnp.ndarray,  # [N, 2] float32 grad/hess (0 for padding/subsampled rows)
+    bins: jnp.ndarray,  # [N, F] int bins (max_bin == missing bucket); may be
+    #   a COMPACTED [M, F] row selection (ops/sampling.py) — every shape in
+    #   the level loop derives from bins.shape, so the grower is
+    #   row-count-blind and sampled builds cost O(M), not O(N_full)
+    gh: jnp.ndarray,  # [N, 2] float32 grad/hess (0 for padding rows;
+    #   GOSS-amplified for sampled-remainder rows)
     cuts: jnp.ndarray,  # [F, max_bin-1] raw cut values for threshold recovery
     cfg: GrowConfig,
     feature_mask: Optional[jnp.ndarray] = None,  # [F] bool (colsample_bytree)

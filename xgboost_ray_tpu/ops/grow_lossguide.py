@@ -44,8 +44,11 @@ from xgboost_ray_tpu.ops.split import find_splits, leaf_weight
 
 
 def build_tree_lossguide(
-    bins: jnp.ndarray,  # [N, F] int bins (max_bin == missing bucket)
-    gh: jnp.ndarray,  # [N, 2] grad/hess (0 for padding/subsampled rows)
+    bins: jnp.ndarray,  # [N, F] int bins (max_bin == missing bucket); may be
+    #   a compacted [M, F] row selection (ops/sampling.py) — each step's
+    #   O(N) one-hot pass then costs O(M)
+    gh: jnp.ndarray,  # [N, 2] grad/hess (0 for padding rows; GOSS-amplified
+    #   for sampled-remainder rows)
     cuts: jnp.ndarray,  # [F, max_bin-1] raw cut values
     cfg: GrowConfig,
     feature_mask: Optional[jnp.ndarray] = None,  # [F] bool (colsample_bytree)

@@ -47,6 +47,9 @@ _PARAM_NAMES = [
     "min_child_weight",
     "max_delta_step",
     "subsample",
+    "sampling_method",
+    "top_rate",
+    "other_rate",
     "colsample_bytree",
     "colsample_bylevel",
     "colsample_bynode",
@@ -456,6 +459,9 @@ class _RayXGBEstimator(BaseEstimator, RayXGBMixin):
         min_child_weight: Optional[float] = None,
         max_delta_step: Optional[float] = None,
         subsample: Optional[float] = None,
+        sampling_method: Optional[str] = None,
+        top_rate: Optional[float] = None,
+        other_rate: Optional[float] = None,
         colsample_bytree: Optional[float] = None,
         colsample_bylevel: Optional[float] = None,
         colsample_bynode: Optional[float] = None,
@@ -483,6 +489,11 @@ class _RayXGBEstimator(BaseEstimator, RayXGBMixin):
         self.min_child_weight = min_child_weight
         self.max_delta_step = max_delta_step
         self.subsample = subsample
+        # explicit ctor params (not **kwargs) so sklearn clone()/set_params
+        # carry the GOSS config through CV/pipelines
+        self.sampling_method = sampling_method
+        self.top_rate = top_rate
+        self.other_rate = other_rate
         self.colsample_bytree = colsample_bytree
         self.colsample_bylevel = colsample_bylevel
         self.colsample_bynode = colsample_bynode
