@@ -68,6 +68,12 @@ SERVE_TRIPWIRE_RATIO = 1.5
 # chaos recovery: flag >20% time-to-recover regressions across snapshots
 CHAOS_TRIPWIRE_RATIO = 1.2
 
+# restart-vs-continue: flag >20% regressions of the elastic continuation's
+# recovery advantage (continue_ttr / restart_ttr) across snapshots — the
+# guard that keeps "zero-replay continuation is actually faster than
+# restart-from-checkpoint" from silently rotting
+ELASTIC_TRIPWIRE_RATIO = 1.2
+
 # sampled-config round time: flag >20% regressions of the subsample=0.5
 # ablation arm across snapshots — the guard that keeps "subsample is
 # actually cheaper" from silently rotting back into zeroed-gh full-row cost
@@ -264,6 +270,56 @@ def chaos_recovery_tripwire(current_chaos, prev_rec, prev_name=None,
             f"{prev_name or 'BENCH_*.json'}) — >{(threshold - 1) * 100:.0f}% "
             f"regression. Investigate the recovery path before trusting "
             f"this build's fault tolerance.",
+            file=sys.stderr,
+        )
+    return out
+
+
+def elastic_recovery_tripwire(current_chaos, prev_rec, prev_name=None,
+                              backend=None, threshold=ELASTIC_TRIPWIRE_RATIO):
+    """Compare this run's continue-vs-restart recovery ratio against the
+    newest recorded bench.
+
+    The elastic-continuation analog of ``chaos_recovery_tripwire``: the
+    tracked figure is ``continue_vs_restart.ratio`` (elastic in-flight
+    recovery time over restart-from-checkpoint recovery time — smaller is
+    better, < 1 means continuation keeps its edge). Returns
+    ``{prev_ratio, prev_record, ratio, fired}`` or None when no comparable
+    record exists (different backend, no recorded pairing). Like-for-like
+    only: a different chaos config is reported with ``config_mismatch`` set
+    and never fires."""
+    if not isinstance(current_chaos, dict):
+        return None
+    cur = (current_chaos.get("continue_vs_restart") or {}).get("ratio")
+    if not cur or not isinstance(prev_rec, dict):
+        return None
+    if backend and prev_rec.get("backend") and prev_rec["backend"] != backend:
+        return None
+    prev_chaos = prev_rec.get("chaos")
+    if not isinstance(prev_chaos, dict):
+        return None
+    prev = (prev_chaos.get("continue_vs_restart") or {}).get("ratio")
+    if not prev:
+        return None
+    ratio = float(cur) / float(prev)
+    out = {
+        "prev_ratio": round(float(prev), 4),
+        "prev_record": prev_name,
+        "ratio": round(ratio, 3),
+        "fired": False,
+    }
+    if prev_chaos.get("config") != current_chaos.get("config"):
+        out["config_mismatch"] = True
+        return out
+    if ratio > threshold:
+        out["fired"] = True
+        print(
+            f"[bench] ELASTIC TRIPWIRE: continue-vs-restart recovery ratio "
+            f"{cur:.3f} is {ratio:.2f}x the newest recorded run "
+            f"({prev:.3f} in {prev_name or 'BENCH_*.json'}) — "
+            f">{(threshold - 1) * 100:.0f}% regression of the zero-replay "
+            f"continuation's advantage. Investigate the in-flight recovery "
+            f"path before trusting this build's elastic training.",
             file=sys.stderr,
         )
     return out
@@ -755,6 +811,78 @@ def run_chaos_measurement():
             "straggle_s": straggle_s, "max_depth": 6,
         },
     }
+
+    # paired restart-vs-continue: the SAME kill schedule once more, now with
+    # elastic in-flight continuation (immediate reintegration: resource
+    # check + grace period zeroed) — recovery must be strictly faster than
+    # the restart-from-checkpoint policy measured above, with ZERO rounds
+    # replayed; the final model stays within the soak tolerance of the
+    # uninterrupted run (the kill fires before the round's step, so no
+    # survivor-world round is ever boosted).
+    if actors >= 2:
+        cont_plan = faults.FaultPlan(rules=[
+            {"site": "actor.train_round", "action": "raise",
+             "match": {"round": kill_round}, "ranks": [actors - 1],
+             "message": "chaos: scheduled rank kill"},
+            {"site": "actor.train_round", "action": "delay",
+             "match": {"round": straggle_round}, "delay_s": straggle_s},
+        ])
+        saved_env = {}
+        for k in ("RXGB_ELASTIC_RESTART_RESOURCE_CHECK_S",
+                  "RXGB_ELASTIC_RESTART_GRACE_PERIOD_S"):
+            saved_env[k] = os.environ.get(k)
+            os.environ[k] = "0"
+        res_cont = {}
+        try:
+            with faults.active_plan(cont_plan):
+                bst_cont = train(
+                    params, RayDMatrix(x, y), rounds,
+                    additional_results=res_cont,
+                    ray_params=RayParams(
+                        num_actors=actors, checkpoint_frequency=2,
+                        elastic_training=True,
+                        max_failed_actors=actors - 1,
+                        max_actor_restarts=2,
+                    ),
+                )
+        finally:
+            for k, v in saved_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        rob_c = res_cont.get("robustness", {})
+        cont_ttr = rob_c.get("time_to_recover_s", 0.0)
+        restart_ttr = section["time_to_recover_s"]
+        cont_matches = bool(np.allclose(
+            bst_cont.predict(x, output_margin=True), ref_margin, atol=1e-5
+        ))
+        section["elastic"] = {
+            "time_to_recover_s": cont_ttr,
+            "rounds_replayed": rob_c.get("rounds_replayed", 0),
+            "restarts": rob_c.get("restarts", 0),
+            "shrinks": rob_c.get("shrinks", 0),
+            "grows": rob_c.get("grows", 0),
+            "orphaned_rows": rob_c.get("orphaned_rows", 0),
+            "recompile_s": rob_c.get("recompile_s", 0.0),
+            "model_matches": cont_matches,  # vs uninterrupted, atol=1e-5
+        }
+        if restart_ttr and cont_ttr:
+            ratio = round(cont_ttr / restart_ttr, 4)
+            section["continue_vs_restart"] = {
+                "restart_time_to_recover_s": restart_ttr,
+                "continue_time_to_recover_s": cont_ttr,
+                "ratio": ratio,
+                "continue_faster": ratio < 1.0,
+            }
+            if ratio >= 1.0:
+                print(
+                    f"[bench] WARNING: elastic continuation recovered in "
+                    f"{cont_ttr:.2f}s, NOT faster than the "
+                    f"restart-from-checkpoint policy ({restart_ttr:.2f}s) — "
+                    f"the zero-replay path has lost its edge.",
+                    file=sys.stderr,
+                )
     print(f"[bench] chaos section: {section}", file=sys.stderr)
     return section
 
@@ -1160,6 +1288,11 @@ def run_measurement():
         )
         if ctrip is not None:
             chaos_section["regression_tripwire"] = ctrip
+        etrip = elastic_recovery_tripwire(
+            chaos_section, prev_rec, prev_name, backend=backend
+        )
+        if etrip is not None:
+            chaos_section["elastic_regression_tripwire"] = etrip
         detail["chaos"] = chaos_section
 
     # normalize to the full protocol (11M rows x 100 rounds) when a smaller
@@ -1301,7 +1434,21 @@ def chaos_only_main():
                                    backend=backend)
     if trip is not None:
         section["regression_tripwire"] = trip
+    etrip = elastic_recovery_tripwire(section, prev_rec, prev_name,
+                                      backend=backend)
+    if etrip is not None:
+        section["elastic_regression_tripwire"] = etrip
     ok = section["model_matches"] and section["ckpt_resume_matches"]
+    elastic_sec = section.get("elastic")
+    if elastic_sec is not None:
+        # the elastic continuation must replay nothing, reproduce the
+        # uninterrupted model, and recover strictly faster than the
+        # restart-from-checkpoint policy
+        ok = ok and elastic_sec["model_matches"]
+        ok = ok and elastic_sec["rounds_replayed"] == 0
+        cvr = section.get("continue_vs_restart")
+        if cvr is not None:
+            ok = ok and cvr["continue_faster"]
     print(
         json.dumps(
             {

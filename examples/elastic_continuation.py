@@ -1,0 +1,60 @@
+"""Elastic continuation smoke: a mid-training kill absorbed in-flight.
+
+Trains a small model with ``elastic_training=True`` and immediate
+reintegration (resource check + grace period at zero). With a fault plan
+installed — programmatically here, or via the ``RXGB_FAULT_PLAN`` env var
+(the CI smoke injects a kill that way) — the scheduled rank death is
+absorbed WITHOUT restarting the attempt: training continues from the
+in-memory booster with zero rounds replayed, and the killed rank is
+reintegrated before the next round starts.
+
+Run directly:        python examples/elastic_continuation.py
+CI smoke (kill + reintegrate via env):
+    RXGB_FAULT_PLAN='{"rules": [{"site": "actor.train_round",
+        "action": "raise", "ranks": [1], "match": {"round": 3}}]}' \
+    python examples/elastic_continuation.py
+"""
+
+import os
+
+import numpy as np
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+
+def main():
+    os.environ.setdefault("RXGB_ELASTIC_RESTART_RESOURCE_CHECK_S", "0")
+    os.environ.setdefault("RXGB_ELASTIC_RESTART_GRACE_PERIOD_S", "0")
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(2048, 8).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float32)
+
+    res = {}
+    bst = train(
+        {"objective": "binary:logistic", "eval_metric": ["logloss"],
+         "max_depth": 4},
+        RayDMatrix(x, y),
+        8,
+        additional_results=res,
+        ray_params=RayParams(num_actors=2, elastic_training=True,
+                             max_failed_actors=1, max_actor_restarts=2,
+                             checkpoint_frequency=2),
+    )
+    rob = res["robustness"]
+    print(f"model rounds: {bst.num_boosted_rounds()}")
+    print(f"robustness:   {rob}")
+
+    assert bst.num_boosted_rounds() == 8
+    if os.environ.get("RXGB_FAULT_PLAN"):
+        # the CI smoke's kill must be absorbed in-flight: nothing replayed,
+        # no attempt restart, the rank reintegrated (grow) before the end
+        assert rob["rounds_replayed"] == 0, rob
+        assert rob["restarts"] == 0, rob
+        assert rob["shrinks"] + rob["grows"] >= 1, rob
+        assert res["total_n"] == len(x), res["total_n"]
+        print("elastic continuation smoke OK (zero replay, world restored)")
+
+
+if __name__ == "__main__":
+    main()

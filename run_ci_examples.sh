@@ -19,7 +19,7 @@ for ex in readme.py readme_sklearn_api.py simple.py simple_predict.py \
           simple_dask.py simple_modin.py simple_ray_dataset.py \
           simple_categorical.py simple_remote.py \
           simple_gblinear.py simple_constraints.py \
-          simple_serve.py \
+          simple_serve.py elastic_continuation.py \
           custom_objective_metric.py; do
   echo "================= Running $ex ================="
   python "$ex"

@@ -11,6 +11,7 @@ python -m pytest tests/test_bench_tripwire.py -v -x
 python -m pytest tests/test_end_to_end.py -v -x
 python -m pytest tests/test_fault_tolerance.py -v -x
 python -m pytest tests/test_faults.py -v -x
+python -m pytest tests/test_elastic_continuation.py -v -x -m 'not slow'
 python -m pytest tests/test_xgboost_api.py -v -x
 python -m pytest tests/test_tune.py -v -x
 python -m pytest tests/test_sklearn.py -v -x
@@ -18,3 +19,7 @@ echo "================= Running smoke benchmark ================="
 python tests/release/benchmark_tpu.py 2 10 8 --smoke-test
 echo "================= Running chaos smoke (bench --chaos) ================="
 BENCH_CHAOS_ROWS=2000 BENCH_CHAOS_ROUNDS=6 python bench.py --chaos
+echo "========= Running elastic-continuation chaos smoke (kill + reintegrate) ========="
+PYTHONPATH=".:$PYTHONPATH" \
+RXGB_FAULT_PLAN='{"rules": [{"site": "actor.train_round", "action": "raise", "ranks": [1], "match": {"round": 3}}]}' \
+    python examples/elastic_continuation.py

@@ -33,8 +33,8 @@ def train_worker(ctx, data_path):
 
     from xgboost_ray_tpu.engine import TpuEngine
     from xgboost_ray_tpu.launcher import (
+        AsyncCheckpointWriter,
         load_round_checkpoint,
-        save_round_checkpoint,
     )
     from xgboost_ray_tpu.matrix import RayShardingMode, _get_sharding_indices
     from xgboost_ray_tpu.params import parse_params
@@ -63,23 +63,27 @@ def train_worker(ctx, data_path):
     eng = TpuEngine(shards, params, num_actors=num_actors,
                     evals=[(shards, "train")], init_booster=booster)
 
-    for i in range(rounds - done):
-        if (ctx.process_id == 1 and ctx.attempt == 0
-                and done + i == kill_round):
-            # REAL process death, mid-training, no cleanup
-            os.kill(os.getpid(), signal.SIGKILL)
-        # watchdog: a step blocking >180 s means the peer death was NOT
-        # surfaced by the coordination service — exit distinctly
-        timer = threading.Timer(180.0, lambda: os._exit(3))
-        timer.daemon = True
-        timer.start()
-        try:
-            eng.step(i)
-        finally:
-            timer.cancel()
-        ctx.heartbeat()  # per-round liveness for the launcher watchdog
-        if ctx.process_id == 0 and ctx.checkpoint_path:
-            save_round_checkpoint(
-                eng.get_booster(), ctx.checkpoint_path, done + i
-            )
+    # background checkpoint writer: serialization + fsync'd commit overlap
+    # the next rounds; the context manager joins the final write (and
+    # surfaces any write error) before the worker returns
+    with AsyncCheckpointWriter() as ckpt_writer:
+        for i in range(rounds - done):
+            if (ctx.process_id == 1 and ctx.attempt == 0
+                    and done + i == kill_round):
+                # REAL process death, mid-training, no cleanup
+                os.kill(os.getpid(), signal.SIGKILL)
+            # watchdog: a step blocking >180 s means the peer death was NOT
+            # surfaced by the coordination service — exit distinctly
+            timer = threading.Timer(180.0, lambda: os._exit(3))
+            timer.daemon = True
+            timer.start()
+            try:
+                eng.step(i)
+            finally:
+                timer.cancel()
+            ctx.heartbeat()  # per-round liveness for the launcher watchdog
+            if ctx.process_id == 0 and ctx.checkpoint_path:
+                ckpt_writer.submit(
+                    eng.get_booster(), ctx.checkpoint_path, done + i
+                )
     return eng.get_booster().predict(x, output_margin=True)

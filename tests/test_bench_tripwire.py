@@ -164,6 +164,72 @@ def test_chaos_tripwire_skips_incomparable_records():
     assert bench.chaos_recovery_tripwire({}, rec_tpu, "x") is None
 
 
+def _elastic_chaos_section(ratio, cfg=None):
+    sec = _chaos_section(10.0, cfg)
+    sec["elastic"] = {"time_to_recover_s": 10.0 * ratio,
+                      "rounds_replayed": 0, "shrinks": 0, "grows": 1}
+    sec["continue_vs_restart"] = {
+        "restart_time_to_recover_s": 10.0,
+        "continue_time_to_recover_s": round(10.0 * ratio, 4),
+        "ratio": ratio,
+        "continue_faster": ratio < 1.0,
+    }
+    return sec
+
+
+def test_elastic_tripwire_fires_on_ratio_regression(capsys):
+    """The continuation's recovery advantage (continue/restart) regressing
+    >20% across snapshots must fire — 0.2 -> 0.3 means in-flight recovery
+    got 50% relatively slower even if absolute times moved little."""
+    rec = {"metric": "m", "backend": "cpu",
+           "chaos": _elastic_chaos_section(0.2)}
+    out = bench.elastic_recovery_tripwire(
+        _elastic_chaos_section(0.3), rec, "BENCH_r07.json", backend="cpu"
+    )
+    assert out is not None and out["fired"]
+    assert out["ratio"] == 1.5
+    assert out["prev_ratio"] == 0.2
+    assert "ELASTIC TRIPWIRE" in capsys.readouterr().err
+
+
+def test_elastic_tripwire_quiet_within_20pct(capsys):
+    rec = {"metric": "m", "backend": "cpu",
+           "chaos": _elastic_chaos_section(0.2)}
+    out = bench.elastic_recovery_tripwire(
+        _elastic_chaos_section(0.22), rec, "x", backend="cpu"
+    )
+    assert out is not None and not out["fired"]
+    assert "ELASTIC TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_elastic_tripwire_reports_but_never_fires_on_config_mismatch(capsys):
+    other = dict(_CHAOS_CFG, rounds=6)
+    rec = {"metric": "m", "backend": "cpu",
+           "chaos": _elastic_chaos_section(0.2, other)}
+    out = bench.elastic_recovery_tripwire(
+        _elastic_chaos_section(0.9), rec, "x", backend="cpu"
+    )
+    assert out is not None and not out["fired"]
+    assert out["config_mismatch"] is True
+    assert "ELASTIC TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_elastic_tripwire_skips_incomparable_records():
+    cur = _elastic_chaos_section(0.5)
+    rec_tpu = {"metric": "m", "backend": "tpu",
+               "chaos": _elastic_chaos_section(0.2)}
+    assert bench.elastic_recovery_tripwire(cur, rec_tpu, "x",
+                                           backend="cpu") is None
+    # pre-pairing-era chaos section (no continue_vs_restart block)
+    rec_old = {"metric": "m", "backend": "cpu", "chaos": _chaos_section(10.0)}
+    assert bench.elastic_recovery_tripwire(cur, rec_old, "x",
+                                           backend="cpu") is None
+    assert bench.elastic_recovery_tripwire(_chaos_section(10.0), rec_tpu,
+                                           "x") is None
+    assert bench.elastic_recovery_tripwire(None, rec_tpu, "x") is None
+    assert bench.elastic_recovery_tripwire({}, rec_tpu, "x") is None
+
+
 _SAMP_CFG = {"rows": 200000, "features": 28, "rounds": 20, "actors": 8,
              "max_depth": 6, "subsample_rate": 0.5, "goss_top_rate": 0.1,
              "goss_other_rate": 0.1}

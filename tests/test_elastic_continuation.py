@@ -1,0 +1,314 @@
+"""Zero-replay elastic continuation (in-flight shrink/grow) tests.
+
+The tentpole guarantee: with ``elastic_training=True``, a mid-attempt actor
+death does NOT raise out of the round loop and restart from the last
+checkpoint — the driver shrinks the world in place (survivor mesh,
+continue boosting from the in-memory booster, ``rounds_replayed == 0``) and
+reintegrates the recovered rank at a round boundary (grow). When every dead
+rank's replacement is staged before the next round starts, the world never
+actually shrinks and continuation is BITWISE identical to an uninterrupted
+run. Every scenario here is driven by a deterministic ``FaultPlan`` — no
+sleep-and-kill races.
+"""
+
+import numpy as np
+import pytest
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, faults, train
+from xgboost_ray_tpu.matrix import RayShardingMode, _get_sharding_indices
+
+_PARAMS = {"objective": "binary:logistic", "eval_metric": ["logloss"],
+           "max_depth": 3}
+
+
+def _data(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    return x, y
+
+
+@pytest.fixture(autouse=True)
+def _fast_restarts(monkeypatch):
+    monkeypatch.setenv("RXGB_RESTART_BACKOFF_BASE_S", "0")
+    yield
+    faults.clear_plan()
+
+
+def _noop_plan():
+    """Targets actor.train_round without ever firing — forces the per-round
+    path so model-identity checks never compare a fused-scan forest to a
+    per-round one."""
+    return faults.FaultPlan(rules=[{
+        "site": "actor.train_round", "action": "raise",
+        "match": {"round": -1},
+    }])
+
+
+def _kill_plan(round_, ranks):
+    return faults.FaultPlan(rules=[{
+        "site": "actor.train_round", "action": "raise", "ranks": list(ranks),
+        "match": {"round": round_},
+    }])
+
+
+def test_shrink_continues_with_zero_replay_and_survivor_parity(monkeypatch):
+    """The acceptance scenario: a mid-attempt kill with reintegration
+    disabled shrinks the attempt in place — zero rounds replayed, no
+    restart — and the final model matches the survivor-world reference
+    (full world for k rounds, then the survivor's shard alone) well inside
+    the 1e-4 metric bound. The loss curve spans the shrink without a gap."""
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_DISABLED", "1")
+    x, y = _data()
+    n, rounds, k = len(x), 10, 5
+
+    res, evals_result = {}, {}
+    dtrain = RayDMatrix(x, y)
+    with faults.active_plan(_kill_plan(k, [1])):
+        bst = train(_PARAMS, dtrain, rounds,
+                    evals=[(dtrain, "train")], evals_result=evals_result,
+                    additional_results=res,
+                    ray_params=RayParams(num_actors=2, elastic_training=True,
+                                         max_failed_actors=1,
+                                         max_actor_restarts=2,
+                                         checkpoint_frequency=2))
+    assert bst.num_boosted_rounds() == rounds
+    rob = res["robustness"]
+    assert rob["rounds_replayed"] == 0
+    assert rob["restarts"] == 0
+    assert rob["elastic_restarts"] == 0
+    assert rob["shrinks"] == 1
+    assert rob["grows"] == 0
+    assert rob["orphaned_rows"] == n // 2  # rank 1's shard was dropped
+    assert rob["recompile_s"] > 0  # the one survivor-mesh rebuild
+    assert rob["time_to_recover_s"] > 0
+    assert res["total_n"] == n // 2
+    # the survivor-world loss curve continues in place: one value per round
+    assert len(evals_result["train"]["logloss"]) == rounds
+
+    # survivor-world reference: k rounds on the full world, then the
+    # remaining rounds warm-started on rank 0's shard alone — exactly what
+    # the shrunk world boosts on
+    with faults.active_plan(_noop_plan()):
+        head = train(_PARAMS, RayDMatrix(x, y), k,
+                     ray_params=RayParams(num_actors=2))
+    idx0 = _get_sharding_indices(RayShardingMode.INTERLEAVED, 0, 2, n)
+    with faults.active_plan(_noop_plan()):
+        ref = train(_PARAMS, RayDMatrix(x[idx0], y[idx0]), rounds - k,
+                    xgb_model=head, ray_params=RayParams(num_actors=1))
+    np.testing.assert_allclose(
+        bst.predict(x, output_margin=True),
+        ref.predict(x, output_margin=True),
+        atol=1e-5,
+    )
+
+
+def test_immediate_growback_is_bitwise_identical(monkeypatch):
+    """Kill + immediate reintegration (resource check and grace period at
+    zero): the replacement rank is staged before the next round starts, the
+    world never shrinks, continuation reuses the SAME compiled engine — and
+    the final model is BITWISE identical to the uninterrupted run at the
+    matched data assignment."""
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_RESOURCE_CHECK_S", "0")
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_GRACE_PERIOD_S", "0")
+    x, y = _data()
+    with faults.active_plan(_noop_plan()):
+        ref = train(_PARAMS, RayDMatrix(x, y), 10,
+                    ray_params=RayParams(num_actors=2,
+                                         checkpoint_frequency=3))
+    res = {}
+    with faults.active_plan(_kill_plan(4, [0])):
+        bst = train(_PARAMS, RayDMatrix(x, y), 10, additional_results=res,
+                    ray_params=RayParams(num_actors=2, elastic_training=True,
+                                         max_failed_actors=1,
+                                         max_actor_restarts=2,
+                                         checkpoint_frequency=3))
+    rob = res["robustness"]
+    assert rob["rounds_replayed"] == 0
+    assert rob["restarts"] == 0
+    assert rob["elastic_restarts"] == 0
+    assert rob["grows"] == 1
+    assert rob["shrinks"] == 0
+    assert rob["orphaned_rows"] == 0
+    assert res["total_n"] == len(x)
+    assert np.array_equal(
+        bst.predict(x, output_margin=True),
+        ref.predict(x, output_margin=True),
+    ), "grow-back continuation must be bitwise identical"
+
+
+def test_shrink_run_is_deterministic(monkeypatch):
+    """Chaos-vs-chaos: two runs of the same kill plan produce bitwise
+    identical models and identical robustness counters (minus wall-clock
+    fields) — the reproducibility contract of the fault layer, preserved
+    through the in-flight shrink."""
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_DISABLED", "1")
+    x, y = _data()
+    outs, robs = [], []
+    for _ in range(2):
+        res = {}
+        with faults.active_plan(_kill_plan(3, [1])):
+            bst = train(_PARAMS, RayDMatrix(x, y), 8, additional_results=res,
+                        ray_params=RayParams(num_actors=2,
+                                             elastic_training=True,
+                                             max_failed_actors=1,
+                                             max_actor_restarts=2,
+                                             checkpoint_frequency=2))
+        outs.append(bst.predict(x, output_margin=True))
+        robs.append({k: v for k, v in res["robustness"].items()
+                     if not k.endswith("_s")})
+    assert np.array_equal(outs[0], outs[1])
+    assert robs[0] == robs[1] == {
+        "restarts": 0, "elastic_restarts": 0, "rounds_replayed": 0,
+        "shrinks": 1, "grows": 0, "orphaned_rows": len(x) // 2,
+    }
+
+
+def test_shrink_then_boundary_growback(monkeypatch):
+    """Shrink first (the replacement's reload is held past the scheduler's
+    1 s fast path by a deterministic delay), then grow back in place at a
+    round boundary once the background load finishes — still zero replay,
+    no restart, and the full world's rows are restored by the end."""
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_RESOURCE_CHECK_S", "0")
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_GRACE_PERIOD_S", "0")
+    x, y = _data(512)
+    plan = faults.FaultPlan(rules=[
+        {"site": "actor.train_round", "action": "raise", "ranks": [1],
+         "match": {"round": 3}},
+        # hold rank 1's RELOAD (its 2nd load) past the scheduling fast path
+        # so the failure handler cannot reintegrate immediately and must
+        # shrink; the load finishes in the background and the grow happens
+        # at a later boundary (the shrunk world's first rounds include a
+        # fresh XLA compile, which dwarfs this delay)
+        {"site": "actor.load_shard", "action": "delay", "delay_s": 2.0,
+         "match": {"rank": 1}, "at": 2},
+    ])
+    res = {}
+    with faults.active_plan(plan):
+        bst = train(_PARAMS, RayDMatrix(x, y), 16, additional_results=res,
+                    ray_params=RayParams(num_actors=2, elastic_training=True,
+                                         max_failed_actors=1,
+                                         max_actor_restarts=2,
+                                         checkpoint_frequency=4))
+    assert bst.num_boosted_rounds() == 16
+    rob = res["robustness"]
+    assert rob["rounds_replayed"] == 0
+    assert rob["restarts"] == 0
+    assert rob["elastic_restarts"] == 0
+    assert rob["shrinks"] == 1
+    assert rob["grows"] == 1
+    assert res["total_n"] == 512  # the boundary grow restored the world
+
+
+def test_elastic_continuation_soak(monkeypatch):
+    """Long soak: two kills of different ranks (each reintegrated
+    immediately) plus a straggler over 24 rounds — zero replay throughout,
+    no restarts, and the whole chaotic run is bitwise reproducible."""
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_RESOURCE_CHECK_S", "0")
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_GRACE_PERIOD_S", "0")
+    x, y = _data(512, seed=7)
+
+    def run():
+        plan = faults.FaultPlan(rules=[
+            {"site": "actor.train_round", "action": "raise", "ranks": [1],
+             "match": {"round": 5}},
+            {"site": "actor.train_round", "action": "raise", "ranks": [0],
+             "match": {"round": 14}},
+            {"site": "actor.train_round", "action": "delay",
+             "delay_s": 0.05, "match": {"round": 18}},
+        ])
+        res = {}
+        with faults.active_plan(plan):
+            bst = train(_PARAMS, RayDMatrix(x, y), 24, additional_results=res,
+                        ray_params=RayParams(num_actors=2,
+                                             elastic_training=True,
+                                             max_failed_actors=1,
+                                             max_actor_restarts=4,
+                                             checkpoint_frequency=4))
+        return bst.predict(x, output_margin=True), res["robustness"]
+
+    m1, rob1 = run()
+    m2, rob2 = run()
+    assert rob1["rounds_replayed"] == 0
+    assert rob1["restarts"] == 0
+    assert rob1["grows"] == 2
+    assert rob1["shrinks"] == 0
+    assert np.array_equal(m1, m2)
+    assert ({k: v for k, v in rob1.items() if not k.endswith("_s")}
+            == {k: v for k, v in rob2.items() if not k.endswith("_s")})
+
+
+def test_transient_blameless_failure_resumes_without_phantom_shrink(monkeypatch):
+    """A failure that blames no worker (liveness probe finds everyone
+    healthy) must resume on the unchanged world — bitwise, zero replay —
+    and must NOT report a phantom shrink/grow in the operator-facing
+    robustness block."""
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_DISABLED", "1")
+    x, y = _data(128)
+    with faults.active_plan(_noop_plan()):
+        ref = train(_PARAMS, RayDMatrix(x, y), 6,
+                    ray_params=RayParams(num_actors=2,
+                                         checkpoint_frequency=2))
+    plan = faults.FaultPlan(rules=[{
+        "site": "actor.train_round", "action": "raise",
+        "exc": "RayTaskError", "match": {"round": 2}}])
+    res = {}
+    with faults.active_plan(plan):
+        bst = train(_PARAMS, RayDMatrix(x, y), 6, additional_results=res,
+                    ray_params=RayParams(num_actors=2, elastic_training=True,
+                                         max_failed_actors=1,
+                                         max_actor_restarts=2,
+                                         checkpoint_frequency=2))
+    assert bst.num_boosted_rounds() == 6
+    rob = res["robustness"]
+    assert rob["shrinks"] == 0 and rob["grows"] == 0
+    assert rob["restarts"] == 0 and rob["rounds_replayed"] == 0
+    assert rob["orphaned_rows"] == 0
+    assert res["total_n"] == len(x)
+    assert np.array_equal(
+        bst.predict(x, output_margin=True),
+        ref.predict(x, output_margin=True),
+    )
+
+
+def test_too_many_dead_still_aborts_in_flight(monkeypatch):
+    """The three-way policy's abort arm survives the tentpole: when a
+    second rank dies past max_failed_actors, the in-flight path refuses and
+    the driver aborts with the reference's error."""
+    from xgboost_ray_tpu.exceptions import RayXGBoostTrainingError
+
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_DISABLED", "1")
+    x, y = _data()
+    plan = faults.FaultPlan(rules=[
+        {"site": "actor.train_round", "action": "raise", "ranks": [0],
+         "match": {"round": 2}},
+        {"site": "actor.train_round", "action": "raise", "ranks": [1],
+         "match": {"round": 5}},
+    ])
+    with faults.active_plan(plan):
+        with pytest.raises(RayXGBoostTrainingError, match="too many"):
+            train(_PARAMS, RayDMatrix(x, y), 10,
+                  ray_params=RayParams(num_actors=2, elastic_training=True,
+                                       max_failed_actors=1,
+                                       max_actor_restarts=3,
+                                       checkpoint_frequency=2))
+
+
+def test_dart_elastic_falls_back_to_restart(monkeypatch):
+    """dart cannot re-shard mid-flight (capacity-padded device forest) —
+    an elastic kill must fall back to the legacy restart-from-checkpoint
+    continuation instead of failing."""
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_DISABLED", "1")
+    x, y = _data(128)
+    params = dict(_PARAMS, booster="dart", rate_drop=0.1)
+    res = {}
+    with faults.active_plan(_kill_plan(3, [1])):
+        bst = train(params, RayDMatrix(x, y), 6, additional_results=res,
+                    ray_params=RayParams(num_actors=2, elastic_training=True,
+                                         max_failed_actors=1,
+                                         max_actor_restarts=2,
+                                         checkpoint_frequency=2))
+    assert bst.num_boosted_rounds() == 6
+    rob = res["robustness"]
+    assert rob["shrinks"] == 0 and rob["grows"] == 0
+    assert rob["restarts"] == 1  # legacy elastic restart path took over

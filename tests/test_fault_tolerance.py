@@ -230,6 +230,63 @@ def test_elastic_scheduler_grace_period_then_restart(monkeypatch):
         elastic._update_scheduled_actor_states(state)
 
 
+def test_elastic_grace_clock_disarms_when_ready_pending_lost(monkeypatch):
+    """Satellite regression: after the grace clock arms, losing every ready
+    pending worker (dropped for a load error) must DISARM the clock — the
+    next ready worker earns a fresh grace period instead of triggering
+    reintegration instantly off the stale expired clock."""
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_RESOURCE_CHECK_S", "0")
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_GRACE_PERIOD_S", "9999")
+    state = _fake_state(dead=(2,))
+    rp = RayParams(num_actors=4, elastic_training=True, max_failed_actors=1,
+                   max_actor_restarts=1)
+    elastic._maybe_schedule_new_actors(
+        training_state=state, num_cpus_per_actor=1, num_gpus_per_actor=0,
+        resources_per_actor=None, ray_params=rp, load_data=[_NoLoadMatrix()],
+    )
+    assert elastic._update_scheduled_actor_states(state) is False  # arms
+    assert state.restart_training_at is not None
+    # the armed worker is lost to a (late) load error and gets dropped
+    state.pending_actors[2].error = RuntimeError("load failed")
+    state.pending_actors[2].ready_at = None
+    assert elastic._update_scheduled_actor_states(state) is False
+    assert state.restart_training_at is None  # clock disarmed
+    # a fresh ready worker arms a FRESH grace period; with the long grace
+    # above it must NOT be due immediately
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_GRACE_PERIOD_S", "0")
+    state.last_resource_check_at = 0.0
+    elastic._maybe_schedule_new_actors(
+        training_state=state, num_cpus_per_actor=1, num_gpus_per_actor=0,
+        resources_per_actor=None, ray_params=rp, load_data=[_NoLoadMatrix()],
+    )
+    assert elastic._update_scheduled_actor_states(state) is False  # re-arms
+    with pytest.raises(RayXGBoostActorAvailable):
+        elastic._update_scheduled_actor_states(state)
+
+
+def test_elastic_update_returns_instead_of_raising(monkeypatch):
+    """``raise_on_ready=False`` (the driver's in-flight grow mode) returns
+    True when reintegration is due instead of raising the legacy
+    restart-from-checkpoint exception."""
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_RESOURCE_CHECK_S", "0")
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_GRACE_PERIOD_S", "0")
+    state = _fake_state(dead=(2,))
+    rp = RayParams(num_actors=4, elastic_training=True, max_failed_actors=1,
+                   max_actor_restarts=1)
+    elastic._maybe_schedule_new_actors(
+        training_state=state, num_cpus_per_actor=1, num_gpus_per_actor=0,
+        resources_per_actor=None, ray_params=rp, load_data=[_NoLoadMatrix()],
+    )
+    assert elastic._update_scheduled_actor_states(
+        state, raise_on_ready=False) is False  # arms
+    assert elastic._update_scheduled_actor_states(
+        state, raise_on_ready=False) is True
+    # the due signal consumed the clock; nothing pending-ready changed, so
+    # the next call re-arms rather than firing again
+    assert elastic._update_scheduled_actor_states(
+        state, raise_on_ready=False) is False
+
+
 def test_get_actor_alive_status():
     state = _fake_state(dead=(0,))
     state.actors[1].kill()

@@ -41,6 +41,7 @@ from xgboost_ray_tpu.models.booster import Booster, RayXGBoostBooster
 from xgboost_ray_tpu.callback import DistributedCallback, TrainingCallback
 from xgboost_ray_tpu import faults
 from xgboost_ray_tpu.launcher import (
+    AsyncCheckpointWriter,
     LaunchContext,
     LaunchResult,
     launch_distributed,
@@ -73,6 +74,7 @@ __all__ = [
     "launch_distributed",
     "load_round_checkpoint",
     "save_round_checkpoint",
+    "AsyncCheckpointWriter",
 ]
 
 try:

@@ -122,17 +122,28 @@ def _maybe_schedule_new_actors(
     return scheduled
 
 
-def _update_scheduled_actor_states(training_state):
-    """Promote ready pending workers; after the grace period force a restart
-    from checkpoint by raising RayXGBoostActorAvailable (elastic.py:98-142).
+def _update_scheduled_actor_states(training_state, raise_on_ready: bool = True):
+    """Reintegration state machine for pending workers (elastic.py:98-142).
+
+    Returns True when reintegration is due: the grace period has expired
+    with at least one READY pending worker. With ``raise_on_ready`` (the
+    legacy restart-from-checkpoint mode, kept for engines that cannot
+    re-shard in place) a due reintegration raises
+    ``RayXGBoostActorAvailable`` instead of returning; the driver's
+    in-flight grow path passes ``raise_on_ready=False`` and re-shards the
+    running world at the round boundary — zero rounds replayed.
 
     Workers whose background data load failed are dropped (and re-tried on
-    the next resource check); the grace clock only arms once at least one
-    pending worker has FINISHED loading."""
+    the next resource check). The grace clock only arms once at least one
+    pending worker has FINISHED loading, and is DISARMED again whenever no
+    ready pending worker remains (e.g. every pending worker was dropped for
+    load errors after the clock armed) — the next ready worker must earn a
+    fresh grace period, not inherit a stale expired one."""
     from xgboost_ray_tpu.main import ENV
 
     if not training_state.pending_actors:
-        return
+        training_state.restart_training_at = None
+        return False
     for rank, pending in list(training_state.pending_actors.items()):
         if pending.error is not None:
             logger.warning(
@@ -141,19 +152,23 @@ def _update_scheduled_actor_states(training_state):
             )
             del training_state.pending_actors[rank]
     if not any(p.ready for p in training_state.pending_actors.values()):
-        return
+        training_state.restart_training_at = None
+        return False
     now = time.time()
     if training_state.restart_training_at is None:
         training_state.restart_training_at = now + float(
             ENV.ELASTIC_RESTART_GRACE_PERIOD_S
         )
-        return
+        return False
     if now >= training_state.restart_training_at:
         training_state.restart_training_at = None
-        raise RayXGBoostActorAvailable(
-            "A new worker became available for training. Restarting from the "
-            "latest checkpoint with the restored world size."
-        )
+        if raise_on_ready:
+            raise RayXGBoostActorAvailable(
+                "A new worker became available for training. Restarting from "
+                "the latest checkpoint with the restored world size."
+            )
+        return True
+    return False
 
 
 def _get_actor_alive_status(actors: List, callback) -> int:

@@ -1367,6 +1367,106 @@ class TpuEngine:
         booster.categories = self.categories
         return booster
 
+    # ------------------------------------------------------------------
+    # In-flight elastic continuation (zero-replay shrink/grow): the driver
+    # swaps worlds mid-attempt without restarting from a checkpoint. A
+    # cached engine for a previously-seen world signature is revived via
+    # ``reset_from_booster`` — its compiled step programs, sketch cuts and
+    # binned device matrix are reused, so growing back to a known world
+    # costs one host forest walk instead of a retrace + re-sketch.
+    # ------------------------------------------------------------------
+
+    def can_reshard(self) -> bool:
+        """Whether this engine supports the zero-replay re-shard path.
+
+        dart keeps a capacity-padded device forest sized to the ORIGINAL
+        total_rounds and recomputes margins from tree weights each round;
+        resetting that mid-flight is not supported — the driver falls back
+        to the restart-from-checkpoint path instead."""
+        return not self.dart
+
+    def reset_from_booster(self, shards, evals, init_booster) -> None:
+        """Re-shard entry point: reuse this engine (compiled step programs,
+        binned device matrix, sketch cuts, eval-set device state) for a
+        continuation segment starting from ``init_booster``.
+
+        The caller guarantees ``shards``/``evals`` hold the SAME rows this
+        engine was built over (``shard_layout_fingerprint`` at the driver's
+        world cache; shapes re-checked here) — the device-resident data
+        never moves, only the margin state and forest bookkeeping are
+        re-derived from the booster. Cost: one host forest walk per data
+        set. No retrace, no re-bin, no re-sketch.
+        """
+        if self.dart:
+            raise ValueError("reset_from_booster is not supported with dart")
+        x, _label, _weight, base_margin, _qid, _lo, _hi = _concat_shards(shards)
+        if x.shape[0] != self._local_rows or x.shape[1] != self.n_features:
+            raise ValueError(
+                f"reshard: shard layout changed ({x.shape} vs "
+                f"({self._local_rows}, {self.n_features})); a fresh engine "
+                f"build is required."
+            )
+        self._init_has_stats = (
+            getattr(init_booster, "_has_node_stats", True)
+            if init_booster is not None
+            else True
+        )
+        have_init = init_booster is not None and init_booster.num_trees
+
+        def margins_for(xv, bm):
+            ms = np.full((xv.shape[0], self.n_outputs), self.base_margin0,
+                         np.float32)
+            if bm is not None:
+                ms = ms + bm.reshape(xv.shape[0], -1).astype(np.float32)
+            if have_init:
+                ms = ms + (
+                    init_booster.predict_margin_np(xv)
+                    - init_booster.base_score_margin_np()
+                )
+            return ms
+
+        self._init_trees = []
+        self._init_tree_weights = None
+        if have_init:
+            self._init_trees = [init_booster.forest]
+            self._init_tree_weights = (
+                init_booster.tree_weights
+                if init_booster.tree_weights is not None
+                else np.ones(init_booster.num_trees, np.float32)
+            )
+        self.margins = self._put_rows(margins_for(x, base_margin), np.float32)
+
+        from xgboost_ray_tpu.distributed import put_rows_global
+
+        if len(evals) != len(self.evals):
+            raise ValueError("reshard: eval-set count changed")
+        for (eval_shards, _name), es in zip(evals, self.evals):
+            if es.is_train:
+                continue
+            ex, _, _, ebm, _, _, _ = _concat_shards(eval_shards)
+            if ex.shape[0] != es.local_rows:
+                raise ValueError(
+                    f"reshard: eval set {es.name!r} layout changed"
+                )
+            _, local_pad, _ = self._global_row_layout(ex.shape[0])
+            arr = margins_for(ex, ebm)
+            if arr.shape[0] < local_pad:
+                arr = np.pad(arr, [(0, local_pad - arr.shape[0]), (0, 0)])
+            es.margins = put_rows_global(arr, self._row_sharding)
+
+        # forest bookkeeping restarts at the booster's round count; the
+        # compiled programs themselves carry no forest state (the margins
+        # and per-round trees are program inputs/outputs)
+        self.trees = []
+        self._trees_dev = []
+        self._stack_entries = 0
+        self._stack_rows = 0
+        self._stack_buf = None
+        self._ar_bytes_dev = None
+        self.iteration_offset = (
+            init_booster.num_boosted_rounds() if init_booster is not None else 0
+        )
+
 
     # ------------------------------------------------------------------
     # DART (dropout) booster: per-round dropout over the forest built so
@@ -1600,6 +1700,28 @@ class TpuEngine:
                     )
             results[es.name] = row
         return results
+
+
+def shard_layout_fingerprint(shards) -> tuple:
+    """Cheap deterministic fingerprint of a shard list: per-shard shape plus
+    strided value samples of data and label. The driver's world cache uses
+    it to decide whether a cached engine's binned device data is still valid
+    for the actors now holding these ranks — shard loads are deterministic
+    in (rank, num_actors), so a matching fingerprint means matching rows
+    without an O(N) comparison."""
+    parts = []
+    for sh in shards:
+        d = np.asarray(sh["data"])
+        flat = d.ravel()
+        stride = max(1, flat.size // 256)
+        dsum = float(np.nansum(flat[::stride].astype(np.float64)))
+        lab = sh.get("label")
+        lsum = 0.0
+        if lab is not None:
+            la = np.asarray(lab, np.float64).ravel()
+            lsum = float(np.nansum(la[:: max(1, la.size // 64)]))
+        parts.append((tuple(d.shape), dsum, lsum))
+    return tuple(parts)
 
 
 def _concat_shards(shards):

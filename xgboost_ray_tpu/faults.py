@@ -14,7 +14,12 @@ Fault sites (where ``fire()`` is called from, and the context it carries):
 ====================  ==========================================  ==============
 site                  fired from                                  ctx keys
 ====================  ==========================================  ==============
-actor.train_round     driver round loop (``main._train``)         ``round``
+actor.train_round     driver round loop (``main._train``)         ``round, world``
+                                                                  (world = alive
+                                                                  actors, so a
+                                                                  rule can match
+                                                                  the shrunk or
+                                                                  restored world)
 actor.load_shard      ``RayXGBoostActor.load_data``               ``rank``
 checkpoint.save       ``launcher.save_round_checkpoint``          ``round, path``
 checkpoint.load       ``launcher.load_round_checkpoint``          ``path``

@@ -28,10 +28,10 @@ what they receive. The canonical training worker:
         booster, done = load_round_checkpoint(ctx.checkpoint_path)
         shards = ...  # THIS process's rows
         eng = TpuEngine(shards, params, num_actors=W, init_booster=booster)
-        for i in range(total_rounds - done):
-            eng.step(i)
-            save_round_checkpoint(eng.get_booster(), ctx.checkpoint_path,
-                                  done + i)
+        with AsyncCheckpointWriter() as ckpt:  # commits off the round loop
+            for i in range(total_rounds - done):
+                eng.step(i)
+                ckpt.submit(eng.get_booster(), ctx.checkpoint_path, done + i)
         return eng.get_booster().save_raw()
 """
 
@@ -47,6 +47,7 @@ import socket
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -63,6 +64,7 @@ __all__ = [
     "launch_distributed",
     "save_round_checkpoint",
     "load_round_checkpoint",
+    "AsyncCheckpointWriter",
 ]
 
 
@@ -153,8 +155,34 @@ def _history_candidates(path: str) -> List[str]:
     return [p for _, p in sorted(out, reverse=True)]
 
 
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    """Durably commit a rename by fsyncing the containing directory (a
+    crash after ``os.replace`` but before the directory entry hits disk can
+    otherwise resurrect the OLD file — or nothing). Best-effort: some
+    filesystems refuse directory fds."""
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover - fs-dependent
+        pass
+    finally:
+        os.close(fd)
+
+
 def save_round_checkpoint(
-    booster, path: str, completed_round: int, keep_last: Optional[int] = None
+    booster, path: str, completed_round: int, keep_last: Optional[int] = None,
+    fsync: bool = True,
 ) -> None:
     """Atomically persist ``booster`` + the round it completed (the driver's
     rank-0 checkpoint role, reference ``main.py:612-626``). The MODEL rename
@@ -162,17 +190,28 @@ def save_round_checkpoint(
     monitoring) and never read back, so a death between the two renames
     cannot desynchronize resume arithmetic.
 
+    Durability: the temp file is fsynced BEFORE the atomic rename (and the
+    directory entry after), so a host crash cannot leave a zero-length or
+    partially-written "newest" checkpoint behind the committed name —
+    ``fsync=False`` opts out for tests/tmpfs.
+
     Integrity + retention (the hardened resume path): every commit also
     writes a ``.sha256`` sidecar and retains the last ``keep_last``
     checkpoints as independent ``{path}.rNNNNNN`` copies (default
     ``RXGB_CHECKPOINT_KEEP``, 2; 0 disables retention) — so a corrupt or
     truncated newest checkpoint makes ``load_round_checkpoint`` fall back
-    to the previous good one instead of killing the resume path."""
+    to the previous good one instead of killing the resume path.
+
+    This runs serialization + write + fsync on the CALLING thread; round
+    loops should submit through :class:`AsyncCheckpointWriter` so the write
+    overlaps the next rounds instead of stalling them."""
     if keep_last is None:
         keep_last = int(os.environ.get("RXGB_CHECKPOINT_KEEP", "2"))
     tmp = f"{path}.tmp"
     booster.save_model(tmp)
     digest = _sha256_file(tmp)
+    if fsync:
+        _fsync_file(tmp)
     os.replace(tmp, path)
     stmp = f"{path}.sha256.tmp"
     with open(stmp, "w") as f:
@@ -197,9 +236,81 @@ def save_round_checkpoint(
                     os.remove(victim)
                 except OSError:
                     pass
+    if fsync:
+        _fsync_dir(os.path.dirname(path))
     # chaos hook LAST: a corrupt/truncate rule damages the COMMITTED newest
     # checkpoint (post-write disk corruption), which load must survive
     faults.fire_file("checkpoint.save", path, round=int(completed_round))
+
+
+class AsyncCheckpointWriter:
+    """Background checkpoint writes for the round loop.
+
+    ``save_round_checkpoint`` serializes, writes and fsyncs on the calling
+    thread — at production model sizes that stalls the boosting loop for the
+    full commit. ``submit()`` hands the (immutable) booster snapshot to a
+    background thread instead; ``wait()`` joins the in-flight write and
+    re-raises its failure, and is invoked automatically by the next
+    ``submit()`` — so at most one write is ever in flight, checkpoints
+    commit strictly in round order, and a write error surfaces at the next
+    round boundary instead of being dropped. Use as a context manager so
+    the final write is joined (and its errors surfaced) before the worker
+    returns::
+
+        with AsyncCheckpointWriter() as ckpt:
+            for i in range(rounds):
+                eng.step(i)
+                ckpt.submit(eng.get_booster(), path, done + i)
+    """
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._exc: Optional[BaseException] = None
+
+    def submit(self, booster, path: str, completed_round: int,
+               keep_last: Optional[int] = None, fsync: bool = True) -> None:
+        """Queue one checkpoint commit; joins the previous one first."""
+        self.wait()
+
+        def _write():
+            try:
+                save_round_checkpoint(
+                    booster, path, completed_round,
+                    keep_last=keep_last, fsync=fsync,
+                )
+            except BaseException as exc:  # noqa: BLE001 - re-raised by wait()
+                self._exc = exc
+
+        self._thread = threading.Thread(
+            target=_write, name="rxgb-ckpt-writer", daemon=True
+        )
+        self._thread.start()
+
+    def wait(self) -> None:
+        """Join the in-flight write (if any); re-raise its failure."""
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join()
+        exc, self._exc = self._exc, None
+        if exc is not None:
+            raise exc
+
+    def __enter__(self) -> "AsyncCheckpointWriter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is None:
+            self.wait()
+        else:
+            # don't mask the in-flight exception with a checkpoint error
+            try:
+                self.wait()
+            except BaseException as ckpt_exc:  # noqa: BLE001
+                logger.warning(
+                    "[RayXGBoost] background checkpoint write failed during "
+                    "error teardown: %s", ckpt_exc,
+                )
+        return False
 
 
 def _checkpoint_sha_ok(path: str) -> Optional[bool]:

@@ -283,6 +283,12 @@ class _TrainingState:
     restart_training_at: Optional[float] = None
     last_resource_check_at: float = 0.0
 
+    # in-flight elastic continuation: live engines keyed by world signature
+    # (tuple of alive ranks), so a shrink->grow cycle revives the cached
+    # engine's compiled programs instead of retracing. Bounded to the two
+    # most recent worlds (each entry pins device arrays).
+    engine_cache: Dict[tuple, Any] = dataclasses.field(default_factory=dict)
+
     training_started_at: float = 0.0
 
     # robustness accounting: rounds completed inside the CURRENT attempt
@@ -481,6 +487,12 @@ class _EngineBoosterProxy:
         self._cached: Optional[RayXGBoostBooster] = None
         self._cached_rounds = -1
 
+    def _rebind(self, engine) -> None:
+        """Point the proxy at a new engine (in-flight world shrink/grow)."""
+        self._engine = engine
+        self._cached = None
+        self._cached_rounds = -1
+
     def _materialize(self) -> RayXGBoostBooster:
         n = self._engine.num_round_trees
         if self._cached is None or self._cached_rounds != n:
@@ -645,59 +657,92 @@ def _train(
         else:
             eff_params["max_bin"] = int(dm_max_bin)
     parsed = parse_params(eff_params)
-    train_shards = [a.get_shard(dtrain) for a in alive]
     train_cats = dtrain.resolved_categories
-    evals_in = []
-    for deval, name in evals:
-        if deval is dtrain:
-            evals_in.append((train_shards, name))
-        else:
-            eshards = [a.get_shard(deval) for a in alive]
-            ecats = deval.resolved_categories
-            if ecats and not train_cats:
-                raise ValueError(
-                    f"eval set {name!r} auto-encoded categorical columns, but "
-                    f"the training matrix was built from integer codes — the "
-                    f"mappings cannot be aligned. Encode the eval set with "
-                    f"the same codes, or train from a DataFrame with "
-                    f"enable_categorical=True."
-                )
-            if train_cats and ecats != train_cats:
-                # align auto-encoded category codes with the training mapping
-                eshards = [
-                    translate_shard_categories(s, ecats, train_cats)
-                    for s in eshards
-                ]
-            evals_in.append((eshards, name))
-    init_booster = _deserialize_booster(state.checkpoint.value)
-    trial_devices = _resolve_mesh_devices(len(alive), ray_params)
-    if parsed.booster == "gblinear":
-        from xgboost_ray_tpu.linear import LinearEngine
 
-        engine = LinearEngine(
+    def _build_world(world_actors, world_init):
+        """The one engine factory of this attempt: assemble the given
+        actors' shards, translate eval-set categories, and build the engine
+        — or revive a cached engine whose compiled programs cover exactly
+        this world (shrink->grow cycles re-enter previously compiled world
+        sizes; see ``_TrainingState.engine_cache``)."""
+        from xgboost_ray_tpu.engine import shard_layout_fingerprint
+
+        train_shards = [a.get_shard(dtrain) for a in world_actors]
+        evals_in = []
+        for deval, name in evals:
+            if deval is dtrain:
+                evals_in.append((train_shards, name))
+            else:
+                eshards = [a.get_shard(deval) for a in world_actors]
+                ecats = deval.resolved_categories
+                if ecats and not train_cats:
+                    raise ValueError(
+                        f"eval set {name!r} auto-encoded categorical columns, "
+                        f"but the training matrix was built from integer "
+                        f"codes — the mappings cannot be aligned. Encode the "
+                        f"eval set with the same codes, or train from a "
+                        f"DataFrame with enable_categorical=True."
+                    )
+                if train_cats and ecats != train_cats:
+                    # align auto-encoded codes with the training mapping
+                    eshards = [
+                        translate_shard_categories(s, ecats, train_cats)
+                        for s in eshards
+                    ]
+                evals_in.append((eshards, name))
+        trial_devices = _resolve_mesh_devices(len(world_actors), ray_params)
+        if parsed.booster == "gblinear":
+            from xgboost_ray_tpu.linear import LinearEngine
+
+            return LinearEngine(
+                train_shards,
+                parsed,
+                num_actors=len(world_actors),
+                evals=evals_in,
+                devices=trial_devices,
+                init_booster=world_init,
+                feature_names=dtrain.resolved_feature_names,
+                feature_types=dtrain.resolved_feature_types,
+            )
+        key = tuple(a.rank for a in world_actors)
+        fp = shard_layout_fingerprint(train_shards)
+        cached = state.engine_cache.pop(key, None)
+        if cached is not None and getattr(cached, "_shard_fingerprint", None) == fp:
+            try:
+                cached.reset_from_booster(train_shards, evals_in, world_init)
+                return cached
+            except Exception as exc:  # noqa: BLE001 - cache is best-effort
+                logger.warning(
+                    "[RayXGBoost] cached engine for world %s unusable (%s); "
+                    "rebuilding.", key, exc,
+                )
+        eng = TpuEngine(
             train_shards,
             parsed,
-            num_actors=len(alive),
+            num_actors=len(world_actors),
             evals=evals_in,
             devices=trial_devices,
-            init_booster=init_booster,
-            feature_names=dtrain.resolved_feature_names,
-            feature_types=dtrain.resolved_feature_types,
-        )
-    else:
-        engine = TpuEngine(
-            train_shards,
-            parsed,
-            num_actors=len(alive),
-            evals=evals_in,
-            devices=trial_devices,
-            init_booster=init_booster,
+            init_booster=world_init,
             feature_names=dtrain.resolved_feature_names,
             total_rounds=boost_rounds_left,
             feature_weights=dtrain.feature_weights,
             feature_types=dtrain.resolved_feature_types,
             categories=train_cats,
         )
+        eng._world_key = key
+        eng._shard_fingerprint = fp
+        return eng
+
+    def _cache_world(eng):
+        key = getattr(eng, "_world_key", None)
+        if key is None or not getattr(eng, "can_reshard", lambda: False)():
+            return
+        state.engine_cache[key] = eng
+        while len(state.engine_cache) > 2:
+            state.engine_cache.pop(next(iter(state.engine_cache)))
+
+    init_booster = _deserialize_booster(state.checkpoint.value)
+    engine = _build_world(alive, init_booster)
     total_n = sum(a.local_n(dtrain) for a in alive)
     state.additional_results["total_n"] = total_n
 
@@ -709,14 +754,234 @@ def _train(
     evals_result: Dict[str, Dict[str, List[float]]] = {}
     callback_returns = state.additional_results.setdefault("callback_returns", {})
 
+    # ------------------------------------------------------------------
+    # In-flight elastic continuation (zero-replay shrink/grow). The global
+    # round index of this attempt is ``attempt_offset0 + i``; after a world
+    # swap the new engine's iteration_offset absorbs the rounds already
+    # boosted, so ``engine_base`` tracks how many attempt rounds are folded
+    # into it and the engine is stepped with the attempt round REBASED to
+    # its own offset (keeping the per-round RNG stream world-schedule
+    # independent: fold(seed, global_round)).
+    # ------------------------------------------------------------------
+    attempt_offset0 = engine.iteration_offset
+    engine_base = 0
+    rob = state.additional_results.get("robustness", {})
+
+    def _schedule_replacements(force=False):
+        if ENV.ELASTIC_RESTART_DISABLED:
+            return
+        if force:
+            state.last_resource_check_at = 0.0
+        elastic_mod._maybe_schedule_new_actors(
+            training_state=state,
+            num_cpus_per_actor=ray_params.cpus_per_actor,
+            num_gpus_per_actor=max(0, ray_params.gpus_per_actor),
+            resources_per_actor=ray_params.resources_per_actor,
+            ray_params=ray_params,
+            load_data=[dtrain] + [e[0] for e in evals],
+        )
+
+    def _swap_engine(new_engine, kind, started):
+        """Install ``new_engine`` as the attempt's engine; cache the old one
+        for a later grow-back; update the robustness metrics and total_n.
+        ``kind == "resume"`` (a blame-less transient failure continuing on
+        the unchanged world) moves no capacity, so it counts as neither a
+        shrink nor a grow."""
+        nonlocal engine, engine_base, total_n
+        if new_engine is not engine:
+            _cache_world(engine)
+            engine = new_engine
+            proxy._rebind(engine)
+        engine_base = engine.iteration_offset - attempt_offset0
+        new_alive = [a for a in state.actors if a is not None]
+        new_total = sum(a.local_n(dtrain) for a in new_alive)
+        if kind == "shrink":
+            rob["shrinks"] = rob.get("shrinks", 0) + 1
+            rob["orphaned_rows"] = (
+                rob.get("orphaned_rows", 0) + max(0, total_n - new_total)
+            )
+        elif kind == "grow":
+            rob["grows"] = rob.get("grows", 0) + 1
+        rob["recompile_s"] = round(
+            rob.get("recompile_s", 0.0) + (time.time() - started), 4
+        )
+        total_n = new_total
+        state.additional_results["total_n"] = total_n
+
+    def _world_is_current(world_actors):
+        """True when ``world_actors`` is exactly the world the CURRENT
+        engine was built over (same ranks, same shard rows) — continuation
+        then needs no rebuild at all: the device state is already live."""
+        from xgboost_ray_tpu.engine import shard_layout_fingerprint
+
+        if tuple(a.rank for a in world_actors) != getattr(
+            engine, "_world_key", None
+        ):
+            return False
+        return (
+            shard_layout_fingerprint([a.get_shard(dtrain) for a in world_actors])
+            == getattr(engine, "_shard_fingerprint", None)
+        )
+
+    def _grow_at_boundary():
+        """Reintegrate ready pending ranks at a round boundary by
+        re-sharding the running world in place — the in-memory booster
+        carries every boosted round, so reintegration replays NOTHING.
+        Falls back to the legacy restart-from-checkpoint reintegration
+        (``RayXGBoostActorAvailable``) when the in-place grow fails."""
+        started = time.time()
+        try:
+            booster_now = engine.get_booster()
+        except Exception as exc:  # noqa: BLE001 - fall back to restart
+            raise RayXGBoostActorAvailable(
+                "A new worker is ready but the in-memory booster could not "
+                "be snapshotted; restarting from the latest checkpoint."
+            ) from exc
+        promoted = [
+            r for r, p in (state.pending_actors or {}).items() if p.ready
+        ]
+        _promote_pending_actors(state)
+        _rewire_actors(state)
+        target = [a for a in state.actors if a is not None]
+        try:
+            new_engine = _build_world(target, booster_now)
+        except Exception as exc:  # noqa: BLE001 - fall back to restart
+            raise RayXGBoostActorAvailable(
+                f"In-place reintegration failed ({exc}); restarting from "
+                f"the latest checkpoint with the restored world."
+            ) from exc
+        for r in promoted:
+            if state.actors[r] is not None:
+                state.actors[r]._distributed_callbacks.before_train(
+                    state.actors[r]
+                )
+        _swap_engine(new_engine, "grow", started)
+        logger.info(
+            f"[RayXGBoost] Reintegrated ranks {promoted} in place at a round "
+            f"boundary ({len(target)} workers, zero rounds replayed)."
+        )
+
+    def _inflight_recover(exc) -> bool:
+        """Zero-replay elastic continuation for a mid-attempt failure:
+        reintegrate immediately when every dead rank's replacement is
+        already staged and no grace period applies (the world never
+        actually shrinks — zero recompile, bitwise continuation), otherwise
+        shrink to the survivors in place, recompiling once for the smaller
+        mesh and continuing from the in-memory booster. Returns False when
+        the in-flight path is unavailable (non-elastic, dart/gblinear,
+        empty forest, too many dead, rebuild failure, repeated failures
+        without progress) — the caller re-raises into the
+        restart-from-checkpoint policy."""
+        if not ray_params.elastic_training:
+            return False
+        if not getattr(engine, "can_reshard", lambda: False)():
+            return False
+        if state.consecutive_failures >= 3:
+            # repeated failures with no completed round in between: stop
+            # absorbing them in-flight and let the retry loop's bounded
+            # restart/backoff policy take over
+            return False
+        try:
+            booster_now = engine.get_booster()
+        except Exception as snap_exc:  # noqa: BLE001 - fall back to restart
+            logger.warning(
+                "[RayXGBoost] cannot snapshot the in-memory booster (%s); "
+                "falling back to restart-from-checkpoint.", snap_exc,
+            )
+            return False
+        alive_before = sum(1 for a in state.actors if a is not None)
+        alive_n = _apply_failure(state, exc)
+        dead = ray_params.num_actors - alive_n
+        if alive_n == 0 or dead > ray_params.max_failed_actors:
+            return False
+        for rank in list(state.failed_actor_ranks):
+            state.elastic_dead_ranks.add(rank)
+            state.failed_actor_ranks.discard(rank)
+        state.recover_started_at = time.time()
+        # stage replacements NOW: when every dead rank reloads within the
+        # scheduler's fast path and no grace period applies, the world is
+        # restored before the next round even starts
+        _schedule_replacements(force=True)
+        # a failure that blamed nobody (liveness probe found every actor
+        # healthy) changes no capacity: continuing on the unchanged world
+        # is a "resume", not a shrink — the robustness block is an
+        # operator-facing contract and must not report phantom world loss
+        kind = "shrink" if alive_n < alive_before else "resume"
+        promoted = []
+        target = [a for a in state.actors if a is not None]
+        if (
+            not ENV.ELASTIC_RESTART_DISABLED
+            and float(ENV.ELASTIC_RESTART_GRACE_PERIOD_S) <= 0
+            and state.elastic_dead_ranks
+            and all(
+                (state.pending_actors or {}).get(r) is not None
+                and state.pending_actors[r].ready
+                for r in state.elastic_dead_ranks
+            )
+        ):
+            # immediate reintegration: build the grown world's engine from
+            # the STAGED replacements first, promote only on success — a
+            # rebuild failure must leave the replacements pending (for the
+            # fallback restart to use), not get them killed as casualties
+            # of the re-raised failure
+            kind = "grow"
+            promoted = sorted(state.elastic_dead_ranks)
+            merged = list(state.actors)
+            for r in promoted:
+                merged[r] = state.pending_actors[r].actor
+            target = [a for a in merged if a is not None]
+        # recompile clock starts AFTER replacement staging: recompile_s is
+        # the runbook's "rebuild/retrace cost" signal and must not absorb
+        # the scheduler's (up to 1 s) data-load fast-path wait
+        started = time.time()
+        try:
+            if _world_is_current(target):
+                # the engine's device state already covers this exact world
+                # (immediate reintegration, or a failure that blamed no
+                # actor): pure resume — no rebuild, no recompile
+                new_engine = engine
+            else:
+                new_engine = _build_world(target, booster_now)
+        except Exception as build_exc:  # noqa: BLE001 - fall back to restart
+            logger.warning(
+                "[RayXGBoost] in-flight elastic %s failed (%s); falling "
+                "back to restart-from-checkpoint.", kind, build_exc,
+            )
+            return False
+        if kind == "grow":
+            _promote_pending_actors(state)
+            _rewire_actors(state)
+            for r in promoted:
+                if state.actors[r] is not None:
+                    state.actors[r]._distributed_callbacks.before_train(
+                        state.actors[r]
+                    )
+        # counted only when the in-flight path actually takes over (the
+        # fallback return-False paths leave the increment to the outer
+        # retry handler — one failure, one count)
+        state.consecutive_failures += 1
+        _swap_engine(new_engine, kind, started)
+        if kind == "resume":
+            logger.warning(
+                f"[RayXGBoost] A transient failure blamed no worker. "
+                f"Resuming in-flight with the unchanged {len(target)}-worker "
+                f"world — zero rounds replayed."
+            )
+        else:
+            logger.warning(
+                f"[RayXGBoost] A worker died. Continuing in-flight ({kind}) "
+                f"with {len(target)} workers — zero rounds replayed."
+            )
+        return True
+
     es_metric = None
     es_maximize = False
     es_best: Optional[float] = None
     es_best_iter = -1
-    if early_stopping_rounds is not None and evals_in:
+    if early_stopping_rounds is not None and evals:
         from xgboost_ray_tpu.ops.metrics import is_maximize_metric
 
-        es_set = evals_in[-1][1]
+        es_set = evals[-1][1]
         es_metric = engine.metric_names[-1]
         es_maximize = maximize if maximize is not None else is_maximize_metric(es_metric)
 
@@ -768,7 +1033,13 @@ def _train(
                 to_boundary = checkpoint_frequency - (completed % checkpoint_frequency)
                 n = min(n, to_boundary)
             chunk_started = time.time()
-            chunk_results = engine.step_many(completed, n)
+            try:
+                chunk_results = engine.step_many(completed - engine_base, n)
+            except (RayActorError, RayTaskError) as exc:
+                if not _inflight_recover(exc):
+                    raise
+                completed = engine_base + engine.num_round_trees
+                continue
             round_times.extend([(time.time() - chunk_started) / n] * n)
             state.rounds_this_attempt += n
             _mark_recovered(state)
@@ -795,21 +1066,20 @@ def _train(
                 or completed == boost_rounds_left
             ):
                 booster = engine.get_booster()
-                iteration = engine.iteration_offset + completed - 1
+                iteration = attempt_offset0 + completed - 1
                 state.queue.put(
                     (0, _Checkpoint(iteration, _serialize_booster(booster)))
                 )
             _handle_queue(state.queue, state.checkpoint, callback_returns)
             if ray_params.elastic_training and not ENV.ELASTIC_RESTART_DISABLED:
-                elastic_mod._maybe_schedule_new_actors(
-                    training_state=state,
-                    num_cpus_per_actor=ray_params.cpus_per_actor,
-                    num_gpus_per_actor=max(0, ray_params.gpus_per_actor),
-                    resources_per_actor=ray_params.resources_per_actor,
-                    ray_params=ray_params,
-                    load_data=[dtrain] + [e[0] for e in evals],
-                )
-                elastic_mod._update_scheduled_actor_states(state)
+                _schedule_replacements()
+                if elastic_mod._update_scheduled_actor_states(
+                    state,
+                    raise_on_ready=not getattr(
+                        engine, "can_reshard", lambda: False
+                    )(),
+                ):
+                    _grow_at_boundary()
             if time.time() - last_status > ENV.STATUS_FREQUENCY_S:
                 logger.info(
                     f"[RayXGBoost] Training in progress "
@@ -818,7 +1088,7 @@ def _train(
                 last_status = time.time()
 
         booster = engine.get_booster()
-        for actor in alive:
+        for actor in [a for a in state.actors if a is not None]:
             actor._distributed_callbacks.after_train(
                 actor, {"evals_result": evals_result}
             )
@@ -835,135 +1105,157 @@ def _train(
         }
 
     completed = 0
-    for i in range(boost_rounds_left):
+    i = 0
+    while i < boost_rounds_left:
         if state.stop_event.is_set():
             raise RayXGBoostTrainingStopped("Training was aborted.")
 
-        for model_cb in callbacks:
-            if hasattr(model_cb, "before_iteration"):
-                model_cb.before_iteration(proxy, i, evals_result)
+        try:
+            for model_cb in callbacks:
+                if hasattr(model_cb, "before_iteration"):
+                    model_cb.before_iteration(proxy, i, evals_result)
 
-        faults.fire(
-            "actor.train_round", round=engine.iteration_offset + i
-        )
+            faults.fire(
+                "actor.train_round",
+                round=attempt_offset0 + i,
+                world=sum(1 for a in state.actors if a is not None),
+            )
 
-        round_started = time.time()
-        gh_custom = None
-        if obj is not None:
-            # process-local rows (the reference computes the custom objective
-            # per actor on its shard, ``main.py:745-752``); label_np/weight_np
-            # hold exactly this process's rows. Single-host: all rows.
-            margins = engine.get_margins_local()
-            preds = margins[:, 0] if engine.n_outputs == 1 else margins
-            faux = _FauxDMatrix(engine.label_np, engine.weight_np, engine.group_ptr)
-            g, h = obj(preds, faux)
-            gh_custom = (g, h)
-
-        round_metrics = engine.step(i, gh_custom=gh_custom)
-        completed += 1
-        state.rounds_this_attempt += 1
-        _mark_recovered(state)
-        round_times.append(time.time() - round_started)
-
-        # custom metric (feval) computed per process on its local rows, then
-        # combined as a weighted mean across processes (the reference's
-        # per-worker metric averaging). Single-host: one call over all rows.
-        if feval is not None:
-            for es in engine.evals:
-                margin = engine.get_margins_local(es)
-                preds = margin[:, 0] if engine.n_outputs == 1 else margin
+            round_started = time.time()
+            gh_custom = None
+            if obj is not None:
+                # process-local rows (the reference computes the custom
+                # objective per actor on its shard, ``main.py:745-752``);
+                # label_np/weight_np hold exactly this process's rows.
+                # Single-host: all rows.
+                margins = engine.get_margins_local()
+                preds = margins[:, 0] if engine.n_outputs == 1 else margins
                 faux = _FauxDMatrix(
-                    es.label_np if es.label_np is not None else engine.label_np,
-                    es.weight_np,
-                    es.group_ptr,
+                    engine.label_np, engine.weight_np, engine.group_ptr
                 )
-                name, value = feval(preds, faux)
-                round_metrics.setdefault(es.name, {})[name] = (
-                    engine.combine_host_scalar(value, es, metric=name)
+                g, h = obj(preds, faux)
+                gh_custom = (g, h)
+
+            round_metrics = engine.step(i - engine_base, gh_custom=gh_custom)
+            completed += 1
+            state.rounds_this_attempt += 1
+            _mark_recovered(state)
+            round_times.append(time.time() - round_started)
+
+            # custom metric (feval) computed per process on its local rows,
+            # then combined as a weighted mean across processes (the
+            # reference's per-worker metric averaging). Single-host: one
+            # call over all rows.
+            if feval is not None:
+                for es in engine.evals:
+                    margin = engine.get_margins_local(es)
+                    preds = margin[:, 0] if engine.n_outputs == 1 else margin
+                    faux = _FauxDMatrix(
+                        es.label_np if es.label_np is not None else engine.label_np,
+                        es.weight_np,
+                        es.group_ptr,
+                    )
+                    name, value = feval(preds, faux)
+                    round_metrics.setdefault(es.name, {})[name] = (
+                        engine.combine_host_scalar(value, es, metric=name)
+                    )
+
+            for set_name, metrics in round_metrics.items():
+                for metric_name, value in metrics.items():
+                    evals_result.setdefault(set_name, {}).setdefault(
+                        metric_name, []
+                    ).append(value)
+
+            if verbose_eval and (
+                verbose_eval is True or (i % max(int(verbose_eval), 1) == 0)
+            ):
+                flat = "\t".join(
+                    f"{sn}-{mn}:{v[-1]:.5f}"
+                    for sn, ms in evals_result.items()
+                    for mn, v in ms.items()
+                )
+                print(f"[{i}]\t{flat}")
+
+            # driver-side checkpointing (mirror of the rank-0 checkpoint
+            # callback, main.py:612-626): every k rounds + after the last
+            is_last = i == boost_rounds_left - 1
+            if checkpoint_frequency and (
+                (i + 1) % checkpoint_frequency == 0 or is_last
+            ):
+                booster = engine.get_booster()
+                iteration = attempt_offset0 + i
+                state.queue.put(
+                    (0, _Checkpoint(iteration, _serialize_booster(booster)))
                 )
 
-        for set_name, metrics in round_metrics.items():
-            for metric_name, value in metrics.items():
-                evals_result.setdefault(set_name, {}).setdefault(
-                    metric_name, []
-                ).append(value)
+            _handle_queue(state.queue, state.checkpoint, callback_returns)
 
-        if verbose_eval and (
-            verbose_eval is True or (i % max(int(verbose_eval), 1) == 0)
-        ):
-            flat = "\t".join(
-                f"{sn}-{mn}:{v[-1]:.5f}"
-                for sn, ms in evals_result.items()
-                for mn, v in ms.items()
-            )
-            print(f"[{i}]\t{flat}")
+            # elastic: reintegrate failed ranks at the round boundary —
+            # in place (zero replay) for reshardable engines, via the
+            # legacy RayXGBoostActorAvailable restart otherwise
+            if ray_params.elastic_training and not ENV.ELASTIC_RESTART_DISABLED:
+                _schedule_replacements()
+                if elastic_mod._update_scheduled_actor_states(
+                    state,
+                    raise_on_ready=not getattr(
+                        engine, "can_reshard", lambda: False
+                    )(),
+                ):
+                    _grow_at_boundary()
 
-        # driver-side checkpointing (mirror of the rank-0 checkpoint callback,
-        # main.py:612-626): every k rounds and after the final round
-        is_last = i == boost_rounds_left - 1
-        if checkpoint_frequency and ((i + 1) % checkpoint_frequency == 0 or is_last):
-            booster = engine.get_booster()
-            iteration = engine.iteration_offset + i
-            state.queue.put((0, _Checkpoint(iteration, _serialize_booster(booster))))
+            stop = False
+            for model_cb in callbacks:
+                if hasattr(model_cb, "after_iteration"):
+                    stop = model_cb.after_iteration(proxy, i, evals_result) or stop
 
-        _handle_queue(state.queue, state.checkpoint, callback_returns)
+            if es_metric is not None:
+                try:
+                    cur = evals_result[evals[-1][1]][es_metric][-1]
+                except KeyError:
+                    cur = None
+                if cur is not None:
+                    better = (
+                        es_best is None
+                        or (es_maximize and cur > es_best)
+                        or (not es_maximize and cur < es_best)
+                    )
+                    if better:
+                        es_best, es_best_iter = cur, i
+                    elif i - es_best_iter >= early_stopping_rounds:
+                        stop = True
 
-        # elastic: try to reintegrate failed ranks (mirror main.py:1266-1277)
-        if ray_params.elastic_training and not ENV.ELASTIC_RESTART_DISABLED:
-            elastic_mod._maybe_schedule_new_actors(
-                training_state=state,
-                num_cpus_per_actor=ray_params.cpus_per_actor,
-                num_gpus_per_actor=max(0, ray_params.gpus_per_actor),
-                resources_per_actor=ray_params.resources_per_actor,
-                ray_params=ray_params,
-                load_data=[dtrain] + [e[0] for e in evals],
-            )
-            elastic_mod._update_scheduled_actor_states(state)
-
-        stop = False
-        for model_cb in callbacks:
-            if hasattr(model_cb, "after_iteration"):
-                stop = model_cb.after_iteration(proxy, i, evals_result) or stop
-
-        if es_metric is not None:
-            try:
-                cur = evals_result[evals_in[-1][1]][es_metric][-1]
-            except KeyError:
-                cur = None
-            if cur is not None:
-                better = (
-                    es_best is None
-                    or (es_maximize and cur > es_best)
-                    or (not es_maximize and cur < es_best)
+            if time.time() - last_status > ENV.STATUS_FREQUENCY_S:
+                logger.info(
+                    f"[RayXGBoost] Training in progress "
+                    f"({time.time() - train_started:.0f}s, round {i})."
                 )
-                if better:
-                    es_best, es_best_iter = cur, i
-                elif i - es_best_iter >= early_stopping_rounds:
-                    stop = True
+                last_status = time.time()
 
-        if time.time() - last_status > ENV.STATUS_FREQUENCY_S:
-            logger.info(
-                f"[RayXGBoost] Training in progress "
-                f"({time.time() - train_started:.0f}s, round {i})."
-            )
-            last_status = time.time()
-
-        if stop:
-            stop_requested = True
-            break
+            if stop:
+                stop_requested = True
+                break
+            i += 1
+        except (RayActorError, RayTaskError) as exc:
+            if not _inflight_recover(exc):
+                raise
+            # the in-memory booster is the single source of truth for how
+            # many attempt rounds are complete (a failure before the step
+            # re-runs round i; one after it does not)
+            i = engine_base + engine.num_round_trees
+            completed = i
 
     booster = engine.get_booster()
     if es_metric is not None and es_best_iter >= 0:
         # es_best_iter is attempt-local; xgboost reports the *global* boosting
         # round, so rebase by the continuation offset (xgb_model / restart).
-        booster.best_iteration = engine.iteration_offset + es_best_iter
+        booster.best_iteration = attempt_offset0 + es_best_iter
         booster.best_score = es_best
 
     for model_cb in callbacks:
         if hasattr(model_cb, "after_training"):
             model_cb.after_training(proxy)
 
-    for actor in alive:
+    for actor in [a for a in state.actors if a is not None]:
         actor._distributed_callbacks.after_train(actor, {"evals_result": evals_result})
 
     _handle_queue(state.queue, state.checkpoint, callback_returns)
@@ -1224,6 +1516,11 @@ def train(
             "rounds_replayed": 0,
             "time_to_recover_s": 0.0,
             "backoff_s": 0.0,
+            # in-flight elastic continuation (zero-replay shrink/grow)
+            "shrinks": 0,
+            "grows": 0,
+            "orphaned_rows": 0,
+            "recompile_s": 0.0,
         },
     )
 
