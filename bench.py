@@ -79,6 +79,14 @@ ELASTIC_TRIPWIRE_RATIO = 1.2
 # actually cheaper" from silently rotting back into zeroed-gh full-row cost
 SAMPLING_TRIPWIRE_RATIO = 1.2
 
+# instrumentation overhead: the obs plane's per-round spans ride the round
+# loop of EVERY traced run, so their cost budget is absolute — tracing on
+# may cost at most 2% of steady round time over tracing off. Unlike the
+# other tripwires this one fires on the current run's own paired
+# measurement (the budget), not only on cross-snapshot drift; the section
+# still lands in every BENCH_*.json so history stays queryable.
+OBS_OVERHEAD_RATIO = 1.02
+
 
 def _load_latest_bench_record(bench_dir):
     """Newest BENCH_*.json result dict (by round number, then mtime).
@@ -373,6 +381,132 @@ def sampling_round_time_tripwire(current_sampling, prev_rec, prev_name=None,
     return out
 
 
+def obs_overhead_tripwire(current_obs, prev_rec=None, prev_name=None,
+                          backend=None, threshold=OBS_OVERHEAD_RATIO):
+    """Check the tracing-on/tracing-off paired measurement against the
+    ≤2% instrumentation budget.
+
+    The obs analog of ``round_time_tripwire``, with one deliberate
+    difference: the tracked figure (``overhead_ratio`` = tracing-on steady
+    per-round time over tracing-off) is a within-run pairing, so the
+    tripwire fires on the CURRENT run's own budget violation — no prior
+    snapshot needed. When the newest recorded bench carries a comparable
+    ``obs_overhead`` section (same backend, same config), its ratio is
+    reported alongside so cross-snapshot drift of the overhead itself stays
+    visible. Returns ``{overhead_ratio, budget, fired, ...}`` or ``None``
+    when the current section has no ratio (an arm failed to measure)."""
+    if not isinstance(current_obs, dict):
+        return None
+    cur = current_obs.get("overhead_ratio")
+    if not cur:
+        return None
+    out = {
+        "overhead_ratio": round(float(cur), 4),
+        "budget": threshold,
+        "fired": False,
+    }
+    prev_obs = (prev_rec or {}).get("obs_overhead") \
+        if isinstance(prev_rec, dict) else None
+    if isinstance(prev_obs, dict) and prev_obs.get("overhead_ratio"):
+        if backend and prev_rec.get("backend") \
+                and prev_rec["backend"] != backend:
+            prev_obs = None
+        elif prev_obs.get("config") != current_obs.get("config"):
+            out["config_mismatch"] = True
+            prev_obs = None
+    if isinstance(prev_obs, dict) and prev_obs.get("overhead_ratio"):
+        out["prev_overhead_ratio"] = round(
+            float(prev_obs["overhead_ratio"]), 4
+        )
+        out["prev_record"] = prev_name
+    if float(cur) > threshold:
+        out["fired"] = True
+        print(
+            f"[bench] OBS OVERHEAD TRIPWIRE: tracing-on steady round time "
+            f"is {float(cur):.4f}x tracing-off — over the "
+            f"{(threshold - 1) * 100:.0f}% instrumentation budget. The "
+            f"span emission path has grown a hot-loop cost; profile "
+            f"obs.trace before trusting traced-run timings.",
+            file=sys.stderr,
+        )
+    return out
+
+
+def run_obs_overhead(x=None, y=None, base_params=None, actors=None):
+    """Paired tracing-on vs tracing-off steady-round measurement.
+
+    Two fresh back-to-back trainings of the identical config — one with
+    ``RXGB_TRACE=0`` (the tracer's ``span()``/``event()`` become near-free
+    no-ops), one with tracing on (the default every production run gets) —
+    each 2 scan chunks so the steady median excludes the compile-carrying
+    first chunk. The ratio is the price of the obs plane itself, which the
+    ≤2% budget (``OBS_OVERHEAD_RATIO``) keeps honest: instrumentation that
+    costs real round time is a perf regression like any other. Returns the
+    ``obs_overhead`` section for the BENCH record."""
+    import jax
+
+    from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+    chunk = max(1, int(os.environ.get("RXGB_SCAN_MAX_CHUNK", "10")))
+    rounds = int(os.environ.get("BENCH_OBS_OVERHEAD_ROUNDS", 2 * chunk))
+    if x is None or y is None:
+        n_rows = int(os.environ.get("BENCH_OBS_OVERHEAD_ROWS", 25_000))
+        x, y = make_higgs_like(n_rows, 28, seed=5)
+    if actors is None:
+        actors = int(os.environ.get(
+            "BENCH_ACTORS", max(1, len(jax.devices()))
+        ))
+    params = {
+        "objective": "binary:logistic", "max_depth": 6, "eta": 0.1,
+        "max_bin": 256, "tree_method": "tpu_hist",
+    }
+    if base_params:
+        params.update(base_params)
+
+    out = {"rounds": rounds}
+    saved = os.environ.get("RXGB_TRACE")
+    try:
+        for arm, flag in (("tracing_off", "0"), ("tracing_on", "1")):
+            os.environ["RXGB_TRACE"] = flag
+            res = {}
+            t0 = time.time()
+            train(
+                params, RayDMatrix(x, y), num_boost_round=rounds,
+                additional_results=res,
+                ray_params=RayParams(num_actors=actors,
+                                     checkpoint_frequency=0),
+            )
+            arm_time = time.time() - t0
+            out[arm] = {
+                "per_round_s": round(_steady_per_round(
+                    res.get("round_times_s"), chunk, arm_time, rounds
+                ), 4),
+                "train_time_s": round(arm_time, 2),
+            }
+            if flag == "1":
+                obs_res = res.get("obs") or {}
+                out[arm]["records"] = len(obs_res.get("timeline") or [])
+                out[arm]["dropped_spans"] = obs_res.get("dropped_spans", 0)
+    finally:
+        if saved is None:
+            os.environ.pop("RXGB_TRACE", None)
+        else:
+            os.environ["RXGB_TRACE"] = saved
+    off_s = out["tracing_off"]["per_round_s"]
+    if off_s:
+        out["overhead_ratio"] = round(
+            out["tracing_on"]["per_round_s"] / off_s, 4
+        )
+        out["within_budget"] = out["overhead_ratio"] <= OBS_OVERHEAD_RATIO
+    out["config"] = {
+        "rows": int(x.shape[0]), "features": int(x.shape[1]),
+        "rounds": rounds, "actors": actors,
+        "max_depth": int(params.get("max_depth", 6)),
+    }
+    print(f"[bench] obs overhead: {out}", file=sys.stderr)
+    return out
+
+
 def run_sampling_ablation(x, y, base_params, actors):
     """Paired full/sampled training ablation on the ambient mesh.
 
@@ -500,183 +634,136 @@ def r4_paired_recheck(detail):
 
 
 def run_phase_breakdown():
-    """Micro-timed per-phase round-cost breakdown (sample / hist / split /
-    partition / margin) for the full, subsample=0.5, and GOSS configs.
+    """Per-phase round-cost breakdown (sample / hist / split / partition /
+    margin / allreduce) for the full, subsample=0.5, and GOSS configs —
+    consumed from the RUNTIME trace.
 
-    Each phase is jitted and timed standalone on ONE device at the
-    per-shard block shape the round step actually processes
-    (rows/actors), with per-level costs summed over the depth —
-    sibling subtraction's half-fan-out builds included. This is a
-    phase-share approximation (the compiled round fuses phases; XLA may
-    overlap them), not an in-program trace: its job is to show WHERE the
-    compacted build saves (hist/partition shrink to the M-row budget;
-    sample + the full-row margin walk are the overhead paid for it)."""
-    import functools
-
+    Each arm trains a short run with fenced phase profiling enabled
+    (``RXGB_TRACE_PHASES=1``); the engine itself emits the per-phase spans
+    at its true per-shard shapes (compile vs execute separated via
+    ``block_until_ready``, sibling-subtraction fan-outs, the engine's real
+    sampling budget and split params), and the table below is read back
+    from ``additional_results["obs"]["phase_profile"]``. This replaced the
+    bench's former standalone duplicate timers: the numbers now come from
+    the same instrumentation any traced production run produces."""
     import jax
-    import jax.numpy as jnp
 
-    from xgboost_ray_tpu.ops import sampling as sampling_mod
-    from xgboost_ray_tpu.ops.grow import (
-        empty_tree,
-        predict_tree_binned,
-        route_right_binned,
-    )
-    from xgboost_ray_tpu.ops.histogram import build_histogram
-    from xgboost_ray_tpu.ops.split import SplitParams, find_splits
+    from xgboost_ray_tpu import RayDMatrix, RayParams, train
 
     n_rows = int(os.environ.get("BENCH_PHASE_ROWS", 25_000))
     n_feat = int(os.environ.get("BENCH_FEATURES", 28))
     depth = int(os.environ.get("BENCH_DEPTH", 6))
-    max_bin = 256
-    nbt = max_bin + 1
-    iters = 3
-
-    rng = np.random.RandomState(0)
-    bins = jnp.asarray(
-        rng.randint(0, max_bin, size=(n_rows, n_feat)), jnp.uint8
+    actors = int(
+        os.environ.get("BENCH_PHASE_ACTORS", max(1, len(jax.devices())))
     )
-    gh = jnp.asarray(
-        np.stack(
-            [rng.standard_normal(n_rows), np.abs(rng.standard_normal(n_rows))],
-            axis=1,
-        ),
-        jnp.float32,
-    )
-    valid = jnp.ones((n_rows,), bool)
-    key = jax.random.PRNGKey(0)
-    # a full random tree so the margin walk takes all depth levels
-    tree = empty_tree((1 << (depth + 1)) - 1)
-    tree = tree._replace(
-        feature=jnp.asarray(
-            rng.randint(0, n_feat, tree.feature.shape), jnp.int32
-        ),
-        split_bin=jnp.asarray(
-            rng.randint(0, max_bin - 1, tree.split_bin.shape), jnp.int32
-        ),
-    )
-
-    def timed(fn, *args):
-        out = fn(*args)
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(*args)
-        jax.block_until_ready(out)
-        return (time.perf_counter() - t0) / iters
-
-    specs = {
-        "full": None,
-        "subsample": sampling_mod.SamplingSpec("uniform", rate=0.5),
-        "goss": sampling_mod.SamplingSpec(
-            "gradient_based", top_rate=0.1, other_rate=0.1
-        ),
+    rounds = 2
+    x, y = make_higgs_like(n_rows, n_feat, seed=3)
+    arms = {
+        "full": {},
+        "subsample": {"subsample": 0.5},
+        "goss": {"sampling_method": "gradient_based", "top_rate": 0.1,
+                 "other_rate": 0.1},
     }
-    # split search scans histograms, not rows — its cost is identical in
-    # every arm, so it is timed ONCE (per-arm re-timing would print noise
-    # as a difference)
-    split_s = 0.0
-    for d in range(depth):
-        n_nodes = 1 << d
-        hist = jnp.asarray(
-            rng.standard_normal((n_nodes, n_feat, nbt, 2)), jnp.float32
-        )
-        node_gh = hist[:, 0, :, :].sum(axis=1)
-        split_fn = jax.jit(lambda h, ng: find_splits(h, ng, SplitParams()))
-        split_s += timed(split_fn, hist, node_gh)
-    split_ms = round(1e3 * split_s, 3)
     section = {}
-    for name, spec in specs.items():
-        m = n_rows if spec is None else sampling_mod.row_budget(n_rows, spec)
-        phases = {"rows_per_level": m}
-
-        if spec is None:
-            phases["sample_ms"] = 0.0
-            bins_m, gh_m = bins, gh
-        else:
-            sample_fn = jax.jit(
-                lambda g, v, k, _s=spec: sampling_mod.sample_rows(
-                    g, v, k, _s
-                )
+    saved = os.environ.get("RXGB_TRACE_PHASES")
+    os.environ["RXGB_TRACE_PHASES"] = "1"
+    try:
+        for name, extra in arms.items():
+            params = {
+                "objective": "binary:logistic", "max_depth": depth,
+                "eta": 0.1, "max_bin": 256, "tree_method": "tpu_hist",
+            }
+            params.update(extra)
+            res = {}
+            train(
+                params, RayDMatrix(x, y), num_boost_round=rounds,
+                additional_results=res,
+                ray_params=RayParams(num_actors=actors,
+                                     checkpoint_frequency=0),
             )
-            gather_fn = jax.jit(lambda r: bins[r])
-            rows, gh_m = sample_fn(gh, valid, key)
-            phases["sample_ms"] = round(
-                1e3
-                * (
-                    timed(sample_fn, gh, valid, key)
-                    + timed(gather_fn, rows)
+            prof = (res.get("obs") or {}).get("phase_profile")
+            if not prof:
+                print(
+                    f"[bench] phase breakdown: no phase profile in the "
+                    f"trace for arm {name!r}; skipping",
+                    file=sys.stderr,
+                )
+                continue
+            phases = prof["phases"]
+            section[name] = {
+                "rows_per_level": prof["sample_rows"],
+                "sample_ms": phases["sample"]["execute_ms"],
+                "hist_ms": phases["hist"]["execute_ms"],
+                "split_ms": phases["split"]["execute_ms"],
+                "partition_ms": phases["partition"]["execute_ms"],
+                "margin_ms": phases["margin"]["execute_ms"],
+                "allreduce_ms": phases["allreduce"]["execute_ms"],
+                "allreduce_bytes_per_round": phases["allreduce"][
+                    "bytes_per_round"
+                ],
+                "compile_ms": round(
+                    sum(p.get("compile_ms", 0.0) for p in phases.values()), 3
                 ),
-                3,
-            )
-            bins_m = gather_fn(rows)
-
-        hist_s = part_s = 0.0
-        for d in range(depth):
-            n_nodes = 1 << d
-            # sibling subtraction: levels >= 1 build only the smaller child
-            build_nodes = max(1, n_nodes // 2) if d > 0 else 1
-            pos = jnp.asarray(
-                rng.randint(0, build_nodes, size=(m,)), jnp.int32
-            )
-            hist_fn = jax.jit(
-                functools.partial(
-                    build_histogram,
-                    n_nodes=build_nodes,
-                    n_bins_total=nbt,
-                    impl="scatter",
-                )
-            )
-            hist_s += timed(hist_fn, bins_m, gh_m, pos)
-            pos_lvl = jnp.asarray(
-                rng.randint(0, n_nodes, size=(m,)), jnp.int32
-            )
-            sbin = jnp.asarray(
-                rng.randint(0, max_bin - 1, size=(n_nodes,)), jnp.int32
-            )
-
-            def part_fn(b, p, sb):
-                bv = b[:, 0].astype(jnp.int32)
-                go_right = route_right_binned(
-                    bv, sb[p], jnp.zeros_like(sb, bool)[p], None, max_bin
-                )
-                return p * 2 + go_right.astype(jnp.int32)
-
-            part_s += timed(jax.jit(part_fn), bins_m, pos_lvl, sbin)
-        phases["hist_ms"] = round(1e3 * hist_s, 3)
-        phases["split_ms"] = split_ms
-        phases["partition_ms"] = round(1e3 * part_s, 3)
-
-        if spec is None:
-            # full-row builds fuse the margin update into row_value — no
-            # separate walk
-            phases["margin_ms"] = 0.0
+                "total_ms": prof["total_execute_ms"],
+                "rows_per_shard": prof["rows_per_shard"],
+            }
+    finally:
+        if saved is None:
+            os.environ.pop("RXGB_TRACE_PHASES", None)
         else:
-            walk_fn = jax.jit(
-                lambda t, b: predict_tree_binned(t, b, depth, max_bin)
-            )
-            phases["margin_ms"] = round(1e3 * timed(walk_fn, tree, bins), 3)
-        phases["total_ms"] = round(
-            phases["sample_ms"] + phases["hist_ms"] + phases["split_ms"]
-            + phases["partition_ms"] + phases["margin_ms"],
-            3,
-        )
-        section[name] = phases
-    if section["full"]["total_ms"]:
-        section["subsample_total_vs_full"] = round(
-            section["subsample"]["total_ms"] / section["full"]["total_ms"], 3
-        )
-        section["goss_total_vs_full"] = round(
-            section["goss"]["total_ms"] / section["full"]["total_ms"], 3
-        )
+            os.environ["RXGB_TRACE_PHASES"] = saved
+    if section.get("full", {}).get("total_ms"):
+        for arm in ("subsample", "goss"):
+            if section.get(arm):
+                section[f"{arm}_total_vs_full"] = round(
+                    section[arm]["total_ms"] / section["full"]["total_ms"], 3
+                )
     section["config"] = {
-        "rows_per_shard": n_rows, "features": n_feat, "depth": depth,
-        "max_bin": max_bin, "impl": "scatter",
-        "note": "standalone jitted phases on one device; approximation, "
-                "not an in-program trace",
+        "rows": n_rows, "features": n_feat, "depth": depth,
+        "max_bin": 256, "actors": actors,
+        "source": "runtime trace (engine.profile_phases spans)",
+        "note": "fenced standalone phase programs at the engine's real "
+                "shard shapes; phase-share approximation — the compiled "
+                "round fuses phases",
     }
     print(f"[bench] phase breakdown: {section}", file=sys.stderr)
     return section
+
+
+def _timeline_recovery_s(timeline):
+    """Failure→recovery seconds reconstructed from a run's trace timeline
+    (``obs.recovery_time_s``), or None when the run produced no timeline
+    (tracing disabled) so callers can fall back to the robustness dict."""
+    if not timeline:
+        return None
+    from xgboost_ray_tpu import obs
+
+    return round(obs.recovery_time_s(timeline), 4)
+
+
+def _timeline_fault_events(timeline):
+    """The chaos story as the timeline tells it: the ordered
+    ``fault.injected`` / ``failure.detected`` / ``world.shrink`` /
+    ``world.grow`` / ``world.restart`` / ``recovered`` events with their
+    round indices — the machine-readable sequence the BENCH snapshot
+    records instead of a prose description of what the soak did."""
+    names = {
+        "fault.injected", "failure.detected", "world.shrink", "world.grow",
+        "world.restart", "recovered", "backoff",
+    }
+    out = []
+    for rec in timeline or []:
+        if rec.get("kind") != "event" or rec.get("name") not in names:
+            continue
+        row = {"event": rec["name"]}
+        if "round" in rec:
+            row["round"] = rec["round"]
+        attrs = rec.get("attrs") or {}
+        for k in ("world", "ranks", "site", "action", "orphaned_rows"):
+            if k in attrs:
+                row[k] = attrs[k]
+        out.append(row)
+    return out
 
 
 def run_chaos_measurement():
@@ -753,6 +840,12 @@ def run_chaos_measurement():
         )
     soak_s = time.time() - soak_started
     rob = res.get("robustness", {})
+    # recovery numbers come from the RUN TIMELINE, not the robustness dict:
+    # each "recovered" event closes the clock its "failure.detected" opened
+    # (obs.recovery_time_s mirrors the driver's accounting — the dict value
+    # is kept alongside as a cross-check; the two must agree)
+    soak_timeline = (res.get("obs") or {}).get("timeline") or []
+    ttr_timeline = _timeline_recovery_s(soak_timeline)
     # the restart recomputes resume margins from the checkpoint forest — a
     # different f32 summation order than the uninterrupted run's incremental
     # accumulation — so the match is pinned at atol=1e-5 (NOT bitwise), with
@@ -798,7 +891,15 @@ def run_chaos_measurement():
     section = {
         "restarts": rob.get("restarts", 0),
         "rounds_replayed": rob.get("rounds_replayed", 0),
-        "time_to_recover_s": rob.get("time_to_recover_s", 0.0),
+        "time_to_recover_s": (
+            ttr_timeline if ttr_timeline is not None
+            else rob.get("time_to_recover_s", 0.0)
+        ),
+        "recovery_source": (
+            "timeline" if ttr_timeline is not None else "robustness_dict"
+        ),
+        "time_to_recover_robustness_s": rob.get("time_to_recover_s", 0.0),
+        "fault_events": _timeline_fault_events(soak_timeline),
         "backoff_s": rob.get("backoff_s", 0.0),
         "soak_train_time_s": round(soak_s, 2),
         "model_matches": model_matches,  # vs uninterrupted, atol=1e-5
@@ -852,13 +953,25 @@ def run_chaos_measurement():
                 else:
                     os.environ[k] = v
         rob_c = res_cont.get("robustness", {})
-        cont_ttr = rob_c.get("time_to_recover_s", 0.0)
+        cont_timeline = (res_cont.get("obs") or {}).get("timeline") or []
+        cont_ttr_timeline = _timeline_recovery_s(cont_timeline)
+        cont_ttr = (
+            cont_ttr_timeline if cont_ttr_timeline is not None
+            else rob_c.get("time_to_recover_s", 0.0)
+        )
         restart_ttr = section["time_to_recover_s"]
         cont_matches = bool(np.allclose(
             bst_cont.predict(x, output_margin=True), ref_margin, atol=1e-5
         ))
         section["elastic"] = {
             "time_to_recover_s": cont_ttr,
+            "recovery_source": (
+                "timeline" if cont_ttr_timeline is not None
+                else "robustness_dict"
+            ),
+            "time_to_recover_robustness_s": rob_c.get(
+                "time_to_recover_s", 0.0
+            ),
             "rounds_replayed": rob_c.get("rounds_replayed", 0),
             "restarts": rob_c.get("restarts", 0),
             "shrinks": rob_c.get("shrinks", 0),
@@ -866,6 +979,9 @@ def run_chaos_measurement():
             "orphaned_rows": rob_c.get("orphaned_rows", 0),
             "recompile_s": rob_c.get("recompile_s", 0.0),
             "model_matches": cont_matches,  # vs uninterrupted, atol=1e-5
+            # the kill→shrink→grow (or immediate-reintegration) sequence as
+            # the timeline recorded it, round indices included
+            "fault_events": _timeline_fault_events(cont_timeline),
         }
         if restart_ttr and cont_ttr:
             ratio = round(cont_ttr / restart_ttr, 4)
@@ -1256,11 +1372,60 @@ def run_measurement():
             detail["r4_regression_recheck"] = recheck
 
     # per-phase round-cost breakdown (sample/hist/split/partition/margin),
-    # micro-timed standalone — shows WHERE sampling saves. Default on for
-    # the CPU mesh; opt-in on TPU via BENCH_PHASE_BREAKDOWN=1.
+    # consumed from the runtime trace — shows WHERE sampling saves. Default
+    # on for the CPU mesh; opt-in on TPU via BENCH_PHASE_BREAKDOWN=1.
     phase_env = os.environ.get("BENCH_PHASE_BREAKDOWN")
     if phase_env == "1" or (phase_env is None and not on_tpu):
         detail["phase_breakdown"] = run_phase_breakdown()
+
+    # the protocol run's own obs snapshot: per-round span stats, ring-buffer
+    # truncation accounting, wire bytes, and (when the breakdown above ran)
+    # the per-phase means — recorded so future tripwires can query phases
+    # straight out of BENCH_*.json without re-instrumenting
+    obs_res = additional_results.get("obs") or {}
+    if obs_res:
+        round_durs = [
+            r["dur_s"] for r in obs_res.get("rounds") or []
+            if r.get("dur_s") is not None
+        ]
+        obs_section = {
+            "rounds_traced": len(round_durs),
+            "events": len(obs_res.get("events") or []),
+            "dropped_spans": obs_res.get("dropped_spans", 0),
+            "capacity": obs_res.get("capacity"),
+        }
+        if round_durs:
+            obs_section["round_dur_mean_s"] = round(
+                float(np.mean(round_durs)), 4
+            )
+            obs_section["round_dur_median_s"] = round(
+                float(np.median(round_durs)), 4
+            )
+        if ar_bytes is not None:
+            obs_section["allreduce_bytes_per_round"] = int(ar_bytes)
+        full_phases = (detail.get("phase_breakdown") or {}).get("full")
+        if full_phases:
+            obs_section["phase_ms"] = {
+                k: full_phases[k]
+                for k in ("sample_ms", "hist_ms", "split_ms", "partition_ms",
+                          "margin_ms", "allreduce_ms")
+                if k in full_phases
+            }
+        detail["obs"] = obs_section
+        print(f"[bench] obs snapshot: {obs_section}", file=sys.stderr)
+
+    # instrumentation-overhead pairing (tracing on vs off) with the ≤2%
+    # budget tripwire. Default on for the CPU mesh; opt-in on TPU via
+    # BENCH_OBS_OVERHEAD=1 (two short extra trainings).
+    obs_env = os.environ.get("BENCH_OBS_OVERHEAD")
+    if obs_env == "1" or (obs_env is None and not on_tpu):
+        obs_overhead = run_obs_overhead(x, y, params, actors)
+        otrip = obs_overhead_tripwire(
+            obs_overhead, prev_rec, prev_name, backend=backend
+        )
+        if otrip is not None:
+            obs_overhead["regression_tripwire"] = otrip
+        detail["obs_overhead"] = obs_overhead
 
     # closed-loop serving benchmark (the online-inference counterpart of the
     # training protocol). Default on for the CPU mesh; opt-in on TPU via

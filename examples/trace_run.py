@@ -1,0 +1,91 @@
+"""Traced-training smoke: the obs plane's queryable run timeline.
+
+Trains 5 rounds with tracing on (the default) plus per-rank JSONL
+streaming (``RXGB_TRACE_DIR``) and fenced phase profiling
+(``RXGB_TRACE_PHASES=1``), then:
+
+* validates BOTH the in-memory timeline (``additional_results["obs"]``)
+  and the streamed JSONL file against the shared trace schema
+  (``xgboost_ray_tpu.validate_trace_records`` — the same checker the
+  tests use, so the CI example and the suite cannot drift apart), and
+* prints the per-phase table (sample / hist / split / partition / margin /
+  allreduce, compile vs execute separated) that traced production runs
+  emit — the per-round/per-collective breakdown the XGBoost GPU paper
+  attributes its wins with, now available outside the benchmark harness.
+
+Run directly: python examples/trace_run.py
+"""
+
+import json
+import os
+import tempfile
+
+import numpy as np
+
+from xgboost_ray_tpu import RayDMatrix, RayParams, train, validate_trace_records
+
+
+def main():
+    rounds = 5
+    rng = np.random.RandomState(0)
+    x = rng.randn(4096, 12).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float32)
+
+    with tempfile.TemporaryDirectory() as trace_dir:
+        os.environ["RXGB_TRACE_DIR"] = trace_dir
+        os.environ["RXGB_TRACE_PHASES"] = "1"
+        try:
+            res = {}
+            bst = train(
+                {"objective": "binary:logistic", "eval_metric": ["logloss"],
+                 "max_depth": 4},
+                RayDMatrix(x, y),
+                rounds,
+                additional_results=res,
+                ray_params=RayParams(num_actors=2, checkpoint_frequency=2),
+            )
+        finally:
+            os.environ.pop("RXGB_TRACE_DIR", None)
+            os.environ.pop("RXGB_TRACE_PHASES", None)
+
+        assert bst.num_boosted_rounds() == rounds
+        obs = res["obs"]
+
+        # schema validation: in-memory timeline AND the streamed JSONL
+        problems = validate_trace_records(obs["timeline"])
+        assert not problems, problems
+        stream_path = os.path.join(trace_dir, "trace-rank0.jsonl")
+        with open(stream_path) as f:
+            streamed = [json.loads(line) for line in f]
+        problems = validate_trace_records(streamed)
+        assert not problems, problems
+        print(f"trace schema OK: {len(obs['timeline'])} buffered records, "
+              f"{len(streamed)} streamed lines, "
+              f"{obs['dropped_spans']} dropped")
+
+    # the queryable views: one span per round, lifecycle events
+    assert [r["round"] for r in obs["rounds"]] == list(range(rounds))
+    print("\nround  dur_s     world  rows")
+    for r in obs["rounds"]:
+        print(f"{r['round']:>5}  {r['dur_s']:<8.4f}  {r['world']:>5}  "
+              f"{r['rows']}")
+    events = [(e["name"], e.get("round")) for e in obs["events"]]
+    print(f"events: {events}")
+    assert any(name == "checkpoint.commit" for name, _ in events)
+
+    # the per-phase table from the fenced profile
+    prof = obs["phase_profile"]
+    print(f"\nphase profile ({prof['rows_per_shard']} rows/shard, "
+          f"world {prof['config']['world']}):")
+    print(f"{'phase':<10} {'compile_ms':>11} {'execute_ms':>11}")
+    for name in ("sample", "hist", "split", "partition", "margin",
+                 "allreduce"):
+        p = prof["phases"][name]
+        print(f"{name:<10} {p['compile_ms']:>11.3f} {p['execute_ms']:>11.3f}")
+    print(f"total execute: {prof['total_execute_ms']:.3f} ms/round "
+          f"(phase-share approximation)")
+    print("\ntraced run OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -337,3 +337,68 @@ def test_load_latest_bench_record_picks_newest_round(tmp_path):
 
 def test_load_latest_bench_record_empty_dir(tmp_path):
     assert bench._load_latest_bench_record(str(tmp_path)) == (None, None)
+
+
+_OBS_CFG = {"rows": 25000, "features": 28, "rounds": 20, "actors": 8,
+            "max_depth": 6}
+
+
+def _obs_section(ratio, cfg=None):
+    return {
+        "rounds": 20,
+        "tracing_off": {"per_round_s": 1.0},
+        "tracing_on": {"per_round_s": ratio, "records": 40,
+                       "dropped_spans": 0},
+        "overhead_ratio": ratio,
+        "within_budget": ratio <= bench.OBS_OVERHEAD_RATIO,
+        "config": dict(cfg if cfg is not None else _OBS_CFG),
+    }
+
+
+def test_obs_overhead_tripwire_fires_over_2pct_budget(capsys):
+    """The instrumentation budget is absolute: tracing-on > 1.02x
+    tracing-off fires on the current run's own pairing, prior snapshot or
+    not — span emission riding the round loop is a perf regression like
+    any other."""
+    out = bench.obs_overhead_tripwire(_obs_section(1.05))
+    assert out is not None and out["fired"]
+    assert out["overhead_ratio"] == 1.05
+    assert out["budget"] == bench.OBS_OVERHEAD_RATIO
+    assert "OBS OVERHEAD TRIPWIRE" in capsys.readouterr().err
+
+
+def test_obs_overhead_tripwire_quiet_within_budget(capsys):
+    out = bench.obs_overhead_tripwire(_obs_section(1.01))
+    assert out is not None and not out["fired"]
+    assert "OBS OVERHEAD TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_obs_overhead_tripwire_reports_prev_snapshot_like_for_like(capsys):
+    rec = {"metric": "m", "backend": "cpu", "obs_overhead": _obs_section(1.005)}
+    out = bench.obs_overhead_tripwire(
+        _obs_section(1.01), rec, "BENCH_r06.json", backend="cpu"
+    )
+    assert out is not None and not out["fired"]
+    assert out["prev_overhead_ratio"] == 1.005
+    assert out["prev_record"] == "BENCH_r06.json"
+    # a different pairing config is not like-for-like: prev dropped, named
+    other = dict(_OBS_CFG, rows=1000)
+    rec2 = {"metric": "m", "backend": "cpu",
+            "obs_overhead": _obs_section(1.005, other)}
+    out2 = bench.obs_overhead_tripwire(
+        _obs_section(1.01), rec2, "x", backend="cpu"
+    )
+    assert out2 is not None and "prev_overhead_ratio" not in out2
+    assert out2["config_mismatch"] is True
+    # cross-backend prev likewise dropped, but the budget check still runs
+    rec3 = {"metric": "m", "backend": "tpu", "obs_overhead": _obs_section(1.0)}
+    out3 = bench.obs_overhead_tripwire(
+        _obs_section(1.05), rec3, "x", backend="cpu"
+    )
+    assert out3["fired"] and "prev_overhead_ratio" not in out3
+
+
+def test_obs_overhead_tripwire_none_without_current_ratio():
+    assert bench.obs_overhead_tripwire(None) is None
+    assert bench.obs_overhead_tripwire({}) is None
+    assert bench.obs_overhead_tripwire({"rounds": 20}) is None

@@ -1,0 +1,494 @@
+"""Unified observability plane (xgboost_ray_tpu/obs/) tests.
+
+Covers the plane's own guarantees (span nesting, ring-buffer truncation
+accounting, histogram edge cases, Prometheus exposition stability, the
+shared trace-schema validator) and the instrumentation contract: a traced
+``train()`` returns a queryable timeline under
+``additional_results["obs"]``, the ``after_round`` callback streams round
+records live, and a chaos run's shrink→grow story is reconstructible from
+the timeline alone — no driver-log reading, no counter re-derivation.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from xgboost_ray_tpu import (
+    DistributedCallback,
+    RayDMatrix,
+    RayParams,
+    faults,
+    obs,
+    train,
+    validate_trace_records,
+)
+from xgboost_ray_tpu.obs.metrics import (
+    BUCKET_BOUNDS_MS,
+    LatencyHistogram,
+    MetricsRegistry,
+)
+from xgboost_ray_tpu.obs.trace import Tracer, recovery_time_s, use_tracer
+
+_PARAMS = {"objective": "binary:logistic", "eval_metric": ["logloss"],
+           "max_depth": 3}
+
+
+def _data(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    return x, y
+
+
+# ---------------------------------------------------------------------------
+# tracer: spans, events, ring buffer
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_records_parent_and_orders_by_end_time():
+    t = Tracer(enabled=True, trace_dir="")
+    with t.span("outer"):
+        with t.span("inner") as attrs:
+            attrs["k"] = 1
+        t.event("mark", round=2, flag=True)
+    recs = t.records()
+    assert [r["name"] for r in recs] == ["inner", "mark", "outer"]
+    inner, mark, outer = recs
+    # seq preserves START order: outer started first
+    assert outer["seq"] < inner["seq"] < mark["seq"]
+    assert inner["parent"] == outer["seq"]
+    assert outer["parent"] is None
+    assert inner["attrs"] == {"k": 1}
+    assert mark["kind"] == "event" and mark["round"] == 2
+    assert mark["attrs"] == {"flag": True}
+    assert outer["dur_s"] >= inner["dur_s"] >= 0.0
+    assert validate_trace_records(recs) == []
+
+
+def test_span_yields_mutable_attrs_measured_inside():
+    t = Tracer(enabled=True, trace_dir="")
+    with t.span("work", round=7) as attrs:
+        attrs["bytes"] = 1024
+    (rec,) = t.records()
+    assert rec["round"] == 7
+    assert rec["attrs"]["bytes"] == 1024
+
+
+def test_ring_buffer_truncation_is_accounted_never_silent():
+    t = Tracer(capacity=8, enabled=True, trace_dir="")
+    for i in range(20):
+        t.event(f"e{i}")
+    recs = t.records()
+    assert len(recs) == 8
+    # oldest dropped, newest kept
+    assert [r["name"] for r in recs] == [f"e{i}" for i in range(12, 20)]
+    assert t.dropped == 12
+    snap = t.snapshot()
+    assert snap == {"records": 8, "dropped_spans": 12, "capacity": 8}
+
+
+def test_disabled_tracer_records_nothing():
+    t = Tracer(enabled=False, trace_dir="")
+    with t.span("outer"):
+        t.event("e")
+    assert t.records() == []
+    assert t.snapshot()["records"] == 0
+
+
+def test_rxgb_trace_env_disables(monkeypatch):
+    monkeypatch.setenv("RXGB_TRACE", "0")
+    assert Tracer().enabled is False
+    monkeypatch.setenv("RXGB_TRACE", "1")
+    assert Tracer().enabled is True
+
+
+def test_trace_dir_streams_jsonl_matching_ring(tmp_path):
+    t = Tracer(enabled=True, trace_dir=str(tmp_path), rank=3)
+    t.event("a", x=1)
+    with t.span("b"):
+        pass
+    t.close()
+    path = tmp_path / "trace-rank3.jsonl"
+    assert path.exists()
+    streamed = [json.loads(line) for line in path.read_text().splitlines()]
+    assert streamed == t.records()
+    assert validate_trace_records(streamed) == []
+
+
+def test_export_jsonl_roundtrip(tmp_path):
+    t = Tracer(enabled=True, trace_dir="")
+    t.event("a")
+    t.event("b")
+    out = tmp_path / "trace.jsonl"
+    assert t.export_jsonl(str(out)) == 2
+    assert [json.loads(line)["name"]
+            for line in out.read_text().splitlines()] == ["a", "b"]
+
+
+def test_use_tracer_scopes_current_thread():
+    scoped = Tracer(enabled=True, trace_dir="")
+    with use_tracer(scoped):
+        obs.get_tracer().event("inside")
+    assert obs.get_tracer() is not scoped
+    assert [r["name"] for r in scoped.records()] == ["inside"]
+
+
+# ---------------------------------------------------------------------------
+# schema validator + timeline queries
+# ---------------------------------------------------------------------------
+
+
+def test_validate_trace_records_flags_malformed():
+    bad = [
+        {"kind": "span", "name": "a", "ts": 0.0, "seq": 1, "dur_s": 0.1,
+         "parent": None, "extra": 1},                     # unknown key
+        {"kind": "event", "name": "b", "ts": 0.0, "seq": 1},  # dup seq
+        {"kind": "event", "name": "c", "ts": 0.0, "seq": 2, "dur_s": 0.5},
+        {"kind": "nope", "name": "d", "ts": 0.0, "seq": 3},   # bad kind
+        {"kind": "span", "name": "", "ts": "x", "seq": 4, "dur_s": -1,
+         "parent": "p"},
+    ]
+    problems = validate_trace_records(bad)
+    text = "\n".join(problems)
+    assert "unknown keys" in text
+    assert "duplicate seq" in text
+    assert "event carries dur_s" in text
+    assert "bad kind 'nope'" in text
+    assert "bad name" in text and "bad ts" in text
+    assert "bad dur_s" in text and "bad parent" in text
+
+
+def test_recovery_time_s_pairs_failures_with_recoveries():
+    def ev(name, ts):
+        return {"kind": "event", "name": name, "ts": ts, "seq": int(ts * 10)}
+
+    records = [
+        ev("failure.detected", 10.0),
+        ev("recovered", 12.0),          # 2 s
+        ev("failure.detected", 20.0),   # clock restarted by the next one:
+        ev("failure.detected", 23.0),   # repeated failure before progress
+        ev("recovered", 24.0),          # 1 s (from the LATEST failure)
+        ev("recovered", 30.0),          # unmatched: no open clock, ignored
+    ]
+    assert recovery_time_s(records) == pytest.approx(3.0)
+    assert recovery_time_s([]) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# metrics: histogram edge cases, registry, Prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_percentile_interpolates_at_bucket_boundaries():
+    h = LatencyHistogram("h")
+    # one sample: p100 walks to the sample's bucket upper bound; p50 lands
+    # mid-bucket by linear interpolation
+    h.record(1.0)
+    idx = next(
+        i for i, b in enumerate(BUCKET_BOUNDS_MS) if 1.0 <= b
+    )
+    lo = BUCKET_BOUNDS_MS[idx - 1]
+    hi = BUCKET_BOUNDS_MS[idx]
+    assert h.percentile(1.0) == pytest.approx(hi)
+    assert h.percentile(0.5) == pytest.approx(lo + 0.5 * (hi - lo))
+    # a sample at/below the smallest bound interpolates from 0
+    h2 = LatencyHistogram("h2")
+    h2.record(0.0)
+    assert 0.0 <= h2.percentile(0.5) <= BUCKET_BOUNDS_MS[0]
+    # overflow bucket: beyond the largest bound, extrapolated one factor up
+    h3 = LatencyHistogram("h3")
+    h3.record(1e9)
+    assert h3.percentile(1.0) == pytest.approx(BUCKET_BOUNDS_MS[-1] * 1.26)
+    # empty histogram: 0.0, not NaN
+    assert LatencyHistogram("h4").percentile(0.99) == 0.0
+
+
+def test_histogram_rejects_nonfinite_and_clamps_negative():
+    h = LatencyHistogram("h")
+    for bad in (float("nan"), float("inf"), float("-inf")):
+        h.record(bad)
+    assert h.total == 0
+    assert h.sum_ms == 0.0
+    assert h.invalid == 3
+    h.record(-5.0)  # clamps to 0: bucket 0, no sum poisoning
+    assert h.total == 1
+    assert h.sum_ms == 0.0
+    assert h.counts[0] == 1
+    snap = h.snapshot()
+    assert snap["invalid"] == 3 and snap["total"] == 1
+    assert np.isfinite(snap["mean_ms"])
+
+
+def test_histogram_snapshot_is_consistent_under_concurrent_record():
+    h = LatencyHistogram("h")
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            h.record(1.0)
+
+    t = threading.Thread(target=hammer, daemon=True)
+    t.start()
+    try:
+        for _ in range(200):
+            snap = h.snapshot()
+            # every recorded sample is exactly 1.0 ms: a torn read shows up
+            # as counts/total/sum disagreeing with each other
+            assert sum(snap["counts"]) == snap["total"]
+            assert snap["sum_ms"] == pytest.approx(float(snap["total"]))
+    finally:
+        stop.set()
+        t.join(5.0)
+
+
+def test_registry_get_or_create_and_type_conflicts():
+    reg = MetricsRegistry()
+    c = reg.counter("rxgb_test_total")
+    assert reg.counter("rxgb_test_total") is c
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("rxgb_test_total")
+    with pytest.raises(ValueError, match="invalid metric name"):
+        reg.counter("bad name!")
+
+
+def test_prometheus_exposition_golden():
+    """The exposition is byte-stable for a given registry state: metrics
+    sorted by name, histogram buckets ascending and cumulative, counts as
+    bare ints — the contract a scrape config and this golden pin rely on."""
+    reg = MetricsRegistry()
+    reg.counter("rxgb_b_total", "b help").inc(3)
+    reg.gauge("rxgb_a").set(2.5)
+    h = reg.histogram("rxgb_lat_ms")
+    h.record(0.04)   # bucket 0 (le 0.05)
+    h.record(0.06)   # bucket 1 (le 0.063)
+    h.record(1e9)    # overflow (+Inf only)
+    text = reg.prometheus_text()
+    lines = text.splitlines()
+    # deterministic name ordering: a, b, lat
+    assert lines[0] == "# TYPE rxgb_a gauge"
+    assert lines[1] == "rxgb_a 2.5"
+    assert lines[2] == "# HELP rxgb_b_total b help"
+    assert lines[3] == "# TYPE rxgb_b_total counter"
+    assert lines[4] == "rxgb_b_total 3"
+    assert lines[5] == "# TYPE rxgb_lat_ms histogram"
+    assert lines[6] == 'rxgb_lat_ms_bucket{le="0.05"} 1'
+    assert lines[7] == 'rxgb_lat_ms_bucket{le="0.063"} 2'
+    # cumulative counts: every later bucket carries the running total
+    assert 'rxgb_lat_ms_bucket{le="+Inf"} 3' in lines
+    assert lines[-2] == "rxgb_lat_ms_sum 1000000000.1"
+    assert lines[-1] == "rxgb_lat_ms_count 3"
+    # bucket lines are sorted ascending by le
+    les = [
+        float(line.split('le="')[1].split('"')[0])
+        for line in lines
+        if 'le="' in line and "+Inf" not in line
+    ]
+    assert les == sorted(les)
+    # a second render of the same state is byte-identical
+    assert reg.prometheus_text() == text
+
+
+def test_registry_snapshot_flattens_and_live_gauge():
+    reg = MetricsRegistry()
+    reg.counter("rxgb_c_total").inc(2)
+    reg.gauge("rxgb_live", fn=lambda: 7)
+    reg.histogram("rxgb_h_ms").record(3.0)
+    snap = reg.snapshot()
+    assert snap["rxgb_c_total"] == 2
+    assert snap["rxgb_live"] == 7
+    assert "counts" not in snap["rxgb_h_ms"]
+    assert snap["rxgb_h_ms"]["total"] == 1
+    # a dead live-gauge probe must not kill the export
+    reg.gauge("rxgb_dead", fn=lambda: 1 / 0)
+    assert np.isnan(reg.snapshot()["rxgb_dead"])
+    assert "rxgb_dead NaN" in reg.prometheus_text()
+
+
+# ---------------------------------------------------------------------------
+# instrumentation contract: train() timeline, after_round, chaos story
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _fast_restarts(monkeypatch):
+    monkeypatch.setenv("RXGB_RESTART_BACKOFF_BASE_S", "0")
+    yield
+    faults.clear_plan()
+
+
+def test_train_returns_queryable_timeline():
+    x, y = _data()
+    res = {}
+    train(_PARAMS, RayDMatrix(x, y), 3, additional_results=res,
+          ray_params=RayParams(num_actors=2, checkpoint_frequency=2))
+    o = res["obs"]
+    assert validate_trace_records(o["timeline"]) == []
+    assert o["dropped_spans"] == 0
+    # one round record per boosting round, attributed with world/rows
+    assert [r["round"] for r in o["rounds"]] == [0, 1, 2]
+    assert all(r["world"] == 2 and r["rows"] == len(x) for r in o["rounds"])
+    assert all(r["dur_s"] >= 0 for r in o["rounds"])
+    # lifecycle events: checkpoint commits carry their round index
+    ck = [e for e in o["events"] if e["name"] == "checkpoint.commit"]
+    assert [e["round"] for e in ck] == [1, 2]
+    # the attempt span closes over the whole run
+    attempts = [r for r in o["timeline"]
+                if r["kind"] == "span" and r["name"] == "attempt"]
+    assert len(attempts) == 1
+    assert attempts[0]["attrs"]["outcome"] == "ok"
+
+
+def test_train_trace_disabled_omits_obs(monkeypatch):
+    monkeypatch.setenv("RXGB_TRACE", "0")
+    x, y = _data()
+    res = {}
+    train(_PARAMS, RayDMatrix(x, y), 2, additional_results=res,
+          ray_params=RayParams(num_actors=2, checkpoint_frequency=0))
+    assert "obs" not in res
+
+
+def test_train_streams_per_rank_jsonl(monkeypatch, tmp_path):
+    monkeypatch.setenv("RXGB_TRACE_DIR", str(tmp_path))
+    x, y = _data()
+    res = {}
+    train(_PARAMS, RayDMatrix(x, y), 2, additional_results=res,
+          ray_params=RayParams(num_actors=2, checkpoint_frequency=0))
+    files = sorted(os.listdir(tmp_path))
+    assert files == ["trace-rank0.jsonl"]
+    streamed = [
+        json.loads(line)
+        for line in (tmp_path / "trace-rank0.jsonl").read_text().splitlines()
+    ]
+    assert validate_trace_records(streamed) == []
+    names = {r["name"] for r in streamed}
+    assert "round" in names
+
+
+def test_after_round_callback_streams_round_records():
+    class Collect(DistributedCallback):
+        def __init__(self):
+            self.records = []
+
+        def after_round(self, actor, record, *args, **kwargs):
+            self.records.append((actor.rank, record))
+
+    cb = Collect()
+    x, y = _data()
+    dtrain = RayDMatrix(x, y)
+    train(_PARAMS, dtrain, 3, evals=[(dtrain, "train")],
+          ray_params=RayParams(num_actors=2, checkpoint_frequency=0,
+                               distributed_callbacks=[cb]))
+    # fan-out: one record per (round, actor)
+    assert len(cb.records) == 3 * 2
+    rounds_seen = sorted({rec["round"] for _, rec in cb.records})
+    assert rounds_seen == [0, 1, 2]
+    for rank, rec in cb.records:
+        assert rec["world"] == 2
+        assert rec["duration_s"] >= 0
+        assert "logloss" in rec["metrics"]["train"]
+
+
+def test_pre_obs_callbacks_without_after_round_still_work():
+    """Duck-typed callbacks written against the original (pre-obs) hook
+    surface — no after_round at all — must keep working through the
+    container fan-out."""
+
+    class Legacy:  # deliberately NOT a DistributedCallback subclass
+        hooks = []
+
+        def on_init(self, actor, *args, **kwargs):
+            self.hooks.append("on_init")
+
+        def before_data_loading(self, actor, data, *args, **kwargs):
+            pass
+
+        def after_data_loading(self, actor, data, *args, **kwargs):
+            pass
+
+        def before_train(self, actor, *args, **kwargs):
+            pass
+
+        def after_train(self, actor, result_dict, *args, **kwargs):
+            self.hooks.append("after_train")
+
+        def before_predict(self, actor, *args, **kwargs):
+            pass
+
+        def after_predict(self, actor, predictions, *args, **kwargs):
+            pass
+
+    x, y = _data()
+    train(_PARAMS, RayDMatrix(x, y), 2,
+          ray_params=RayParams(num_actors=2, checkpoint_frequency=0,
+                               distributed_callbacks=[Legacy()]))
+    assert "after_train" in Legacy.hooks
+
+
+def test_chaos_shrink_grow_sequence_reconstructible_from_timeline(monkeypatch):
+    """The acceptance scenario: kill → shrink → boundary grow leaves a
+    machine-readable timeline — fault.injected, failure.detected,
+    world.shrink and world.grow events in order with correct round
+    indices — so the chaos story no longer needs driver logs or counter
+    re-derivation."""
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_RESOURCE_CHECK_S", "0")
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_GRACE_PERIOD_S", "0")
+    x, y = _data(512)
+    kill_round = 3
+    plan = faults.FaultPlan(rules=[
+        {"site": "actor.train_round", "action": "raise", "ranks": [1],
+         "match": {"round": kill_round}},
+        # hold rank 1's reload past the scheduler's fast path so the world
+        # actually shrinks, then grows back at a later round boundary
+        {"site": "actor.load_shard", "action": "delay", "delay_s": 2.0,
+         "match": {"rank": 1}, "at": 2},
+    ])
+    res = {}
+    with faults.active_plan(plan):
+        bst = train(_PARAMS, RayDMatrix(x, y), 16, additional_results=res,
+                    ray_params=RayParams(num_actors=2, elastic_training=True,
+                                         max_failed_actors=1,
+                                         max_actor_restarts=2,
+                                         checkpoint_frequency=4))
+    assert bst.num_boosted_rounds() == 16
+    o = res["obs"]
+    assert validate_trace_records(o["timeline"]) == []
+
+    by_name = {}
+    for e in o["events"]:
+        by_name.setdefault(e["name"], []).append(e)
+    assert len(by_name["fault.injected"]) >= 1
+    assert by_name["fault.injected"][0]["attrs"]["site"] == \
+        "actor.train_round"
+    (shrink,) = by_name["world.shrink"]
+    (grow,) = by_name["world.grow"]
+    # rounds 0..kill_round-1 boosted before the kill: the shrunk world takes
+    # over AT the kill round; the grow lands at a later round boundary
+    assert shrink["round"] == kill_round
+    assert shrink["attrs"]["world"] == 1
+    assert shrink["attrs"]["orphaned_rows"] == len(x) // 2
+    assert grow["round"] > kill_round
+    assert grow["attrs"]["world"] == 2
+    # ordering: injection → detection → shrink → grow, by seq
+    seqs = [
+        by_name["fault.injected"][0]["seq"],
+        by_name["failure.detected"][0]["seq"],
+        shrink["seq"],
+        grow["seq"],
+    ]
+    assert seqs == sorted(seqs)
+    # per-round spans attribute the world size through the change: full
+    # world before the kill, survivor world at the kill round, full world
+    # again from the grow boundary on
+    worlds = {r["round"]: r["world"] for r in o["rounds"]}
+    assert worlds[kill_round - 1] == 2
+    assert worlds[kill_round] == 1
+    if grow["round"] < 16:
+        assert worlds[grow["round"]] == 2
+    # the timeline's failure→recovery clock matches the robustness dict's
+    ttr = recovery_time_s(o["timeline"])
+    assert ttr == pytest.approx(
+        res["robustness"]["time_to_recover_s"], abs=0.05
+    )

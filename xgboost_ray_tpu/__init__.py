@@ -39,7 +39,8 @@ from xgboost_ray_tpu.matrix import (
 from xgboost_ray_tpu.data_sources import RayFileType
 from xgboost_ray_tpu.models.booster import Booster, RayXGBoostBooster
 from xgboost_ray_tpu.callback import DistributedCallback, TrainingCallback
-from xgboost_ray_tpu import faults
+from xgboost_ray_tpu import faults, obs
+from xgboost_ray_tpu.obs import validate_trace_records
 from xgboost_ray_tpu.launcher import (
     AsyncCheckpointWriter,
     LaunchContext,
@@ -69,6 +70,8 @@ __all__ = [
     "DistributedCallback",
     "TrainingCallback",
     "faults",
+    "obs",
+    "validate_trace_records",
     "LaunchContext",
     "LaunchResult",
     "launch_distributed",

@@ -26,6 +26,14 @@ class DistributedCallback:
     def after_train(self, actor, result_dict, *args, **kwargs):
         pass
 
+    def after_round(self, actor, round_record, *args, **kwargs):
+        """Fired once per boosting round with the obs round record
+        (``{"round", "iteration", "duration_s", "world", "metrics"}``) so
+        user code can stream per-round metrics live instead of parsing
+        ``additional_results`` post hoc. One extra hook over the reference
+        surface; default no-op keeps ported callbacks working unchanged."""
+        pass
+
     def before_predict(self, actor, *args, **kwargs):
         pass
 
@@ -56,6 +64,14 @@ class DistributedCallbackContainer:
     def after_train(self, actor, result_dict, *args, **kwargs):
         for callback in self.callbacks:
             callback.after_train(actor, result_dict, *args, **kwargs)
+
+    def after_round(self, actor, round_record, *args, **kwargs):
+        for callback in self.callbacks:
+            # subclasses written against the original (pre-obs) hook set
+            # may not define after_round; don't break them
+            hook = getattr(callback, "after_round", None)
+            if hook is not None:
+                hook(actor, round_record, *args, **kwargs)
 
     def before_predict(self, actor, *args, **kwargs):
         for callback in self.callbacks:

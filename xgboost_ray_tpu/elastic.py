@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from xgboost_ray_tpu import obs
 from xgboost_ray_tpu.exceptions import RayActorError, RayXGBoostActorAvailable
 
 logger = logging.getLogger(__name__)
@@ -119,6 +120,10 @@ def _maybe_schedule_new_actors(
         rob["elastic_reschedules"] = (
             rob.get("elastic_reschedules", 0) + len(started)
         )
+        obs.get_tracer().event(
+            "elastic.reschedule",
+            attrs={"ranks": [r for r, _ in started]},
+        )
     return scheduled
 
 
@@ -162,6 +167,16 @@ def _update_scheduled_actor_states(training_state, raise_on_ready: bool = True):
         return False
     if now >= training_state.restart_training_at:
         training_state.restart_training_at = None
+        obs.get_tracer().event(
+            "elastic.ready",
+            attrs={
+                "ranks": sorted(
+                    r for r, p in training_state.pending_actors.items()
+                    if p.ready
+                ),
+                "mode": "restart" if raise_on_ready else "grow",
+            },
+        )
         if raise_on_ready:
             raise RayXGBoostActorAvailable(
                 "A new worker became available for training. Restarting from "

@@ -24,6 +24,7 @@ reference's split between actor hot loop and driver control flow.
 """
 
 import logging
+import time
 from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
@@ -32,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from xgboost_ray_tpu import obs
 from xgboost_ray_tpu.compat import shard_map_compat
 from xgboost_ray_tpu.models.booster import RayXGBoostBooster, stack_trees
 from xgboost_ray_tpu.ops import binning
@@ -481,6 +483,18 @@ class TpuEngine:
         # device-resident payload-byte counter of the latest round's tree
         # allreduces (materialized lazily — see hist_allreduce_bytes_per_round)
         self._ar_bytes_dev = None
+        # static attributes attached to every "round" span: world size, row
+        # counts, and (when sampling is on) the per-shard compacted budget —
+        # the "sampling budgets become span attributes" half of the obs plane
+        samp_spec = sampling.spec_from_params(params)
+        self._obs_round_attrs = {
+            "world": int(self.n_devices),
+            "rows": int(self.n_rows),
+        }
+        if samp_spec is not None:
+            self._obs_round_attrs["sample_rows_per_shard"] = int(
+                sampling.row_budget(self.pad_to // self.n_devices, samp_spec)
+            )
         if self.dart:
             self._init_dart_forest()
         self.iteration_offset = (
@@ -1015,6 +1029,23 @@ class TpuEngine:
     def can_batch_rounds(self) -> bool:
         return not self._host_metrics and not self.dart
 
+    def _emit_round_spans(self, ts, t0, round0: int, n_rounds: int = 1) -> None:
+        """Record per-round spans on the current tracer, fenced by the same
+        host-side sync the step paths already perform (no extra device round
+        trips). Fused-scan chunks amortize the chunk duration evenly and mark
+        each span with ``fused_chunk`` so consumers know the granularity."""
+        tracer = obs.get_tracer()
+        if not tracer.enabled:
+            return
+        dur = (time.perf_counter() - t0) / max(n_rounds, 1)
+        attrs = self._obs_round_attrs
+        if n_rounds > 1:
+            attrs = dict(attrs, fused_chunk=n_rounds)
+        for r in range(n_rounds):
+            tracer.add_span(
+                "round", ts + r * dur, dur, round=round0 + r, attrs=attrs
+            )
+
     def step_many(self, iteration0: int, n_rounds: int) -> List[Dict[str, Dict[str, float]]]:
         """Run ``n_rounds`` boosting rounds in one compiled program.
 
@@ -1025,6 +1056,7 @@ class TpuEngine:
         """
         if not self.can_batch_rounds():
             raise RuntimeError("host-side metrics require per-round stepping")
+        span_ts, span_t0 = time.time(), time.perf_counter()
         if self._scan_fn is None:
             self._scan_fn = self._make_scan_step()
         iterations = jnp.arange(
@@ -1082,6 +1114,9 @@ class TpuEngine:
             # tunneled relay block_until_ready does not reliably block)
             shard0 = new_margins.addressable_shards[0].data
             np.asarray(shard0[:1, :1])
+        self._emit_round_spans(
+            span_ts, span_t0, self.iteration_offset + iteration0, n_rounds
+        )
         results: List[Dict[str, Dict[str, float]]] = []
         for r in range(n_rounds):
             round_res: Dict[str, Dict[str, float]] = {}
@@ -1105,6 +1140,7 @@ class TpuEngine:
             if gh_custom is not None:
                 raise ValueError("custom objectives are not supported with dart")
             return self.step_dart(iteration)
+        span_ts, span_t0 = time.time(), time.perf_counter()
         custom = gh_custom is not None
         if custom:
             if self._step_fn_custom is None:
@@ -1188,6 +1224,9 @@ class TpuEngine:
                         metric=name,
                     )
             results[es.name] = row
+        self._emit_round_spans(
+            span_ts, span_t0, self.iteration_offset + iteration
+        )
         return results
 
     def _host_metric_value(self, name: str, margin: np.ndarray, es) -> float:
@@ -1624,6 +1663,7 @@ class TpuEngine:
 
     def step_dart(self, iteration: int) -> Dict[str, Dict[str, float]]:
         params = self.params
+        span_ts, span_t0 = time.time(), time.perf_counter()
         if self._dart_fn is None:
             self._dart_fn = self._make_dart_step()
         lr = params.learning_rate
@@ -1699,7 +1739,224 @@ class TpuEngine:
                         metric=name,
                     )
             results[es.name] = row
+        self._emit_round_spans(
+            span_ts, span_t0, self.iteration_offset + iteration
+        )
         return results
+
+    # ------------------------------------------------------------------
+    # Fenced per-phase profiling (the obs plane's runtime replacement for
+    # bench.py's former standalone phase timers).
+    # ------------------------------------------------------------------
+
+    def profile_phases(self, tracer=None, iters: int = 3) -> Dict[str, Any]:
+        """Micro-time each round phase (``sample`` / ``hist`` / ``split`` /
+        ``partition`` / ``margin`` / ``allreduce``) standalone at THIS
+        engine's true per-shard shapes, emitting one span per phase on the
+        current tracer with compile-vs-execute separated via
+        ``jax.block_until_ready`` and rows/bytes attributes attached.
+
+        The compiled round step fuses these phases (XLA may overlap them),
+        so this is a phase-share approximation, not an in-program trace —
+        but it runs against the engine's real shard block size, sampling
+        budget, resolved hist impl and split params, so the breakdown
+        reflects the program that actually trains. Returns the
+        ``phase_profile`` dict that ``train()`` surfaces under
+        ``additional_results["obs"]`` when ``RXGB_TRACE_PHASES=1``."""
+        import functools
+
+        from xgboost_ray_tpu.ops.grow import empty_tree, route_right_binned
+        from xgboost_ray_tpu.ops.histogram import build_histogram
+        from xgboost_ray_tpu.ops.split import find_splits
+
+        tracer = tracer if tracer is not None else obs.get_tracer()
+        n_local = self.pad_to // self.n_devices  # one shard's row block
+        n_feat = self.n_features
+        depth = self.cfg.max_depth
+        max_bin = self.params.max_bin
+        nbt = max_bin + 1
+        impl = self.cfg.hist_impl
+        spec = sampling.spec_from_params(self.params)
+        m = n_local if spec is None else sampling.row_budget(n_local, spec)
+
+        rng = np.random.RandomState(0)
+        bins = jnp.asarray(
+            rng.randint(0, max_bin, size=(n_local, n_feat)), jnp.uint8
+        )
+        gh = jnp.asarray(
+            np.stack(
+                [rng.standard_normal(n_local),
+                 np.abs(rng.standard_normal(n_local))],
+                axis=1,
+            ),
+            jnp.float32,
+        )
+        valid = jnp.ones((n_local,), bool)
+        key = jax.random.PRNGKey(0)
+
+        def fenced(fn, *args):
+            """(compile_s, execute_s): the first call carries compile; the
+            steady mean over ``iters`` further calls is execute — every
+            timing fenced by block_until_ready."""
+            t0 = time.perf_counter()
+            out = fn(*args)
+            jax.block_until_ready(out)
+            first = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(*args)
+            jax.block_until_ready(out)
+            execute = (time.perf_counter() - t0) / iters
+            return max(first - execute, 0.0), execute
+
+        phases: Dict[str, Dict[str, Any]] = {}
+
+        def emit(name, compile_s, execute_s, rows, **extra):
+            attrs = {"compile_s": round(compile_s, 6), "rows": int(rows)}
+            attrs.update(extra)
+            tracer.add_span(name, time.time(), execute_s, attrs=attrs)
+            phases[name] = {
+                "compile_ms": round(1e3 * compile_s, 3),
+                "execute_ms": round(1e3 * execute_s, 3),
+                "rows": int(rows),
+                **extra,
+            }
+
+        # -- sample: budget selection + row gather (absent for full rows)
+        if spec is None:
+            emit("sample", 0.0, 0.0, n_local)
+            bins_m, gh_m = bins, gh
+        else:
+            sample_fn = jax.jit(
+                lambda g, v, k, _s=spec: sampling.sample_rows(g, v, k, _s)
+            )
+            gather_fn = jax.jit(lambda r: bins[r])
+            rows_sel, gh_m = sample_fn(gh, valid, key)
+            c1, e1 = fenced(sample_fn, gh, valid, key)
+            c2, e2 = fenced(gather_fn, rows_sel)
+            bins_m = gather_fn(rows_sel)
+            emit("sample", c1 + c2, e1 + e2, m)
+
+        # -- hist + partition, per level (sibling subtraction halves the
+        # built fan-out beyond the root, exactly as the real builds do)
+        hist_c = hist_e = part_c = part_e = 0.0
+        split_c = split_e = 0.0
+        for d in range(depth):
+            n_nodes = 1 << d
+            build_nodes = max(1, n_nodes // 2) if d > 0 else 1
+            pos = jnp.asarray(
+                rng.randint(0, build_nodes, size=(m,)), jnp.int32
+            )
+            hist_fn = jax.jit(
+                functools.partial(
+                    build_histogram,
+                    n_nodes=build_nodes,
+                    n_bins_total=nbt,
+                    impl=impl,
+                    chunk=self.cfg.hist_chunk,
+                )
+            )
+            c, e = fenced(hist_fn, bins_m, gh_m, pos)
+            hist_c, hist_e = hist_c + c, hist_e + e
+
+            hist = jnp.asarray(
+                rng.standard_normal((n_nodes, n_feat, nbt, 2)), jnp.float32
+            )
+            node_gh = hist[:, 0, :, :].sum(axis=1)
+            split_fn = jax.jit(
+                lambda h, ng, _p=self.cfg.split: find_splits(h, ng, _p)
+            )
+            c, e = fenced(split_fn, hist, node_gh)
+            split_c, split_e = split_c + c, split_e + e
+
+            pos_lvl = jnp.asarray(
+                rng.randint(0, n_nodes, size=(m,)), jnp.int32
+            )
+            sbin = jnp.asarray(
+                rng.randint(0, max_bin - 1, size=(n_nodes,)), jnp.int32
+            )
+
+            def part_fn(b, p, sb):
+                bv = b[:, 0].astype(jnp.int32)
+                go_right = route_right_binned(
+                    bv, sb[p], jnp.zeros_like(sb, bool)[p], None, max_bin
+                )
+                return p * 2 + go_right.astype(jnp.int32)
+
+            c, e = fenced(jax.jit(part_fn), bins_m, pos_lvl, sbin)
+            part_c, part_e = part_c + c, part_e + e
+        emit("hist", hist_c, hist_e, m, impl=impl)
+        emit("split", split_c, split_e, m)
+        emit("partition", part_c, part_e, m)
+
+        # -- margin: the once-per-tree full-row walk sampled builds pay
+        # (full-row builds fuse the margin update into the build itself)
+        if spec is None:
+            emit("margin", 0.0, 0.0, n_local, fused_into_build=True)
+        else:
+            tree = empty_tree((1 << (depth + 1)) - 1)
+            tree = tree._replace(
+                feature=jnp.asarray(
+                    rng.randint(0, n_feat, tree.feature.shape), jnp.int32
+                ),
+                split_bin=jnp.asarray(
+                    rng.randint(0, max_bin - 1, tree.split_bin.shape),
+                    jnp.int32,
+                ),
+            )
+            walk_fn = jax.jit(
+                lambda t, b: predict_tree_binned(t, b, depth, max_bin)
+            )
+            c, e = fenced(walk_fn, tree, bins)
+            emit("margin", c, e, n_local)
+
+        # -- allreduce: one psum of the deepest built level's histogram over
+        # the real mesh, with the whole round's ring-model payload attached
+        # (measured from the trained program when a round has run)
+        last_level = depth - 1
+        last_nodes = (
+            max(1, (1 << last_level) // 2) if last_level > 0 else 1
+        )
+        arr = jnp.zeros((last_nodes, n_feat, nbt, 2), jnp.float32)
+        ar_fn = jax.jit(
+            shard_map(
+                lambda a: jax.lax.psum(a, "actors"),
+                mesh=self.mesh,
+                in_specs=(P(),),
+                out_specs=P(),
+            )
+        )
+        c, e = fenced(ar_fn, arr)
+        measured = self.hist_allreduce_bytes_per_round()
+        if measured is None:
+            counter = AllreduceBytes(self.n_devices)
+            for d in range(depth):
+                bn = max(1, (1 << d) // 2) if d > 0 else 1
+                counter.add_allreduce(
+                    np.zeros((bn, n_feat, nbt, 2), np.float32)
+                )
+            measured = counter.total
+        emit("allreduce", c, e, m, bytes_per_round=int(measured))
+
+        total_ms = round(sum(p["execute_ms"] for p in phases.values()), 3)
+        return {
+            "rows_per_shard": int(n_local),
+            "sample_rows": int(m),
+            "phases": phases,
+            "total_execute_ms": total_ms,
+            "config": {
+                "features": int(n_feat),
+                "depth": int(depth),
+                "max_bin": int(max_bin),
+                "impl": impl,
+                "world": int(self.n_devices),
+                "note": (
+                    "standalone jitted phases fenced with block_until_ready; "
+                    "compile-vs-execute separated; phase-share approximation "
+                    "— the compiled round fuses phases"
+                ),
+            },
+        }
 
 
 def shard_layout_fingerprint(shards) -> tuple:

@@ -225,11 +225,13 @@ class FaultPlan:
         for i in self._due(site, ctx):
             rule = self.rules[i]
             if rule.action in ("corrupt", "truncate"):
+                _emit_fault_event(site, rule.action, ctx)
                 self._damage_file(rule, path, self._rngs[i])
             else:
                 self._perform(rule, site, ctx)
 
     def _perform(self, rule: FaultRule, site: str, ctx: Dict[str, Any]):
+        _emit_fault_event(site, rule.action, ctx)
         msg = rule.message or f"injected fault at {site} ({ctx})"
         if rule.action == "raise":
             if rule.ranks is not None:
@@ -267,6 +269,24 @@ class FaultPlan:
                 byte = f.read(1)
                 f.seek(pos)
                 f.write(bytes([byte[0] ^ 0xFF]))
+
+
+def _emit_fault_event(site: str, action: str, ctx: Dict[str, Any]) -> None:
+    """Record every injected fault on the current trace timeline, so a
+    chaos run's machine-readable story starts at the injection itself.
+    Lazily imported (obs is stdlib-only) and failure-proof: observability
+    must never alter the chaos under test."""
+    try:
+        from xgboost_ray_tpu import obs
+
+        attrs = {"site": site, "action": action}
+        attrs.update({
+            k: v for k, v in ctx.items()
+            if isinstance(v, (str, int, float, bool))
+        })
+        obs.get_tracer().event("fault.injected", **attrs)
+    except Exception:  # noqa: BLE001 - never fail the fault path
+        pass
 
 
 # ---------------------------------------------------------------------------

@@ -51,7 +51,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from xgboost_ray_tpu import faults
+from xgboost_ray_tpu import faults, obs
 from xgboost_ray_tpu.util import restart_backoff_s
 
 logger = logging.getLogger(__name__)
@@ -238,6 +238,10 @@ def save_round_checkpoint(
                     pass
     if fsync:
         _fsync_dir(os.path.dirname(path))
+    obs.get_tracer().event(
+        "checkpoint.commit", round=int(completed_round),
+        attrs={"path": path, "bytes": os.path.getsize(path)},
+    )
     # chaos hook LAST: a corrupt/truncate rule damages the COMMITTED newest
     # checkpoint (post-write disk corruption), which load must survive
     faults.fire_file("checkpoint.save", path, round=int(completed_round))
@@ -387,6 +391,11 @@ def load_round_checkpoint(path: Optional[str]) -> Tuple[Optional[Any], int]:
                     "from retained fallback %s (%d rounds).",
                     path, cand, booster.num_boosted_rounds(),
                 )
+            obs.get_tracer().event(
+                "checkpoint.load",
+                attrs={"rounds": booster.num_boosted_rounds(),
+                       "fallback": cand != path},
+            )
             return booster, booster.num_boosted_rounds()
     # no candidate passed integrity. A sha mismatch can also be a STALE
     # sidecar (a kill between the model rename and the sidecar rename), so
@@ -580,6 +589,10 @@ def _run_attempts(
             )
             log_f.close()
             paths.append((result_path, log_path, heartbeat_path, pid_))
+        obs.get_tracer().event(
+            "launcher.spawn",
+            attrs={"attempt": attempt, "world": len(local_ids)},
+        )
 
         deadline = time.monotonic() + timeout_s
         attempt_failed = False
@@ -610,6 +623,20 @@ def _run_attempts(
                 if hung_ids:
                     # a stalled world never trips the coordination service
                     # (nobody died) — flag it long before the global timeout
+                    obs.get_tracer().event(
+                        "launcher.hung",
+                        attrs={
+                            "attempt": attempt,
+                            "ranks": sorted(hung_ids),
+                            "heartbeat_age_s": round(
+                                max(
+                                    now - os.path.getmtime(hb)
+                                    if os.path.exists(hb) else now - spawned_at
+                                    for _, _, hb, _ in paths
+                                ), 3,
+                            ),
+                        },
+                    )
                     attempt_failed = True
                     break
             time.sleep(poll_interval)
@@ -687,6 +714,11 @@ def _run_attempts(
                 "%.2fs).",
                 why, attempt - 1, checkpoint_path, restarts, max_restarts,
                 backoff,
+            )
+            obs.get_tracer().event(
+                "launcher.attempt_failed",
+                attrs={"attempt": attempt - 1, "reason": why,
+                       "restart": restarts, "backoff_s": round(backoff, 4)},
             )
             if backoff > 0:
                 time.sleep(backoff)

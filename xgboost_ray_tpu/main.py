@@ -33,7 +33,7 @@ from xgboost_ray_tpu.callback import (
     DistributedCallbackContainer,
     TrainingCallback,
 )
-from xgboost_ray_tpu import faults
+from xgboost_ray_tpu import faults, obs
 from xgboost_ray_tpu.engine import TpuEngine
 from xgboost_ray_tpu.exceptions import (
     RayActorError,
@@ -307,14 +307,18 @@ def _mark_recovered(state: "_TrainingState") -> None:
     state.consecutive_failures = 0
     if state.recover_started_at is None:
         return
+    delta = time.time() - state.recover_started_at
     rob = state.additional_results.get("robustness")
     if rob is not None:
         rob["time_to_recover_s"] = round(
-            rob.get("time_to_recover_s", 0.0)
-            + (time.time() - state.recover_started_at),
-            4,
+            rob.get("time_to_recover_s", 0.0) + delta, 4,
         )
     state.recover_started_at = None
+    # timeline closure of the clock the matching "failure.detected" opened:
+    # bench --chaos reconstructs time-to-recover from these two timestamps
+    obs.get_tracer().event(
+        "recovered", attrs={"time_to_recover_s": round(delta, 4)}
+    )
 
 
 def _create_actor(
@@ -424,6 +428,10 @@ def _handle_queue(queue: Queue, checkpoint: _Checkpoint, callback_returns: Dict)
         elif isinstance(item, _Checkpoint):
             checkpoint.iteration = item.iteration
             checkpoint.value = item.value
+            obs.get_tracer().event(
+                "checkpoint.commit", round=item.iteration,
+                attrs={"bytes": len(item.value or b"")},
+            )
         else:
             callback_returns.setdefault(rank, []).append(item)
 
@@ -441,6 +449,9 @@ def _record_allreduce_bytes(state, engine) -> None:
         return
     if val is not None:
         state.additional_results["hist_allreduce_bytes_per_round"] = val
+        obs.get_tracer().event(
+            "allreduce.bytes", attrs={"bytes_per_round": int(val)}
+        )
 
 
 def _stop_profile_if_running():
@@ -452,6 +463,51 @@ def _stop_profile_if_running():
         jax.profiler.stop_trace()
     except Exception:  # noqa: BLE001 - no trace running
         pass
+
+
+def _maybe_profile_phases(engine, state) -> None:
+    """End-of-training fenced phase profiling (``RXGB_TRACE_PHASES=1``):
+    emits sample/hist/split/partition/margin/allreduce spans at the
+    engine's true shard shapes and stashes the table for
+    ``additional_results["obs"]["phase_profile"]``. Runs after the round
+    loop so the standalone phase programs never pollute steady-round
+    timings."""
+    if not obs.phase_profiling_enabled():
+        return
+    tracer = obs.get_tracer()
+    if not tracer.enabled:
+        return
+    profiler = getattr(engine, "profile_phases", None)
+    if profiler is None:
+        return  # gblinear's LinearEngine has no tree phases
+    try:
+        state.additional_results["_obs_phase_profile"] = profiler(tracer)
+    except Exception as exc:  # noqa: BLE001 - diagnostics never fail training
+        logger.warning("[RayXGBoost] phase profiling failed: %s", exc)
+
+
+def _assemble_obs(tracer, state) -> Dict:
+    """The ``additional_results["obs"]`` payload: full timeline plus the
+    derived per-round and event views and the ring-buffer accounting
+    (dropped records are surfaced, never silent)."""
+    records = tracer.records()
+    rounds = []
+    for rec in records:
+        if rec.get("kind") == "span" and rec.get("name") == "round":
+            row = {"round": rec.get("round"), "dur_s": rec["dur_s"]}
+            row.update(rec.get("attrs") or {})
+            rounds.append(row)
+    out = {
+        "timeline": records,
+        "rounds": rounds,
+        "events": [r for r in records if r.get("kind") == "event"],
+        "dropped_spans": tracer.dropped,
+        "capacity": tracer.capacity,
+    }
+    profile = state.additional_results.pop("_obs_phase_profile", None)
+    if profile is not None:
+        out["phase_profile"] = profile
+    return out
 
 
 class _FauxDMatrix:
@@ -767,6 +823,21 @@ def _train(
     engine_base = 0
     rob = state.additional_results.get("robustness", {})
 
+    def _fire_after_round(i_attempt, round_metrics, duration_s):
+        """Fan the obs round record out to the distributed callbacks."""
+        if not ray_params.distributed_callbacks:
+            return
+        record = {
+            "round": attempt_offset0 + i_attempt,
+            "iteration": i_attempt,
+            "duration_s": duration_s,
+            "world": sum(1 for a in state.actors if a is not None),
+            "metrics": round_metrics,
+        }
+        for actor in state.actors:
+            if actor is not None:
+                actor._distributed_callbacks.after_round(actor, record)
+
     def _schedule_replacements(force=False):
         if ENV.ELASTIC_RESTART_DISABLED:
             return
@@ -795,18 +866,33 @@ def _train(
         engine_base = engine.iteration_offset - attempt_offset0
         new_alive = [a for a in state.actors if a is not None]
         new_total = sum(a.local_n(dtrain) for a in new_alive)
+        orphaned = max(0, total_n - new_total) if kind == "shrink" else 0
         if kind == "shrink":
             rob["shrinks"] = rob.get("shrinks", 0) + 1
-            rob["orphaned_rows"] = (
-                rob.get("orphaned_rows", 0) + max(0, total_n - new_total)
-            )
+            rob["orphaned_rows"] = rob.get("orphaned_rows", 0) + orphaned
         elif kind == "grow":
             rob["grows"] = rob.get("grows", 0) + 1
+        recompile_s = round(time.time() - started, 4)
         rob["recompile_s"] = round(
-            rob.get("recompile_s", 0.0) + (time.time() - started), 4
+            rob.get("recompile_s", 0.0) + recompile_s, 4
         )
         total_n = new_total
         state.additional_results["total_n"] = total_n
+        # the machine-readable world-change record: the timeline entry every
+        # chaos scenario reconstructs its shrink→grow sequence from. The
+        # current global round is offset + trees boosted on this engine —
+        # offset alone is stale when the immediate-reintegration fast path
+        # reuses the attempt's compiled engine mid-flight.
+        obs.get_tracer().event(
+            f"world.{kind}",
+            round=engine.iteration_offset + engine.num_round_trees,
+            attrs={
+                "world": len(new_alive),
+                "orphaned_rows": orphaned,
+                "recompile_s": recompile_s,
+            },
+        )
+        obs.get_registry().counter(f"rxgb_train_{kind}s_total").inc()
 
     def _world_is_current(world_actors):
         """True when ``world_actors`` is exactly the world the CURRENT
@@ -898,6 +984,14 @@ def _train(
             state.elastic_dead_ranks.add(rank)
             state.failed_actor_ranks.discard(rank)
         state.recover_started_at = time.time()
+        obs.get_tracer().event(
+            "failure.detected", round=engine.iteration_offset
+            + engine.num_round_trees,
+            attrs={
+                "ranks": sorted(state.elastic_dead_ranks),
+                "in_flight": True,
+            },
+        )
         # stage replacements NOW: when every dead rank reloads within the
         # scheduler's fast path and no grace period applies, the world is
         # restored before the next round even starts
@@ -1051,6 +1145,7 @@ def _train(
                         ).append(value)
                 # same per-round interval semantics as the per-round path
                 i = completed + ri
+                _fire_after_round(i, round_metrics, round_times[-1])
                 if verbose_eval and (
                     verbose_eval is True or (i % max(int(verbose_eval), 1) == 0)
                 ):
@@ -1087,6 +1182,7 @@ def _train(
                 )
                 last_status = time.time()
 
+        _maybe_profile_phases(engine, state)
         booster = engine.get_booster()
         for actor in [a for a in state.actors if a is not None]:
             actor._distributed_callbacks.after_train(
@@ -1166,6 +1262,8 @@ def _train(
                         metric_name, []
                     ).append(value)
 
+            _fire_after_round(i, round_metrics, round_times[-1])
+
             if verbose_eval and (
                 verbose_eval is True or (i % max(int(verbose_eval), 1) == 0)
             ):
@@ -1244,6 +1342,7 @@ def _train(
             i = engine_base + engine.num_round_trees
             completed = i
 
+    _maybe_profile_phases(engine, state)
     booster = engine.get_booster()
     if es_metric is not None and es_best_iter >= 0:
         # es_best_iter is attempt-local; xgboost reports the *global* boosting
@@ -1386,7 +1485,46 @@ def train(
     Failure handling matches the reference's three-way policy (elastic
     continuation / recreate-from-checkpoint / abort), driven by
     ``ray_params``.
+
+    Observability: every run is traced by a fresh run-scoped
+    :class:`obs.Tracer` — per-round spans from the engine, lifecycle
+    events (attempts, failures, world shrink/grow, checkpoint commits,
+    backoff) from the driver — and the timeline is returned under
+    ``additional_results["obs"]``. ``RXGB_TRACE=0`` disables tracing,
+    ``RXGB_TRACE_DIR`` streams per-rank JSONL, ``RXGB_TRACE_PHASES=1``
+    adds an end-of-run fenced per-phase profile.
     """
+    tracer = obs.Tracer()
+    with obs.use_tracer(tracer):
+        return _train_impl(
+            params,
+            dtrain,
+            num_boost_round,
+            *args,
+            evals=evals,
+            evals_result=evals_result,
+            additional_results=additional_results,
+            ray_params=ray_params,
+            _remote=_remote,
+            _run_tracer=tracer,
+            **kwargs,
+        )
+
+
+def _train_impl(
+    params: Dict,
+    dtrain: RayDMatrix,
+    num_boost_round: int = 10,
+    *args,
+    evals: Union[List[Tuple[RayDMatrix, str]], Tuple] = (),
+    evals_result: Optional[Dict] = None,
+    additional_results: Optional[Dict] = None,
+    ray_params: Union[None, RayParams, Dict] = None,
+    _remote: Optional[bool] = None,
+    _run_tracer=None,
+    **kwargs,
+) -> RayXGBoostBooster:
+    """The driver body behind :func:`train` (which scopes the run tracer)."""
     start_time = time.time()
     if args:
         raise TypeError(
@@ -1527,7 +1665,7 @@ def train(
     def _xgb_base_rounds() -> int:
         return xgb_model.num_boosted_rounds() if xgb_model else 0
 
-    def _account_failure() -> None:
+    def _account_failure(exc=None) -> None:
         """Called on every restart-causing exception: rounds progressed past
         the surviving checkpoint will be replayed by the next attempt."""
         progressed = (
@@ -1540,10 +1678,23 @@ def train(
             )
         else:
             covered = 0
-        robustness["rounds_replayed"] += max(0, progressed - covered)
+        replayed = max(0, progressed - covered)
+        robustness["rounds_replayed"] += replayed
         state.rounds_this_attempt = 0
         state.recover_started_at = time.time()
+        # opens the timeline clock "recovered" closes (matches the
+        # robustness block's time_to_recover_s accounting)
+        obs.get_tracer().event(
+            "failure.detected",
+            attrs={
+                "ranks": sorted(getattr(exc, "ranks", None) or []),
+                "rounds_replayed": replayed,
+                "restart": True,
+            },
+        )
 
+    attempt_no = -1
+    run_tracer = obs.get_tracer()
     while tries <= max_actor_restarts:
         # restart-from-checkpoint round arithmetic (mirror main.py:1606-1612)
         if state.checkpoint.value and state.checkpoint.value != last_checkpoint_value:
@@ -1558,6 +1709,16 @@ def train(
                 # the recovery — close the clock before leaving the loop
                 _mark_recovered(state)
                 break
+
+        attempt_no += 1
+        attempt_ts, attempt_t0 = time.time(), time.perf_counter()
+
+        def _close_attempt(outcome):
+            run_tracer.add_span(
+                "attempt", attempt_ts, time.perf_counter() - attempt_t0,
+                attrs={"attempt": attempt_no, "outcome": outcome,
+                       "rounds_left": boost_rounds_left},
+            )
 
         try:
             booster, final_evals_result, stats = _train(
@@ -1575,26 +1736,36 @@ def train(
                 _training_state=state,
             )
             total_training_time += stats["training_time_s"]
+            _close_attempt("ok")
             break
         except RayXGBoostActorAvailable as exc:
             _stop_profile_if_running()
+            _close_attempt("elastic_restart")
             # elastic reintegration: free restart (mirror main.py:1661-1673)
             logger.info(f"[RayXGBoost] {exc} Restarting from checkpoint with "
                         f"reintegrated workers.")
             robustness["elastic_restarts"] += 1
-            _account_failure()
+            obs.get_registry().counter("rxgb_train_elastic_restarts_total").inc()
+            _account_failure(exc)
             _promote_pending_actors(state)
+            run_tracer.event(
+                "world.restart",
+                attrs={"elastic": True,
+                       "world": sum(1 for a in state.actors if a is not None)},
+            )
             state.queue = Queue()
             state.stop_event = Event()
             _rewire_actors(state)
             continue
         except (RayActorError, RayTaskError) as exc:
             _stop_profile_if_running()
+            _close_attempt("failed")
             if state.training_started_at:
                 total_training_time += time.time() - state.training_started_at
                 state.training_started_at = 0.0
             robustness["restarts"] += 1
-            _account_failure()
+            obs.get_registry().counter("rxgb_train_restarts_total").inc()
+            _account_failure(exc)
             # only REAL failures escalate the backoff exponent — the elastic
             # reintegration restart above replays rounds but is a planned
             # event, not a crash
@@ -1646,6 +1817,11 @@ def train(
                 robustness["backoff_s"] = round(
                     robustness["backoff_s"] + backoff, 4
                 )
+                run_tracer.event(
+                    "backoff",
+                    attrs={"seconds": round(backoff, 4),
+                           "restart": robustness["restarts"]},
+                )
                 time.sleep(backoff)
             tries += 1
             continue
@@ -1665,6 +1841,10 @@ def train(
     total_time = time.time() - start_time
     state.additional_results["training_time_s"] = total_training_time
     state.additional_results["total_time_s"] = total_time
+    if _run_tracer is not None and _run_tracer.enabled:
+        # the queryable run timeline: per-round spans, lifecycle events,
+        # ring-buffer truncation accounting, optional phase profile
+        state.additional_results["obs"] = _assemble_obs(_run_tracer, state)
     if additional_results is not None:
         additional_results.update(state.additional_results)
 

@@ -1,0 +1,64 @@
+"""Unified observability plane: metrics registry + span tracer.
+
+Every subsystem used to invent its own telemetry (the ``AllreduceBytes``
+number threaded through ``additional_results``, the hand-rolled
+``robustness`` dict, ``bench.py``'s private phase timers, the serve layer's
+lock-guarded metrics island). This package is the one plane they now share:
+
+* :mod:`xgboost_ray_tpu.obs.metrics` — process-wide ``MetricsRegistry``
+  with counters, gauges and the log-bucket ``LatencyHistogram`` (promoted
+  out of ``serve/metrics.py``), plus Prometheus text exposition.
+* :mod:`xgboost_ray_tpu.obs.trace` — span/event ``Tracer`` with a bounded
+  ring buffer (dropped-record accounting, never silent), JSONL export and
+  per-rank ``RXGB_TRACE_DIR`` streaming; ``validate_trace_records`` is the
+  shared schema checker; ``recovery_time_s`` reconstructs
+  failure→recovery timing from the event timeline.
+
+``train()`` scopes a fresh tracer per run and returns its timeline under
+``additional_results["obs"]``. Environment knobs: ``RXGB_TRACE`` (0
+disables), ``RXGB_TRACE_CAPACITY`` (ring size), ``RXGB_TRACE_DIR``
+(per-rank JSONL streaming), ``RXGB_TRACE_PHASES=1`` (fenced per-phase
+engine profiling at the end of training).
+
+Stdlib-only imports: safe to touch before jax comes up.
+"""
+
+from xgboost_ray_tpu.obs.metrics import (
+    BUCKET_BOUNDS_MS,
+    Counter,
+    Gauge,
+    LatencyHistogram,
+    MetricsRegistry,
+    get_registry,
+)
+from xgboost_ray_tpu.obs.trace import (
+    Tracer,
+    get_tracer,
+    recovery_time_s,
+    set_default_tracer,
+    use_tracer,
+    validate_trace_records,
+)
+
+__all__ = [
+    "BUCKET_BOUNDS_MS",
+    "Counter",
+    "Gauge",
+    "LatencyHistogram",
+    "MetricsRegistry",
+    "Tracer",
+    "get_registry",
+    "get_tracer",
+    "recovery_time_s",
+    "set_default_tracer",
+    "use_tracer",
+    "validate_trace_records",
+]
+
+
+def phase_profiling_enabled() -> bool:
+    """Whether end-of-training fenced phase profiling is requested
+    (``RXGB_TRACE_PHASES=1``)."""
+    import os
+
+    return os.environ.get("RXGB_TRACE_PHASES", "0") == "1"

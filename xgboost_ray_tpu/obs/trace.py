@@ -1,0 +1,367 @@
+"""Span tracer: bounded ring buffer, JSONL export, and timeline helpers.
+
+One trace record per finished span or instantaneous event, as a plain JSON
+dict (the schema the whole repo shares — drivers, tests, CI and ``bench.py``
+all validate against :func:`validate_trace_records`):
+
+``{"kind": "span" | "event", "name": str, "ts": float (epoch seconds),
+"seq": int (monotonic per tracer), "dur_s": float (spans only),
+"parent": int | None (enclosing span's seq, spans only),
+"round": int (optional — global boosting round), "attrs": dict (optional)}``
+
+Design points:
+
+* **Bounded and never silent.** Records live in a fixed-capacity ring
+  buffer (``RXGB_TRACE_CAPACITY``, default 8192); when a record would
+  overflow, the OLDEST record is dropped and the tracer's ``dropped``
+  counter advances — the count is exported in ``snapshot()`` and in
+  ``additional_results["obs"]["dropped_spans"]``, so truncation is always
+  accounted, never invisible.
+* **Nesting via a thread-local stack.** ``span()`` records its enclosing
+  span's ``seq`` as ``parent``; children finish (and are appended) before
+  their parents, so the record list is end-time ordered while ``seq``
+  preserves start order.
+* **Streaming.** With ``RXGB_TRACE_DIR`` set (or ``trace_dir=`` passed),
+  every record is also appended as one JSON line to
+  ``<dir>/trace-rank<k>.jsonl`` (k = the JAX process index when available)
+  at emission time — a crash loses at most the last unflushed line, and
+  multi-host runs produce one stream per rank.
+* **Import-light.** Stdlib only: the launcher worker (and ``faults.py``)
+  touch this module before any jax import.
+
+This module is process-global-aware: :func:`get_tracer` returns the
+thread's installed tracer (``use_tracer``) or a lazily-created process
+default, so instrumentation sites never need plumbing.
+"""
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional
+
+__all__ = [
+    "Tracer",
+    "get_tracer",
+    "set_default_tracer",
+    "use_tracer",
+    "validate_trace_records",
+    "recovery_time_s",
+]
+
+_DEFAULT_CAPACITY = 8192
+
+
+def _process_rank() -> int:
+    """This process's rank for trace-file naming; 0 when jax is absent or
+    uninitialized (single-host)."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:  # noqa: BLE001 - tracing must never fail the caller
+        return 0
+
+
+class Tracer:
+    """Span/event recorder with a bounded ring buffer.
+
+    ``enabled`` defaults from ``RXGB_TRACE`` (on unless ``"0"``);
+    ``capacity`` from ``RXGB_TRACE_CAPACITY``; ``trace_dir`` from
+    ``RXGB_TRACE_DIR`` (empty = no streaming). A disabled tracer's
+    ``span()``/``event()`` are near-free no-ops.
+    """
+
+    def __init__(
+        self,
+        capacity: Optional[int] = None,
+        enabled: Optional[bool] = None,
+        trace_dir: Optional[str] = None,
+        rank: Optional[int] = None,
+    ):
+        if enabled is None:
+            enabled = os.environ.get("RXGB_TRACE", "1") != "0"
+        if capacity is None:
+            capacity = int(
+                os.environ.get("RXGB_TRACE_CAPACITY", str(_DEFAULT_CAPACITY))
+            )
+        if trace_dir is None:
+            trace_dir = os.environ.get("RXGB_TRACE_DIR", "")
+        self.enabled = bool(enabled)
+        self.capacity = max(1, int(capacity))
+        self._buf: collections.deque = collections.deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._seq = 0
+        self._dropped = 0
+        self._trace_dir = trace_dir or ""
+        self._rank = rank
+        self._stream_file = None
+        self._stream_failed = False
+
+    # -- recording ----------------------------------------------------------
+
+    @property
+    def dropped(self) -> int:
+        return self._dropped
+
+    def _next_seq(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    def _append(self, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._buf) == self.capacity:
+                self._dropped += 1
+            self._buf.append(rec)
+            self._stream(rec)
+
+    def _stream(self, rec: Dict[str, Any]) -> None:
+        """Append one JSON line to the per-rank trace file (best-effort;
+        called under the lock)."""
+        if not self._trace_dir or self._stream_failed:
+            return
+        try:
+            if self._stream_file is None:
+                rank = self._rank if self._rank is not None else _process_rank()
+                self._rank = rank
+                os.makedirs(self._trace_dir, exist_ok=True)
+                path = os.path.join(self._trace_dir, f"trace-rank{rank}.jsonl")
+                self._stream_file = open(path, "a", buffering=1)
+            # default=str: attrs are caller-supplied (span() hands out the
+            # mutable dict) — a numpy scalar or exotic value must degrade to
+            # its string form, never raise out of the instrumented code
+            self._stream_file.write(json.dumps(rec, default=str) + "\n")
+        except Exception:  # noqa: BLE001 - tracing must never fail the caller
+            # a dead disk must not take training down; the in-memory ring
+            # still has the records
+            self._stream_failed = True
+
+    @contextlib.contextmanager
+    def span(self, name: str, round: Optional[int] = None, **attrs):
+        """Context manager recording one fenced span; yields the (mutable)
+        attrs dict so callers can attach results measured inside."""
+        if not self.enabled:
+            yield attrs
+            return
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        with self._lock:
+            seq = self._next_seq()
+        parent = stack[-1] if stack else None
+        stack.append(seq)
+        ts = time.time()
+        t0 = time.perf_counter()
+        try:
+            yield attrs
+        finally:
+            dur = time.perf_counter() - t0
+            stack.pop()
+            self._finish_span(name, ts, dur, seq, parent, round, attrs)
+
+    def add_span(
+        self,
+        name: str,
+        ts: float,
+        dur_s: float,
+        round: Optional[int] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Record an externally-timed span (no nesting bookkeeping)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            seq = self._next_seq()
+        stack = getattr(self._tls, "stack", None)
+        parent = stack[-1] if stack else None
+        self._finish_span(name, ts, dur_s, seq, parent, round, attrs)
+
+    def _finish_span(self, name, ts, dur_s, seq, parent, round, attrs):
+        rec: Dict[str, Any] = {
+            "kind": "span",
+            "name": name,
+            "ts": ts,
+            "seq": seq,
+            "dur_s": float(dur_s),
+            "parent": parent,
+        }
+        if round is not None:
+            rec["round"] = int(round)
+        if attrs:
+            rec["attrs"] = dict(attrs)
+        self._append(rec)
+
+    def event(
+        self,
+        name: str,
+        round: Optional[int] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+        **kw,
+    ) -> None:
+        """Record one instantaneous event; attributes may come as an
+        ``attrs`` dict, keyword arguments, or both (merged, kwargs win)."""
+        if not self.enabled:
+            return
+        merged = dict(attrs) if attrs else {}
+        merged.update(kw)
+        with self._lock:
+            seq = self._next_seq()
+        rec: Dict[str, Any] = {
+            "kind": "event",
+            "name": name,
+            "ts": time.time(),
+            "seq": seq,
+        }
+        if round is not None:
+            rec["round"] = int(round)
+        if merged:
+            rec["attrs"] = merged
+        self._append(rec)
+
+    # -- reading / export ---------------------------------------------------
+
+    def records(self) -> List[Dict[str, Any]]:
+        """Snapshot of the ring buffer (oldest first)."""
+        with self._lock:
+            return list(self._buf)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "records": len(self._buf),
+                "dropped_spans": self._dropped,
+                "capacity": self.capacity,
+            }
+
+    def export_jsonl(self, path: str) -> int:
+        """Write the buffered records as JSON lines; returns record count.
+        Non-JSON-serializable attr values degrade to their string form."""
+        recs = self.records()
+        with open(path, "w") as f:
+            for rec in recs:
+                f.write(json.dumps(rec, default=str) + "\n")
+        return len(recs)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._stream_file is not None:
+                try:
+                    self._stream_file.close()
+                except OSError:  # pragma: no cover - close is best-effort
+                    pass
+                self._stream_file = None
+
+
+# ---------------------------------------------------------------------------
+# current-tracer plumbing: thread-local install (train() scopes a fresh
+# tracer per run) over a lazily-created process default (launcher-level
+# spans outside any train() land there).
+# ---------------------------------------------------------------------------
+
+_default_tracer: Optional[Tracer] = None
+_default_lock = threading.Lock()
+_tls = threading.local()
+
+
+def get_tracer() -> Tracer:
+    """The thread's installed tracer, else the process-default tracer."""
+    current = getattr(_tls, "current", None)
+    if current is not None:
+        return current
+    global _default_tracer
+    if _default_tracer is None:
+        with _default_lock:
+            if _default_tracer is None:
+                _default_tracer = Tracer()
+    return _default_tracer
+
+
+def set_default_tracer(tracer: Optional[Tracer]) -> None:
+    """Replace the process-default tracer (None resets to lazy re-create)."""
+    global _default_tracer
+    _default_tracer = tracer
+
+
+@contextlib.contextmanager
+def use_tracer(tracer: Tracer):
+    """Install ``tracer`` as this thread's current tracer for the scope."""
+    prev = getattr(_tls, "current", None)
+    _tls.current = tracer
+    try:
+        yield tracer
+    finally:
+        _tls.current = prev
+
+
+# ---------------------------------------------------------------------------
+# schema validation + timeline queries (shared by tests, CI and bench.py)
+# ---------------------------------------------------------------------------
+
+_ALLOWED_KEYS = {"kind", "name", "ts", "seq", "dur_s", "parent", "round", "attrs"}
+
+
+def validate_trace_records(records: Iterable[Dict[str, Any]]) -> List[str]:
+    """Validate records against the trace schema; returns a list of problem
+    strings (empty = valid). Exported at package top level so tests and the
+    CI example (``examples/trace_run.py``) share one checker."""
+    problems: List[str] = []
+    seen_seq = set()
+    for i, rec in enumerate(records):
+        where = f"record {i}"
+        if not isinstance(rec, dict):
+            problems.append(f"{where}: not a dict")
+            continue
+        unknown = set(rec) - _ALLOWED_KEYS
+        if unknown:
+            problems.append(f"{where}: unknown keys {sorted(unknown)}")
+        kind = rec.get("kind")
+        if kind not in ("span", "event"):
+            problems.append(f"{where}: bad kind {kind!r}")
+        name = rec.get("name")
+        if not isinstance(name, str) or not name:
+            problems.append(f"{where}: bad name {name!r}")
+        if not isinstance(rec.get("ts"), (int, float)):
+            problems.append(f"{where}: bad ts {rec.get('ts')!r}")
+        seq = rec.get("seq")
+        if not isinstance(seq, int):
+            problems.append(f"{where}: bad seq {seq!r}")
+        elif seq in seen_seq:
+            problems.append(f"{where}: duplicate seq {seq}")
+        else:
+            seen_seq.add(seq)
+        if kind == "span":
+            dur = rec.get("dur_s")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(f"{where}: bad dur_s {dur!r}")
+            parent = rec.get("parent")
+            if parent is not None and not isinstance(parent, int):
+                problems.append(f"{where}: bad parent {parent!r}")
+        if "round" in rec and not isinstance(rec["round"], int):
+            problems.append(f"{where}: bad round {rec['round']!r}")
+        if "attrs" in rec and not isinstance(rec["attrs"], dict):
+            problems.append(f"{where}: bad attrs {rec['attrs']!r}")
+        if kind == "event" and "dur_s" in rec:
+            problems.append(f"{where}: event carries dur_s")
+    return problems
+
+
+def recovery_time_s(records: Iterable[Dict[str, Any]]) -> float:
+    """Total failure→first-forward-progress time reconstructed from the
+    timeline: each ``recovered`` event closes the clock opened by the most
+    recent ``failure.detected`` event (matching the driver's
+    ``time_to_recover_s`` accounting, which restarts the clock on repeated
+    failures before progress)."""
+    total = 0.0
+    last_failure: Optional[float] = None
+    for rec in records:
+        if rec.get("kind") != "event":
+            continue
+        if rec.get("name") == "failure.detected":
+            last_failure = float(rec["ts"])
+        elif rec.get("name") == "recovered" and last_failure is not None:
+            total += max(0.0, float(rec["ts"]) - last_failure)
+            last_failure = None
+    return total

@@ -12,9 +12,11 @@ Endpoints (JSON in/out, loopback-friendly, no extra dependencies):
   serving; 503 with ``status`` ``no_model`` / ``draining`` (graceful
   shutdown) / ``degraded`` (consecutive-predictor-failure breaker open).
 * ``GET /metrics`` — the ``ServeMetrics.snapshot()`` dict: qps, queue
-  depth, p50/p95/p99 latency, padding-waste fraction, recompile count —
-  the serving analog of the ``AllreduceBytes``-through-additional_results
-  counter pattern.
+  depth, p50/p95/p99 latency, padding-waste fraction, recompile count.
+  ``GET /metrics?format=prometheus`` returns the same endpoint's counters,
+  live gauges and latency histogram as Prometheus 0.0.4 text exposition
+  (stable name ordering, cumulative ``le`` buckets) — scrape-ready, from
+  the shared ``obs`` metrics registry.
 
 Each HTTP request runs on its own thread (``ThreadingHTTPServer``); the
 threads rendezvous in the microbatcher, which is where concurrency turns
@@ -59,8 +61,32 @@ class _Handler(BaseHTTPRequestHandler):
         raw = self.rfile.read(length) if length else b"{}"
         return json.loads(raw.decode("utf-8"))
 
+    def _reply_text(self, code: int, body: str, content_type: str) -> None:
+        raw = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
     def do_GET(self):  # noqa: N802 - http.server API
+        from urllib.parse import parse_qs, urlparse
+
         h = self.serve_handle
+        parsed = urlparse(self.path)
+        if parsed.path == "/metrics":
+            fmt = parse_qs(parsed.query).get("format", ["json"])[0]
+            if fmt == "prometheus":
+                self._reply_text(
+                    200, h.metrics.prometheus_text(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            elif fmt == "json":
+                self._reply(200, h.metrics.snapshot())
+            else:
+                self._reply(400, {"error": f"unknown format {fmt!r}; "
+                                           f"one of json|prometheus"})
+            return
         if self.path == "/healthz":
             # 503 is reserved for the take-me-out-of-rotation states:
             # draining (graceful shutdown), no model yet, and degraded
@@ -81,9 +107,6 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(200, {
                     "status": "ok", "model_version": h.registry.version,
                 })
-            return
-        if self.path == "/metrics":
-            self._reply(200, h.metrics.snapshot())
             return
         self._reply(404, {"error": f"unknown path {self.path!r}"})
 

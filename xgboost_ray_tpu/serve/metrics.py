@@ -1,71 +1,53 @@
-"""Serving-side observability counters.
+"""Serving-side observability, on the shared ``obs`` metrics plane.
 
-The training path surfaces its one wire counter (``AllreduceBytes``) as a
-plain number threaded through ``additional_results`` (PR 1); the serving
-path follows the same pattern — every gauge here is a host-side Python
-counter, updated under one lock on the request completion path and exported
-as a flat dict by ``snapshot()`` (the payload of the HTTP ``/metrics``
-endpoint and of the bench ``serve`` section). Nothing touches the device.
+Until PR 6 every gauge here was a hand-rolled Python counter and the
+latency histogram was a private type; both now come from
+``xgboost_ray_tpu.obs.metrics`` — the same registry/counter/histogram
+primitives the training side uses — so the serving layer gains Prometheus
+text exposition (``/metrics?format=prometheus``) for free and the
+log-bucket :class:`LatencyHistogram` has one implementation repo-wide.
 
-Latency percentiles come from a fixed log-spaced histogram (60 buckets,
-0.05 ms .. ~170 s at ~1.26x spacing) rather than a reservoir: constant
-memory, O(1) record, and the p50/p95/p99 read is a cumulative walk with
-linear interpolation inside the bucket — the same resolution/overhead
-trade Prometheus client histograms make.
+``snapshot()`` keeps its original flat-dict schema (the payload of the
+HTTP ``/metrics`` JSON endpoint and of the bench ``serve`` section):
+derived rates (qps, rows/s, padding waste, percentiles) are computed at
+read time from the underlying counters. Each endpoint owns its own
+:class:`~xgboost_ray_tpu.obs.metrics.MetricsRegistry` by default so
+multiple endpoints in one process never share counters; pass
+``registry=obs.get_registry()`` to publish into the process-wide one —
+but at most ONE endpoint per registry: counters are name-keyed (no
+per-endpoint label), so a second ServeMetrics on the same registry would
+merge counts, rebind the live gauges to itself, and let either
+endpoint's ``reset()`` zero the other's window.
+
+Latency percentiles come from the fixed log-spaced histogram (60 buckets,
+0.05 ms .. ~170 s at ~1.26x spacing): constant memory, O(1) record, and
+the p50/p95/p99 read is a cumulative walk with linear interpolation
+inside the bucket — the same resolution/overhead trade Prometheus client
+histograms make.
 """
 
-import math
 import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-# log-spaced latency bucket upper bounds (ms)
-_BUCKET_BASE_MS = 0.05
-_BUCKET_FACTOR = 1.26
-_N_BUCKETS = 60
-_BOUNDS_MS = [
-    _BUCKET_BASE_MS * _BUCKET_FACTOR ** i for i in range(_N_BUCKETS)
-]
+from xgboost_ray_tpu.obs.metrics import (
+    BUCKET_BOUNDS_MS as _BOUNDS_MS,  # noqa: F401 - back-compat re-export
+    LatencyHistogram,
+    MetricsRegistry,
+)
 
+__all__ = ["LatencyHistogram", "ServeMetrics"]
 
-class LatencyHistogram:
-    """Fixed log-bucket latency histogram with interpolated percentiles."""
-
-    def __init__(self):
-        self.counts = [0] * (_N_BUCKETS + 1)  # +1 overflow bucket
-        self.total = 0
-        self.sum_ms = 0.0
-
-    def record(self, ms: float) -> None:
-        if ms <= _BOUNDS_MS[0]:
-            idx = 0
-        elif ms > _BOUNDS_MS[-1]:
-            idx = _N_BUCKETS
-        else:
-            idx = int(
-                math.ceil(math.log(ms / _BUCKET_BASE_MS) / math.log(_BUCKET_FACTOR))
-            )
-            idx = min(max(idx, 0), _N_BUCKETS)
-        self.counts[idx] += 1
-        self.total += 1
-        self.sum_ms += ms
-
-    def percentile(self, q: float) -> float:
-        """Interpolated latency at quantile ``q`` in [0, 1]; 0.0 when empty."""
-        if self.total == 0:
-            return 0.0
-        target = q * self.total
-        seen = 0
-        for i, c in enumerate(self.counts):
-            if c == 0:
-                continue
-            if seen + c >= target:
-                hi = _BOUNDS_MS[i] if i < _N_BUCKETS else _BOUNDS_MS[-1] * _BUCKET_FACTOR
-                lo = _BOUNDS_MS[i - 1] if 0 < i <= _N_BUCKETS else 0.0
-                frac = (target - seen) / c
-                return lo + frac * (hi - lo)
-            seen += c
-        return _BOUNDS_MS[-1]
+_COUNTER_NAMES = (
+    "requests",
+    "rows",
+    "errors",
+    "shed",
+    "batches",
+    "batch_rows",
+    "padded_rows",
+    "model_swaps",
+)
 
 
 class ServeMetrics:
@@ -73,7 +55,9 @@ class ServeMetrics:
 
     ``queue_depth_fn`` is injected by the batcher so the gauge reads the
     live queue without a reverse dependency; ``recompile_count_fn`` reads
-    the predictor layer's trace counter the same way.
+    the predictor layer's trace counter the same way; ``breaker_fn`` the
+    front-end's degradation breaker. All three are also exported as live
+    gauges in the Prometheus exposition.
     """
 
     def __init__(
@@ -81,18 +65,23 @@ class ServeMetrics:
         queue_depth_fn: Optional[Callable[[], int]] = None,
         recompile_count_fn: Optional[Callable[[], int]] = None,
         breaker_fn: Optional[Callable[[], Dict[str, int]]] = None,
+        registry: Optional[MetricsRegistry] = None,
     ):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        # outer lock restoring the pre-obs single-lock guarantee for
+        # MULTI-counter operations: observe_batch's three increments,
+        # reset()'s zeroing sweep, and snapshot()'s cross-counter read are
+        # each atomic relative to one another (individual counters keep
+        # their own locks for the Prometheus export path)
         self._lock = threading.Lock()
         self._started = time.monotonic()
-        self._hist = LatencyHistogram()
-        self.requests = 0
-        self.rows = 0
-        self.errors = 0
-        self.shed = 0  # requests rejected at the max_queue_rows cap (429)
-        self.batches = 0
-        self.batch_rows = 0
-        self.padded_rows = 0  # padding rows added on top of batch_rows
-        self.model_swaps = 0
+        self._c = {
+            name: self.registry.counter(f"rxgb_serve_{name}_total")
+            for name in _COUNTER_NAMES
+        }
+        self._hist = self.registry.histogram(
+            "rxgb_serve_latency_ms", "request latency (ms)"
+        )
         self.queue_depth_fn = queue_depth_fn
         self.recompile_count_fn = recompile_count_fn
         # injected by the front-end: live degradation-breaker state
@@ -102,75 +91,124 @@ class ServeMetrics:
         # so hot-swaps reuse programs); report compiles SINCE this endpoint
         # came up (re-baselined by reset()), not the process total
         self._recompile_base = int(recompile_count_fn()) if recompile_count_fn else 0
+        # live gauges for the Prometheus exposition (the JSON snapshot reads
+        # the fns directly); closures read the CURRENT fn so late injection
+        # (http.py assigns queue_depth_fn after construction) just works
+        self.registry.gauge(
+            "rxgb_serve_uptime_seconds",
+            fn=lambda: round(time.monotonic() - self._started, 3),
+        )
+        self.registry.gauge(
+            "rxgb_serve_queue_depth",
+            fn=lambda: int(self.queue_depth_fn()) if self.queue_depth_fn else 0,
+        )
+        self.registry.gauge(
+            "rxgb_serve_breaker_open",
+            fn=lambda: int((self.breaker_fn() or {}).get("breaker_open", 0))
+            if self.breaker_fn
+            else 0,
+        )
+        self.registry.gauge(
+            "rxgb_serve_recompile_count",
+            fn=lambda: (
+                int(self.recompile_count_fn()) - self._recompile_base
+                if self.recompile_count_fn
+                else 0
+            ),
+        )
+
+    # back-compat attribute access (the counters used to be plain ints)
+    @property
+    def requests(self) -> int:
+        return self._c["requests"].value
+
+    @property
+    def rows(self) -> int:
+        return self._c["rows"].value
+
+    @property
+    def errors(self) -> int:
+        return self._c["errors"].value
+
+    @property
+    def shed(self) -> int:
+        return self._c["shed"].value
+
+    @property
+    def batches(self) -> int:
+        return self._c["batches"].value
+
+    @property
+    def batch_rows(self) -> int:
+        return self._c["batch_rows"].value
+
+    @property
+    def padded_rows(self) -> int:
+        return self._c["padded_rows"].value
+
+    @property
+    def model_swaps(self) -> int:
+        return self._c["model_swaps"].value
 
     def reset(self) -> None:
         """Zero every counter and restart the clock — used by the closed-loop
         bench to exclude its warmup traffic from the measured window."""
         with self._lock:
             self._started = time.monotonic()
-            self._hist = LatencyHistogram()
-            self.requests = 0
-            self.rows = 0
-            self.errors = 0
-            self.shed = 0
-            self.batches = 0
-            self.batch_rows = 0
-            self.padded_rows = 0
-            self.model_swaps = 0
+            for c in self._c.values():
+                c.reset()
+            self._hist.reset()
             if self.recompile_count_fn is not None:
                 self._recompile_base = int(self.recompile_count_fn())
 
     def observe_request(self, latency_s: float, n_rows: int) -> None:
         with self._lock:
-            self.requests += 1
-            self.rows += n_rows
+            self._c["requests"].inc()
+            self._c["rows"].inc(n_rows)
             self._hist.record(latency_s * 1000.0)
 
     def observe_error(self) -> None:
-        with self._lock:
-            self.errors += 1
+        self._c["errors"].inc()
 
     def observe_shed(self) -> None:
-        with self._lock:
-            self.shed += 1
+        self._c["shed"].inc()
 
     def observe_batch(self, n_rows: int, bucket: int) -> None:
         with self._lock:
-            self.batches += 1
-            self.batch_rows += n_rows
-            self.padded_rows += max(bucket - n_rows, 0)
+            self._c["batches"].inc()
+            self._c["batch_rows"].inc(n_rows)
+            self._c["padded_rows"].inc(max(bucket - n_rows, 0))
 
     def observe_swap(self) -> None:
-        with self._lock:
-            self.model_swaps += 1
+        self._c["model_swaps"].inc()
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             elapsed = max(time.monotonic() - self._started, 1e-9)
-            issued = self.batch_rows + self.padded_rows
-            snap = {
-                "uptime_s": round(elapsed, 3),
-                "requests": self.requests,
-                "rows": self.rows,
-                "errors": self.errors,
-                "shed": self.shed,
-                "qps": round(self.requests / elapsed, 3),
-                "rows_per_s": round(self.rows / elapsed, 3),
-                "batches": self.batches,
-                "mean_batch_rows": round(
-                    self.batch_rows / max(self.batches, 1), 3
-                ),
-                "padding_waste": round(
-                    self.padded_rows / max(issued, 1), 5
-                ),
-                "latency_p50_ms": round(self._hist.percentile(0.50), 4),
-                "latency_p95_ms": round(self._hist.percentile(0.95), 4),
-                "latency_p99_ms": round(self._hist.percentile(0.99), 4),
-                "latency_mean_ms": round(
-                    self._hist.sum_ms / max(self._hist.total, 1), 4
-                ),
-                "model_swaps": self.model_swaps,
-            }
+            hist = self._hist.snapshot()  # consistent cut under both locks
+            requests = self.requests
+            rows = self.rows
+            batches = self.batches
+            batch_rows = self.batch_rows
+            padded = self.padded_rows
+        issued = batch_rows + padded
+        snap = {
+            "uptime_s": round(elapsed, 3),
+            "requests": requests,
+            "rows": rows,
+            "errors": self.errors,
+            "shed": self.shed,
+            "qps": round(requests / elapsed, 3),
+            "rows_per_s": round(rows / elapsed, 3),
+            "batches": batches,
+            "mean_batch_rows": round(batch_rows / max(batches, 1), 3),
+            "padding_waste": round(padded / max(issued, 1), 5),
+            "latency_p50_ms": round(hist["p50_ms"], 4),
+            "latency_p95_ms": round(hist["p95_ms"], 4),
+            "latency_p99_ms": round(hist["p99_ms"], 4),
+            "latency_mean_ms": round(hist["mean_ms"], 4),
+            "model_swaps": self.model_swaps,
+        }
         if self.queue_depth_fn is not None:
             snap["queue_depth"] = int(self.queue_depth_fn())
         if self.breaker_fn is not None:
@@ -182,5 +220,10 @@ class ServeMetrics:
         return snap
 
     def latency_buckets(self) -> List[int]:
-        with self._lock:
-            return list(self._hist.counts)
+        return list(self._hist.snapshot()["counts"])
+
+    def prometheus_text(self) -> str:
+        """Prometheus 0.0.4 text exposition of this endpoint's registry
+        (counters, live gauges, and the latency histogram with cumulative
+        ``le`` buckets) — the ``/metrics?format=prometheus`` payload."""
+        return self.registry.prometheus_text()
