@@ -2,6 +2,11 @@
 # Ordered fail-fast test runner (parity with the reference's run_ci_tests.sh).
 set -e
 cd "$(dirname "$0")"
+echo "================= rxgblint static analysis (tier-1 gate) ================="
+# fails on any non-baselined finding; the JSON artifact lets future PRs
+# diff finding counts (tools/rxgblint/baseline.json holds justified ones)
+python -m tools.rxgblint xgboost_ray_tpu --json /tmp/rxgblint.json
+python -m pytest tests/test_lint.py -v -x
 python -m pytest tests/test_matrix.py -v -x
 python -m pytest tests/test_data_source.py -v -x
 python -m pytest tests/test_ops.py -v -x
@@ -17,7 +22,9 @@ python -m pytest tests/test_xgboost_api.py -v -x
 python -m pytest tests/test_tune.py -v -x
 python -m pytest tests/test_sklearn.py -v -x
 echo "================= Running smoke benchmark ================="
-python tests/release/benchmark_tpu.py 2 10 8 --smoke-test
+# explicit PYTHONPATH: the script lives in tests/release/, so sys.path[0]
+# is NOT the repo root (same treatment as the elastic smoke below)
+PYTHONPATH=".:$PYTHONPATH" python tests/release/benchmark_tpu.py 2 10 8 --smoke-test
 echo "================= Running chaos smoke (bench --chaos) ================="
 BENCH_CHAOS_ROWS=2000 BENCH_CHAOS_ROUNDS=6 python bench.py --chaos
 echo "========= Running elastic-continuation chaos smoke (kill + reintegrate) ========="

@@ -265,6 +265,12 @@ def test_transient_blameless_failure_resumes_without_phantom_shrink(monkeypatch)
     assert rob["restarts"] == 0 and rob["rounds_replayed"] == 0
     assert rob["orphaned_rows"] == 0
     assert res["total_n"] == len(x)
+    # the timeline must agree with the metrics: a resume is a
+    # "world.resume" event, never a phantom "world.grow"/"world.shrink"
+    timeline_names = [r["name"] for r in res["obs"]["timeline"]]
+    assert "world.resume" in timeline_names
+    assert "world.grow" not in timeline_names
+    assert "world.shrink" not in timeline_names
     assert np.array_equal(
         bst.predict(x, output_margin=True),
         ref.predict(x, output_margin=True),

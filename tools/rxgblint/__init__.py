@@ -1,0 +1,40 @@
+"""rxgblint: SPMD/determinism static analysis for xgboost_ray_tpu.
+
+The runtime bets on invariants that nothing used to check: collectives must
+execute uniformly on every rank (a rank-divergent ``psum`` is a silent
+cluster hang), training must stay bitwise reproducible (every RNG routed
+through ``params.seed`` + the ``SALT_*`` fold domains), shared state in the
+threaded serve/obs layers must stay behind its lock, and the fault/trace
+catalogs must match their call sites. rxgblint enforces all of it as a
+tier-1 CI gate::
+
+    python -m tools.rxgblint xgboost_ray_tpu            # human output
+    python -m tools.rxgblint xgboost_ray_tpu --json out.json
+
+Rules: SPMD001 SPMD002 DET001 SYNC001 LOCK001 FAULT001 OBS001 EXP001 — see
+``tools/rxgblint/findings.py`` (or README "Static analysis") for the
+catalog, pragma syntax (``# rxgblint: disable=RULE``) and the justified
+baseline workflow (``tools/rxgblint/baseline.json``).
+
+Stdlib-only, AST-based: never imports the package under analysis, so it
+runs before jax is even installed.
+"""
+
+from tools.rxgblint.baseline import BaselineError
+from tools.rxgblint.findings import RULES, Finding
+from tools.rxgblint.runner import (
+    lint_source,
+    render_report,
+    report_to_json,
+    run_lint,
+)
+
+__all__ = [
+    "RULES",
+    "Finding",
+    "BaselineError",
+    "lint_source",
+    "run_lint",
+    "render_report",
+    "report_to_json",
+]

@@ -1,0 +1,90 @@
+"""CLI: ``python -m tools.rxgblint <paths> [--json FILE] [--baseline FILE]``.
+
+Exit status: 0 = no open (non-suppressed) findings, 1 = open findings or a
+malformed baseline, 2 = usage error.
+"""
+
+import argparse
+import os
+import sys
+
+from tools.rxgblint.baseline import DEFAULT_BASELINE, BaselineError
+from tools.rxgblint.findings import RULES
+from tools.rxgblint.runner import (
+    TargetError,
+    render_report,
+    report_to_json,
+    run_lint,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="rxgblint",
+        description="SPMD/determinism static analysis for xgboost_ray_tpu",
+    )
+    parser.add_argument("paths", nargs="*", help="files or directories to lint")
+    parser.add_argument(
+        "--json", metavar="FILE",
+        help="also write the machine-readable report (the CI artifact "
+             "future PRs diff finding counts against)",
+    )
+    parser.add_argument(
+        "--baseline", default=DEFAULT_BASELINE, metavar="FILE",
+        help="justified-suppression baseline (default: the shipped one); "
+             "pass an empty string to run baseline-free",
+    )
+    parser.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also print pragma-/baseline-suppressed findings",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for code in sorted(RULES):
+            print(f"{code}: {RULES[code]}")
+        return 0
+    if not args.paths:
+        parser.print_usage(sys.stderr)
+        return 2
+
+    try:
+        report = run_lint(args.paths, baseline_path=args.baseline)
+    except BaselineError as exc:
+        print(f"rxgblint: bad baseline: {exc}", file=sys.stderr)
+        return 1
+    except TargetError as exc:
+        print(f"rxgblint: {exc}", file=sys.stderr)
+        return 2
+    if report["files"] == 0:
+        # an existing-but-empty target is as vacuous as a missing one
+        print(
+            f"rxgblint: no Python files found under {args.paths!r}",
+            file=sys.stderr,
+        )
+        return 2
+
+    # write the artifact and settle the exit code BEFORE printing: stdout's
+    # consumer closing early (`rxgblint ... | head`) must not be able to
+    # turn findings into a success exit
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(report_to_json(report) + "\n")
+    status = 1 if report["open"] else 0
+    try:
+        print(render_report(report, show_suppressed=args.show_suppressed))
+    except BrokenPipeError:
+        # swallow the pipe (not the findings); devnull keeps the
+        # interpreter's shutdown flush from tracebacking
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return status
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `rxgblint ... | head` must not traceback...
+        sys.exit(1)  # ...but a run we couldn't report is not a pass

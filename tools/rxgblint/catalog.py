@@ -1,0 +1,192 @@
+"""Codebase-specific catalogs the rules check against.
+
+Everything here is either declared in one place in the production code and
+*extracted* at lint time (fault sites, SALT constants, trace names, mesh
+axes) or is a policy list owned by the linter (required exports, collective
+wrapper names). Extraction is AST-based — the linter never imports the
+package under analysis, so it runs in a bare CPython with no jax installed.
+"""
+
+import ast
+import functools
+import os
+import re
+from typing import FrozenSet, Set, Tuple
+
+#: repository root = two levels above this file (tools/rxgblint/)
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+PACKAGE = "xgboost_ray_tpu"
+
+# ---------------------------------------------------------------------------
+# SPMD: collectives and mesh axes
+# ---------------------------------------------------------------------------
+
+#: jax.lax collective primitives (terminal attribute names)
+JAX_COLLECTIVES: FrozenSet[str] = frozenset({
+    "psum", "pmax", "pmin", "pmean", "all_gather", "all_to_all",
+    "ppermute", "pshuffle", "psum_scatter", "axis_index",
+})
+
+#: repo-local wrapper callables that perform a collective internally; a call
+#: to one of these under rank-dependent control flow is the same hang hazard
+COLLECTIVE_WRAPPERS: FrozenSet[str] = frozenset({
+    "allreduce", "tree_psum", "hist_ar", "counting_psum",
+    "quantized_hist_allreduce",
+})
+
+#: identifier fragments that mark a value as rank-/shard-dependent when they
+#: appear in a branch condition guarding a collective
+RANK_TAINT_RE = re.compile(
+    r"(^|_)(rank|ranks|process_index|proc_index|shard_id|worker_id|"
+    r"host_id|device_id|axis_index|pid)($|_)"
+)
+RANK_TAINT_CALLS: FrozenSet[str] = frozenset({
+    "process_index", "axis_index", "host_id", "process_count",
+})
+
+
+def _parse(path: str) -> ast.Module:
+    with open(path, "r", encoding="utf-8") as f:
+        return ast.parse(f.read(), filename=path)
+
+
+@functools.lru_cache(maxsize=None)
+def mesh_axes(root: str = REPO_ROOT) -> FrozenSet[str]:
+    """Mesh-axis catalog: every string inside a tuple passed to a ``Mesh``
+    constructor anywhere in the package. Falls back to {"actors"} (the
+    engine's 1D row mesh) if extraction comes up empty."""
+    axes: Set[str] = set()
+    for path in _package_files(root):
+        try:
+            tree = _parse(path)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and _callee_name(node) == "Mesh"):
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, (ast.Tuple, ast.List)):
+                    for elt in arg.elts:
+                        if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                            axes.add(elt.value)
+    return frozenset(axes) if axes else frozenset({"actors"})
+
+
+# ---------------------------------------------------------------------------
+# DET: the SALT_* fold domains
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def salt_values(root: str = REPO_ROOT) -> FrozenSet[int]:
+    """Integer values of every module-level ``SALT_*`` assignment in the
+    package (declared in ops/grow.py; the scheme every deterministic
+    fold_in stream routes through)."""
+    vals: Set[int] = set()
+    for path in _package_files(root):
+        try:
+            tree = _parse(path)
+        except SyntaxError:
+            continue
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Name)
+                        and tgt.id.startswith("SALT_")
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, int)
+                    ):
+                        vals.add(node.value.value)
+    return frozenset(vals)
+
+
+# ---------------------------------------------------------------------------
+# FAULT: the fault-site catalog from faults.py
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def fault_sites(root: str = REPO_ROOT) -> Tuple[str, ...]:
+    """The ``SITES`` tuple extracted from ``xgboost_ray_tpu/faults.py``."""
+    path = os.path.join(root, PACKAGE, "faults.py")
+    try:
+        tree = _parse(path)
+    except (OSError, SyntaxError):
+        return ()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "SITES":
+                    if isinstance(node.value, (ast.Tuple, ast.List)):
+                        return tuple(
+                            elt.value
+                            for elt in node.value.elts
+                            if isinstance(elt, ast.Constant)
+                            and isinstance(elt.value, str)
+                        )
+    return ()
+
+
+# ---------------------------------------------------------------------------
+# OBS: the trace-name catalog from obs/trace.py
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def trace_names(root: str = REPO_ROOT) -> FrozenSet[str]:
+    """The ``TRACE_NAMES`` frozenset extracted from obs/trace.py — the one
+    declared catalog of every span/event name the runtime may emit."""
+    path = os.path.join(root, PACKAGE, "obs", "trace.py")
+    try:
+        tree = _parse(path)
+    except (OSError, SyntaxError):
+        return frozenset()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "TRACE_NAMES":
+                    names: Set[str] = set()
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                            names.add(sub.value)
+                    return frozenset(names)
+    return frozenset()
+
+
+#: valid span/event name shape (lowercase dotted identifiers — greppable,
+#: Prometheus-label-safe, and guaranteed to pass validate_trace_records)
+TRACE_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)*$")
+
+# ---------------------------------------------------------------------------
+# EXP: required public exports of the top-level package
+# ---------------------------------------------------------------------------
+
+#: symbols that must appear in xgboost_ray_tpu/__init__.py __all__ —
+#: the core API plus the public surfaces added by PRs 3-6
+REQUIRED_EXPORTS: FrozenSet[str] = frozenset({
+    "train", "predict", "RayParams", "RayDMatrix",
+    "faults", "obs",
+    "AsyncCheckpointWriter",          # PR 5
+    "validate_trace_records",         # PR 6
+    "recovery_time_s",                # PR 6 obs helper
+})
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+
+def _callee_name(call: ast.Call) -> str:
+    """Terminal identifier of a call's callee: ``jax.lax.psum`` -> "psum"."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _package_files(root: str):
+    pkg = os.path.join(root, PACKAGE)
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)

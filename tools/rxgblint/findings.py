@@ -1,0 +1,83 @@
+"""Finding record shared by every rxgblint rule.
+
+A finding is one (rule, location, message) triple plus the *scope* — the
+dotted qualname of the enclosing class/function chain — which is what the
+suppression baseline keys on: line numbers churn on every edit, but a
+finding's scope survives refactors that don't move the offending code
+between functions.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+#: rule code -> one-line description (the catalog printed by --list-rules
+#: and documented in README "Static analysis")
+RULES: Dict[str, str] = {
+    "SPMD001": (
+        "collective reachable under rank-/shard-dependent Python control "
+        "flow (divergent ranks skip the collective: cluster hang)"
+    ),
+    "SPMD002": (
+        "collective axis name not in the engine's declared mesh-axis "
+        "catalog (typo'd axis fails at trace time, or worse, resolves "
+        "against an unintended mesh)"
+    ),
+    "DET001": (
+        "nondeterminism source in engine/ops code: wall-clock or unseeded "
+        "RNG, jax.random fold outside the SALT_* domains, or unsorted set "
+        "iteration feeding ordered data (breaks bitwise reproducibility)"
+    ),
+    "SYNC001": (
+        "hidden host<->device sync (float()/bool()/.item()/np.asarray/"
+        "device_get) inside traced code (serializes the round pipeline)"
+    ),
+    "LOCK001": (
+        "shared-state attribute accessed outside `with self._lock` in a "
+        "lock-owning class (torn snapshot / lost update under threads)"
+    ),
+    "FAULT001": (
+        "fault-injection site string not in faults.SITES, or a catalogued "
+        "site with no fire() call site (chaos plans silently no-op)"
+    ),
+    "OBS001": (
+        "span/event name not a static literal from the obs trace-name "
+        "catalog (timeline becomes ungreppable; schema validation cannot "
+        "pin names)"
+    ),
+    "EXP001": (
+        "__all__ export drift: name does not resolve in the module, or a "
+        "required public symbol is missing from the package export list"
+    ),
+}
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    scope: str = ""  # dotted qualname of enclosing class/function chain
+    suppressed: Optional[str] = field(default=None)  # "pragma" | "baseline"
+
+    def key(self):
+        """Baseline matching key: stable across line-number churn."""
+        return (self.rule, self.path, self.scope)
+
+    def to_dict(self) -> Dict[str, object]:
+        out = {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "scope": self.scope,
+            "message": self.message,
+        }
+        if self.suppressed:
+            out["suppressed"] = self.suppressed
+        return out
+
+    def render(self) -> str:
+        tag = f" [{self.suppressed}]" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}{tag} {self.message}"

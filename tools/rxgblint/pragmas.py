@@ -1,0 +1,66 @@
+"""Inline suppression pragmas.
+
+Two forms, mirroring the linters this repo's contributors already know:
+
+* same line::
+
+      risky_call()  # rxgblint: disable=SPMD001
+      risky_call()  # rxgblint: disable=SPMD001,DET001
+      risky_call()  # rxgblint: disable=all
+
+* previous line (for statements that don't fit a trailing comment)::
+
+      # rxgblint: disable-next-line=LOCK001
+      self._depth += 1
+
+A pragma suppresses only the named rules (or every rule for ``all``) and
+only on its target line. Suppressed findings still appear in ``--json``
+output tagged ``"suppressed": "pragma"`` so finding counts stay diffable
+across PRs.
+"""
+
+import io
+import re
+import tokenize
+from typing import Dict, Set
+
+# codes may be followed by a free-form justification: the recommended style
+# is `# rxgblint: disable=DET001 - why this is fine here`
+_PRAGMA_RE = re.compile(
+    r"#\s*rxgblint:\s*(disable|disable-next-line)\s*=\s*"
+    r"(all|[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+)
+
+
+def collect(source: str) -> Dict[int, Set[str]]:
+    """Map of 1-based line number -> set of disabled rule codes (the token
+    ``"all"`` disables every rule on that line).
+
+    Pragmas are recognized only in real COMMENT tokens — pragma-shaped text
+    inside a string literal or docstring (e.g. a module documenting the
+    pragma syntax) must never silently disable rules on its line."""
+    disabled: Dict[int, Set[str]] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA_RE.search(tok.string)
+            if not m:
+                continue
+            kind, codes_raw = m.group(1), m.group(2)
+            codes = {c.strip() for c in codes_raw.split(",") if c.strip()}
+            lineno = tok.start[0]
+            target = lineno + 1 if kind == "disable-next-line" else lineno
+            disabled.setdefault(target, set()).update(codes)
+    except (tokenize.TokenError, IndentationError):
+        # unparsable tail (callers lint only sources that already passed
+        # ast.parse, so this is belt-and-braces); keep what we collected
+        pass
+    return disabled
+
+
+def is_disabled(disabled: Dict[int, Set[str]], line: int, rule: str) -> bool:
+    codes = disabled.get(line)
+    if not codes:
+        return False
+    return "all" in codes or rule in codes

@@ -1,0 +1,233 @@
+"""File walking, cross-module checks, suppression, and output."""
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+from tools.rxgblint import baseline as baseline_mod
+from tools.rxgblint import catalog, pragmas, rules
+from tools.rxgblint.findings import RULES, Finding
+
+
+def _rel(path: str, root: str) -> str:
+    try:
+        return os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
+    except ValueError:  # different drive (windows)
+        return path.replace(os.sep, "/")
+
+
+class TargetError(Exception):
+    """A lint target doesn't exist or isn't Python — a typo'd path must be
+    a loud usage error, never a vacuous 0-files/0-findings exit 0 (this is
+    the first tier-1 CI gate; passing because it checked nothing is the
+    worst possible failure mode)."""
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        elif os.path.isfile(p):
+            if not p.endswith(".py"):
+                raise TargetError(f"not a Python file: {p!r}")
+            out.append(p)
+        else:
+            raise TargetError(f"no such file or directory: {p!r}")
+    return out
+
+
+def _lint_module(
+    mod: "rules._Module",
+    source: str,
+    only: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Run every rule over one parsed module and apply its pragmas — the
+    single per-file pipeline both lint_source and run_lint share, so
+    suppression semantics can never diverge between the fixture-test path
+    and the CLI."""
+    findings: List[Finding] = []
+    for check in rules.ALL_CHECKS:
+        code = check.__name__.replace("check_", "").upper()
+        if only is not None and code not in {c.upper() for c in only}:
+            continue
+        findings.extend(check(mod))
+    disabled = pragmas.collect(source)
+    for f in findings:
+        if pragmas.is_disabled(disabled, f.line, f.rule):
+            f.suppressed = "pragma"
+    return findings
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    root: str = catalog.REPO_ROOT,
+    only: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Lint one source blob; the unit the fixture tests drive. ``only``
+    restricts to the named rule codes. Pragmas are applied (suppressed
+    findings are returned tagged, not dropped)."""
+    try:
+        mod = rules._Module(source, path, root=root)
+    except SyntaxError as exc:
+        return [Finding(
+            rule="PARSE", path=path, line=exc.lineno or 1, col=0,
+            message=f"syntax error: {exc.msg}", scope="<module>",
+        )]
+    findings = _lint_module(mod, source, only=only)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def _covers_package(mods: Dict[str, "rules._Module"], root: str) -> bool:
+    """True when the linted set includes every .py file of the package —
+    the precondition for whole-package properties (reverse coverage, stale
+    baselines). Linting a single file must not claim the rest of the
+    package's call sites don't exist."""
+    for path in catalog._package_files(root):
+        if _rel(path, root) not in mods:
+            return False
+    return True
+
+
+def _cross_module_checks(
+    mods: Dict[str, "rules._Module"], root: str
+) -> List[Finding]:
+    """Whole-package reverse checks: every catalogued fault site must have a
+    call site; every catalogued trace name must be emitted somewhere."""
+    findings: List[Finding] = []
+
+    sites = catalog.fault_sites(root)
+    if sites:
+        used = set()
+        for path, mod in mods.items():
+            if path.endswith("faults.py"):
+                continue
+            used |= rules.collect_fault_sites_used(mod)
+        faults_rel = f"{catalog.PACKAGE}/faults.py"
+        for site in sites:
+            if site not in used:
+                findings.append(Finding(
+                    rule="FAULT001", path=faults_rel, line=1, col=0,
+                    scope="<module>",
+                    message=(
+                        f"faults.SITES declares {site!r} but no faults.fire"
+                        f"()/fire_file()/plan_targets() call site names it: "
+                        f"plans targeting it silently never fire"
+                    ),
+                ))
+
+    names = catalog.trace_names(root)
+    if names:
+        emitted = set()
+        for path, mod in mods.items():
+            if path.endswith("obs/trace.py"):
+                continue
+            emitted |= rules.collect_trace_literals(mod)
+        trace_rel = f"{catalog.PACKAGE}/obs/trace.py"
+        for name in sorted(names - emitted):
+            findings.append(Finding(
+                rule="OBS001", path=trace_rel, line=1, col=0,
+                scope="<module>",
+                message=(
+                    f"TRACE_NAMES catalogs {name!r} but nothing in the "
+                    f"package emits it: stale catalog entry (or the "
+                    f"emission site lost its literal)"
+                ),
+            ))
+    return findings
+
+
+def run_lint(
+    paths: Sequence[str],
+    root: str = catalog.REPO_ROOT,
+    baseline_path: Optional[str] = None,
+) -> Dict[str, object]:
+    """Lint ``paths``; returns the full report dict the CLI renders.
+
+    ``baseline_path=None`` uses the shipped baseline file; pass "" to run
+    baseline-free (the fixture tests do)."""
+    files = iter_python_files(paths)
+    findings: List[Finding] = []
+    mods: Dict[str, rules._Module] = {}
+    for path in files:
+        rel = _rel(path, root)
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        try:
+            mod = rules._Module(source, rel, root=root)
+        except SyntaxError as exc:
+            findings.append(Finding(
+                rule="PARSE", path=rel, line=exc.lineno or 1, col=0,
+                message=f"syntax error: {exc.msg}", scope="<module>",
+            ))
+            continue
+        mods[rel] = mod
+        findings.extend(_lint_module(mod, source))
+    full_package = _covers_package(mods, root)
+    if full_package:
+        findings.extend(_cross_module_checks(mods, root))
+
+    if baseline_path is None:
+        baseline_path = baseline_mod.DEFAULT_BASELINE
+    entries = baseline_mod.load(baseline_path) if baseline_path else []
+    stale, n_baselined = baseline_mod.apply(findings, entries)
+    if not full_package:
+        # a partial lint can't distinguish "stale" from "not linted today"
+        stale = []
+
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    open_findings = [f for f in findings if not f.suppressed]
+    counts: Dict[str, int] = {}
+    for f in open_findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return {
+        "files": len(files),
+        "findings": findings,
+        "open": open_findings,
+        "counts": counts,
+        "baselined": n_baselined,
+        "pragma_suppressed": sum(
+            1 for f in findings if f.suppressed == "pragma"
+        ),
+        "stale_baseline": stale,
+    }
+
+
+def render_report(report: Dict[str, object], show_suppressed: bool = False) -> str:
+    lines: List[str] = []
+    for f in report["findings"]:
+        if f.suppressed and not show_suppressed:
+            continue
+        lines.append(f.render())
+    for e in report["stale_baseline"]:
+        lines.append(
+            f"{e['path']}: stale baseline entry ({e['rule']} @ {e['scope']}): "
+            f"no current finding matches — remove it"
+        )
+    n_open = len(report["open"])
+    lines.append(
+        f"rxgblint: {report['files']} files, {n_open} finding(s), "
+        f"{report['baselined']} baselined, "
+        f"{report['pragma_suppressed']} pragma-suppressed"
+    )
+    return "\n".join(lines)
+
+
+def report_to_json(report: Dict[str, object]) -> str:
+    doc = {
+        "tool": "rxgblint",
+        "rules": RULES,
+        "files": report["files"],
+        "counts": report["counts"],
+        "baselined": report["baselined"],
+        "pragma_suppressed": report["pragma_suppressed"],
+        "stale_baseline": report["stale_baseline"],
+        "findings": [f.to_dict() for f in report["findings"]],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)

@@ -40,7 +40,7 @@ from xgboost_ray_tpu.data_sources import RayFileType
 from xgboost_ray_tpu.models.booster import Booster, RayXGBoostBooster
 from xgboost_ray_tpu.callback import DistributedCallback, TrainingCallback
 from xgboost_ray_tpu import faults, obs
-from xgboost_ray_tpu.obs import validate_trace_records
+from xgboost_ray_tpu.obs import recovery_time_s, validate_trace_records
 from xgboost_ray_tpu.launcher import (
     AsyncCheckpointWriter,
     LaunchContext,
@@ -72,6 +72,7 @@ __all__ = [
     "faults",
     "obs",
     "validate_trace_records",
+    "recovery_time_s",
     "LaunchContext",
     "LaunchResult",
     "launch_distributed",

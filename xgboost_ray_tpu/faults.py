@@ -176,11 +176,15 @@ class FaultPlan:
         self.reset()
 
     def reset(self) -> None:
-        self._seen = [0] * len(self.rules)
-        self._rngs = [
-            random.Random(self.seed * 1000003 + i)
-            for i in range(len(self.rules))
-        ]
+        # under the lock: a reset racing a concurrent fire() (e.g. a test
+        # rewinding a plan while a launcher thread still fires) must never
+        # interleave with _due()'s counter advance mid-sweep
+        with self._lock:
+            self._seen = [0] * len(self.rules)
+            self._rngs = [
+                random.Random(self.seed * 1000003 + i)
+                for i in range(len(self.rules))
+            ]
 
     # -- serialization ------------------------------------------------------
 
@@ -226,7 +230,19 @@ class FaultPlan:
             rule = self.rules[i]
             if rule.action in ("corrupt", "truncate"):
                 _emit_fault_event(site, rule.action, ctx)
-                self._damage_file(rule, path, self._rngs[i])
+                positions: List[int] = []
+                if rule.action == "corrupt":
+                    size = os.path.getsize(path)
+                    # draw the byte positions under the lock: the Random's
+                    # state IS the determinism contract ("same plan, byte-
+                    # identical faults"), and two sites due concurrently on
+                    # one rule must not interleave draws from its stream
+                    # (truncate never draws — don't advance it spuriously)
+                    with self._lock:
+                        rng = self._rngs[i]
+                        n = min(rule.nbytes or 16, size)
+                        positions = [rng.randrange(size) for _ in range(n)]
+                self._damage_file(rule, path, positions)
             else:
                 self._perform(rule, site, ctx)
 
@@ -254,17 +270,15 @@ class FaultPlan:
             )
 
     @staticmethod
-    def _damage_file(rule: FaultRule, path: str, rng: random.Random) -> None:
+    def _damage_file(rule: FaultRule, path: str, positions: List[int]) -> None:
         size = os.path.getsize(path)
         if rule.action == "truncate":
             keep = rule.nbytes if rule.nbytes else size // 2
             with open(path, "rb+") as f:
                 f.truncate(min(keep, size))
             return
-        n = rule.nbytes or 16
         with open(path, "rb+") as f:
-            for _ in range(min(n, size)):
-                pos = rng.randrange(size)
+            for pos in positions:
                 f.seek(pos)
                 byte = f.read(1)
                 f.seek(pos)

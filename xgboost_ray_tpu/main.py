@@ -884,7 +884,10 @@ def _train(
         # offset alone is stale when the immediate-reintegration fast path
         # reuses the attempt's compiled engine mid-flight.
         obs.get_tracer().event(
-            f"world.{kind}",
+            # static literals (not f"world.{kind}") so the timeline event
+            # vocabulary stays greppable and checkable against TRACE_NAMES
+            "world.shrink" if kind == "shrink"
+            else "world.grow" if kind == "grow" else "world.resume",
             round=engine.iteration_offset + engine.num_round_trees,
             attrs={
                 "world": len(new_alive),

@@ -32,6 +32,7 @@ from xgboost_ray_tpu.obs.metrics import (
     get_registry,
 )
 from xgboost_ray_tpu.obs.trace import (
+    TRACE_NAMES,
     Tracer,
     get_tracer,
     recovery_time_s,
@@ -46,6 +47,7 @@ __all__ = [
     "Gauge",
     "LatencyHistogram",
     "MetricsRegistry",
+    "TRACE_NAMES",
     "Tracer",
     "get_registry",
     "get_tracer",

@@ -43,6 +43,7 @@ import time
 from typing import Any, Dict, Iterable, List, Optional
 
 __all__ = [
+    "TRACE_NAMES",
     "Tracer",
     "get_tracer",
     "set_default_tracer",
@@ -52,6 +53,27 @@ __all__ = [
 ]
 
 _DEFAULT_CAPACITY = 8192
+
+#: The declared catalog of every span/event name the runtime emits — the
+#: single source of truth rxgblint's OBS001 checks emission sites against
+#: (both directions: an uncatalogued emission and a never-emitted catalog
+#: entry are each findings), and the optional ``known_names`` vocabulary
+#: for :func:`validate_trace_records`. Grouped by emitting layer.
+TRACE_NAMES = frozenset({
+    # engine round/phase spans (engine.py; phase spans via profile_phases)
+    "round", "sample", "hist", "split", "partition", "margin", "allreduce",
+    # driver lifecycle (main.py)
+    "attempt", "failure.detected", "recovered", "backoff",
+    "world.shrink", "world.grow", "world.resume", "world.restart",
+    "checkpoint.commit", "allreduce.bytes",
+    # elastic scheduler (elastic.py)
+    "elastic.reschedule", "elastic.ready",
+    # launcher (launcher.py)
+    "launcher.spawn", "launcher.hung", "launcher.attempt_failed",
+    "checkpoint.load",
+    # fault injection (faults.py)
+    "fault.injected",
+})
 
 
 def _process_rank() -> int:
@@ -105,9 +127,13 @@ class Tracer:
 
     @property
     def dropped(self) -> int:
-        return self._dropped
+        with self._lock:
+            return self._dropped
 
-    def _next_seq(self) -> int:
+    def _next_seq_locked(self) -> int:
+        # _locked suffix = caller holds self._lock (enforced by rxgblint
+        # LOCK001 on both ends: this method may touch shared state bare,
+        # and every call site must sit inside `with self._lock`)
         self._seq += 1
         return self._seq
 
@@ -116,11 +142,11 @@ class Tracer:
             if len(self._buf) == self.capacity:
                 self._dropped += 1
             self._buf.append(rec)
-            self._stream(rec)
+            self._stream_locked(rec)
 
-    def _stream(self, rec: Dict[str, Any]) -> None:
+    def _stream_locked(self, rec: Dict[str, Any]) -> None:
         """Append one JSON line to the per-rank trace file (best-effort;
-        called under the lock)."""
+        caller holds the lock)."""
         if not self._trace_dir or self._stream_failed:
             return
         try:
@@ -150,7 +176,7 @@ class Tracer:
         if stack is None:
             stack = self._tls.stack = []
         with self._lock:
-            seq = self._next_seq()
+            seq = self._next_seq_locked()
         parent = stack[-1] if stack else None
         stack.append(seq)
         ts = time.time()
@@ -174,7 +200,7 @@ class Tracer:
         if not self.enabled:
             return
         with self._lock:
-            seq = self._next_seq()
+            seq = self._next_seq_locked()
         stack = getattr(self._tls, "stack", None)
         parent = stack[-1] if stack else None
         self._finish_span(name, ts, dur_s, seq, parent, round, attrs)
@@ -208,7 +234,7 @@ class Tracer:
         merged = dict(attrs) if attrs else {}
         merged.update(kw)
         with self._lock:
-            seq = self._next_seq()
+            seq = self._next_seq_locked()
         rec: Dict[str, Any] = {
             "kind": "event",
             "name": name,
@@ -303,12 +329,21 @@ def use_tracer(tracer: Tracer):
 _ALLOWED_KEYS = {"kind", "name", "ts", "seq", "dur_s", "parent", "round", "attrs"}
 
 
-def validate_trace_records(records: Iterable[Dict[str, Any]]) -> List[str]:
+def validate_trace_records(
+    records: Iterable[Dict[str, Any]],
+    known_names: Optional[Iterable[str]] = None,
+) -> List[str]:
     """Validate records against the trace schema; returns a list of problem
     strings (empty = valid). Exported at package top level so tests and the
-    CI example (``examples/trace_run.py``) share one checker."""
+    CI example (``examples/trace_run.py``) share one checker.
+
+    ``known_names`` opts into vocabulary checking: pass :data:`TRACE_NAMES`
+    (or any custom set) and a record whose ``name`` is outside it becomes a
+    problem — the runtime counterpart of rxgblint's static OBS001 check.
+    The default (``None``) keeps the historical schema-only behavior."""
     problems: List[str] = []
     seen_seq = set()
+    name_vocab = None if known_names is None else set(known_names)
     for i, rec in enumerate(records):
         where = f"record {i}"
         if not isinstance(rec, dict):
@@ -323,6 +358,8 @@ def validate_trace_records(records: Iterable[Dict[str, Any]]) -> List[str]:
         name = rec.get("name")
         if not isinstance(name, str) or not name:
             problems.append(f"{where}: bad name {name!r}")
+        elif name_vocab is not None and name not in name_vocab:
+            problems.append(f"{where}: unknown name {name!r}")
         if not isinstance(rec.get("ts"), (int, float)):
             problems.append(f"{where}: bad ts {rec.get('ts')!r}")
         seq = rec.get("seq")

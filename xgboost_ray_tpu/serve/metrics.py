@@ -191,6 +191,9 @@ class ServeMetrics:
             batches = self.batches
             batch_rows = self.batch_rows
             padded = self.padded_rows
+            # reset() rebaselines this under the same lock; reading it
+            # outside the cut could pair a new baseline with old counters
+            recompile_base = self._recompile_base
         issued = batch_rows + padded
         snap = {
             "uptime_s": round(elapsed, 3),
@@ -215,7 +218,7 @@ class ServeMetrics:
             snap.update(self.breaker_fn())
         if self.recompile_count_fn is not None:
             snap["recompile_count"] = (
-                int(self.recompile_count_fn()) - self._recompile_base
+                int(self.recompile_count_fn()) - recompile_base
             )
         return snap
 

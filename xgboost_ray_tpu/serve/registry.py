@@ -92,6 +92,12 @@ class ModelRegistry:
         default_factory=lambda: threading.Condition(threading.Lock()),
         repr=False,
     )
+    #: serializes whole load() calls (not just the flip): two concurrent
+    #: loads otherwise both predict version N+1 before either commits, so
+    #: the fault-injection key and the committed version could disagree
+    _load_lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False
+    )
     _current: Optional[ModelEntry] = field(default=None, repr=False)
     _inflight: int = field(default=0, repr=False)
     _swapping: bool = field(default=False, repr=False)
@@ -100,28 +106,37 @@ class ModelRegistry:
     def load(self, model: Any, name: str = "", warm: bool = True) -> int:
         """Register ``model`` and atomically make it current; returns the
         new version. Compiles (warmup) happen before the old model stops
-        serving, and in-flight batches drain before the flip."""
-        faults.fire("registry.swap", version=self._version + 1)
-        booster = coerce_model(model)
-        predictor = CompiledPredictor(
-            booster, devices=self.devices, min_bucket=self.min_bucket
-        )
-        if warm and self.warm_kinds:
-            kinds = [k for k in self.warm_kinds if k in KINDS]
-            predictor.warmup(kinds=kinds, max_batch=self.warm_max_batch)
-        with self._cond:
-            # serialize swaps; each waits for the previous flip to finish
-            while self._swapping:
-                self._cond.wait()
-            self._swapping = True
-            while self._inflight:
-                self._cond.wait()
-            self._version += 1
-            entry = ModelEntry(self._version, booster, predictor, name=name)
-            was_live = self._current is not None
-            self._current = entry
-            self._swapping = False
-            self._cond.notify_all()
+        serving, and in-flight batches drain before the flip. Whole loads
+        serialize (leases do NOT — the old model keeps serving while the
+        new one compiles): with only the flip serialized, two concurrent
+        loads would both predict version N+1 at fire time and the
+        fault-injection key would disagree with the committed version."""
+        with self._load_lock:
+            # exact under _load_lock: no other load can commit in between,
+            # and a failed load (fault fired, bad model) consumes nothing
+            with self._cond:
+                next_version = self._version + 1
+            faults.fire("registry.swap", version=next_version)
+            booster = coerce_model(model)
+            predictor = CompiledPredictor(
+                booster, devices=self.devices, min_bucket=self.min_bucket
+            )
+            if warm and self.warm_kinds:
+                kinds = [k for k in self.warm_kinds if k in KINDS]
+                predictor.warmup(kinds=kinds, max_batch=self.warm_max_batch)
+            with self._cond:
+                # serialize vs the drain; leases block only during the flip
+                while self._swapping:
+                    self._cond.wait()
+                self._swapping = True
+                while self._inflight:
+                    self._cond.wait()
+                self._version = next_version
+                entry = ModelEntry(next_version, booster, predictor, name=name)
+                was_live = self._current is not None
+                self._current = entry
+                self._swapping = False
+                self._cond.notify_all()
         if was_live and self.metrics is not None:
             self.metrics.observe_swap()
         return entry.version

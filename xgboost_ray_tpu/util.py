@@ -92,5 +92,6 @@ def restart_backoff_s(
         jitter = float(os.environ.get("RXGB_RESTART_BACKOFF_JITTER", "0.1"))
     delay = min(cap, base * (2.0 ** max(0, int(restart_index))))
     if jitter > 0:
+        # rxgblint: disable-next-line=DET001 - restart-schedule jitter only; never touches model state
         delay *= 1.0 + random.random() * jitter
     return delay
