@@ -1245,18 +1245,36 @@ def run_measurement():
         "hist_quant": hist_quant,
     }
 
+    from xgboost_ray_tpu import progreg
+
     train_start = time.time()
     additional_results = {}
-    bst = train(
-        params,
-        dtrain,
-        num_boost_round=rounds,
-        additional_results=additional_results,
-        ray_params=RayParams(num_actors=actors, checkpoint_frequency=0),
-    )
-    train_time = time.time() - train_start
-    print(f"[bench] TRAIN TIME TAKEN: {train_time:.2f}s", file=sys.stderr)
-    assert bst.num_boosted_rounds() == rounds
+    # capture the protocol run's compiled-program signatures so the snapshot
+    # carries their jaxpr fingerprints (tools/rxgbverify) — a PR that
+    # silently changes a compiled program shows up as a fingerprint diff
+    # across BENCH_*.json files. Capture costs one early-returning branch
+    # per registration site; the abstract re-trace below runs post-timing.
+    with progreg.capture():
+        progreg.clear()
+        bst = train(
+            params,
+            dtrain,
+            num_boost_round=rounds,
+            additional_results=additional_results,
+            ray_params=RayParams(num_actors=actors, checkpoint_frequency=0),
+        )
+        train_time = time.time() - train_start
+        print(f"[bench] TRAIN TIME TAKEN: {train_time:.2f}s", file=sys.stderr)
+        assert bst.num_boosted_rounds() == rounds
+        try:
+            from tools.rxgbverify import fingerprint_registry
+
+            program_fingerprints = fingerprint_registry()
+        except Exception as exc:  # fingerprinting must never fail the bench
+            print(f"[bench] program fingerprinting failed: {exc}",
+                  file=sys.stderr)
+            program_fingerprints = {}
+    progreg.clear()  # drop the engine references the records keep alive
 
     # per-round time series: the artifact the single-chip -> 8-chip projection
     # argues from (VERDICT r3 weak #7). First chunk carries the compile; the
@@ -1277,6 +1295,11 @@ def run_measurement():
             detail["steady_median_s"] = round(float(np.median(steady)), 4)
             detail["steady_p90_s"] = round(float(np.percentile(steady, 90)), 4)
         print(f"[bench] round-time detail: {detail}", file=sys.stderr)
+
+    if program_fingerprints:
+        detail["program_fingerprints"] = program_fingerprints
+        print(f"[bench] {len(program_fingerprints)} program fingerprints "
+              f"recorded", file=sys.stderr)
 
     # measured collective wire bytes per round (the hist_quant metric; see
     # ops/histogram.py AllreduceBytes for the ring-model accounting)

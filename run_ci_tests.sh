@@ -5,8 +5,16 @@ cd "$(dirname "$0")"
 echo "================= rxgblint static analysis (tier-1 gate) ================="
 # fails on any non-baselined finding; the JSON artifact lets future PRs
 # diff finding counts (tools/rxgblint/baseline.json holds justified ones)
-python -m tools.rxgblint xgboost_ray_tpu --json /tmp/rxgblint.json
+python -m tools.rxgblint xgboost_ray_tpu --json /tmp/rxgblint.json --sarif /tmp/rxgblint.sarif
+echo "================= rxgbverify jaxpr verification (tier-1 gate) ================="
+# second static-analysis layer: re-traces every compiled program the full
+# config matrix (grower x hist_quant x sampling x world 2/4/8) can produce
+# and checks collective-schedule identity / precision flow / drift
+# fingerprints on the jaxprs; exits non-zero on any finding. The JSON
+# artifact (incl. per-program fingerprints) is what future PRs diff.
+python -m tools.rxgbverify --json /tmp/rxgbverify.json --sarif /tmp/rxgbverify.sarif --fingerprints /tmp/rxgbverify_fingerprints.json
 python -m pytest tests/test_lint.py -v -x
+python -m pytest tests/test_verify.py -v -x
 python -m pytest tests/test_matrix.py -v -x
 python -m pytest tests/test_data_source.py -v -x
 python -m pytest tests/test_ops.py -v -x

@@ -30,6 +30,12 @@ def main(argv=None) -> int:
              "future PRs diff finding counts against)",
     )
     parser.add_argument(
+        "--sarif", metavar="FILE",
+        help="also write open findings as SARIF 2.1.0 (code-review "
+             "annotations; suppressed findings stay out — they are not "
+             "actionable on a diff)",
+    )
+    parser.add_argument(
         "--baseline", default=DEFAULT_BASELINE, metavar="FILE",
         help="justified-suppression baseline (default: the shipped one); "
              "pass an empty string to run baseline-free",
@@ -73,6 +79,14 @@ def main(argv=None) -> int:
     if args.json:
         with open(args.json, "w") as f:
             f.write(report_to_json(report) + "\n")
+    if args.sarif:
+        from tools.sarif import to_sarif_json
+
+        with open(args.sarif, "w") as f:
+            f.write(to_sarif_json(
+                "rxgblint", RULES,
+                [f_.to_dict() for f_ in report["open"]],
+            ) + "\n")
     status = 1 if report["open"] else 0
     try:
         print(render_report(report, show_suppressed=args.show_suppressed))

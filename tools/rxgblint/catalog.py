@@ -51,11 +51,38 @@ def _parse(path: str) -> ast.Module:
 
 
 @functools.lru_cache(maxsize=None)
+def axis_constants(root: str = REPO_ROOT) -> Tuple[Tuple[str, str], ...]:
+    """``AXIS_*`` name -> value pairs declared in the package's constants
+    module (``xgboost_ray_tpu/constants.py``) — the one source of truth the
+    Mesh constructors, SPMD002, and rxgbverify's schedule checks all share.
+    Sorted tuple-of-pairs (hashable for the lru caches downstream)."""
+    path = os.path.join(root, PACKAGE, "constants.py")
+    pairs = {}
+    try:
+        tree = _parse(path)
+    except (OSError, SyntaxError):
+        return ()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Name)
+                    and tgt.id.startswith("AXIS_")
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)
+                ):
+                    pairs[tgt.id] = node.value.value
+    return tuple(sorted(pairs.items()))
+
+
+@functools.lru_cache(maxsize=None)
 def mesh_axes(root: str = REPO_ROOT) -> FrozenSet[str]:
     """Mesh-axis catalog: every string inside a tuple passed to a ``Mesh``
-    constructor anywhere in the package. Falls back to {"actors"} (the
+    constructor anywhere in the package, with ``AXIS_*`` constant names
+    resolved through :func:`axis_constants`. Falls back to {"actors"} (the
     engine's 1D row mesh) if extraction comes up empty."""
     axes: Set[str] = set()
+    consts = dict(axis_constants(root))
     for path in _package_files(root):
         try:
             tree = _parse(path)
@@ -69,6 +96,8 @@ def mesh_axes(root: str = REPO_ROOT) -> FrozenSet[str]:
                     for elt in arg.elts:
                         if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
                             axes.add(elt.value)
+                        elif isinstance(elt, ast.Name) and elt.id in consts:
+                            axes.add(consts[elt.id])
     return frozenset(axes) if axes else frozenset({"actors"})
 
 

@@ -335,6 +335,19 @@ def check_spmd002(mod: _Module) -> List[Finding]:
             ]
         elif isinstance(axis_arg, (ast.Name, ast.Attribute)):
             ident = _terminal(axis_arg)
+            consts = dict(catalog.axis_constants(mod.root))
+            if ident in consts:
+                # declared AXIS_* constant: resolve to its value and
+                # validate like a literal (one source of truth with the
+                # Mesh constructors — see constants.py)
+                if consts[ident] not in axes:
+                    findings.append(mod.finding(
+                        "SPMD002", node,
+                        f"collective {name!r} axis constant {ident} "
+                        f"resolves to {consts[ident]!r}, not a declared "
+                        f"mesh axis: {sorted(axes)}",
+                    ))
+                continue
             if "axis" not in ident.lower():
                 findings.append(mod.finding(
                     "SPMD002", node,

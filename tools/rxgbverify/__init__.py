@@ -1,0 +1,46 @@
+"""rxgbverify: jaxpr-level SPMD schedule / precision-flow / drift verifier.
+
+The second static-analysis layer (after the source-AST ``tools/rxgblint``):
+re-traces every compiled program the package registers with
+``xgboost_ray_tpu.progreg`` into its ``ClosedJaxpr`` — abstractly, on CPU,
+no execution — and checks the properties AST analysis cannot see:
+
+* the ordered collective schedule is identical across every world size the
+  elastic engine-cache can interleave (VER001 — deadlock-freedom
+  certificate for zero-replay shrink/grow),
+* no collective hides inside a ``lax.cond`` branch (VER002),
+* collective axis names resolve against the same mesh-axis catalog
+  rxgblint's SPMD002 uses (VER003),
+* the hist_quant int8/int16 payload reaches the wire un-upcast and the f32
+  fallback psum of the full histogram is gone (VER004), no f64 anywhere
+  (VER005), and every donated buffer is actually aliasable (VER006),
+* a stable per-program fingerprint of (jaxpr structure, avals, donation),
+  recorded to a JSON artifact and into BENCH snapshots so silent program
+  drift shows up as a reviewable diff.
+
+CLI: ``python -m tools.rxgbverify [--json F] [--sarif F] [--fingerprints F]``
+— traces the full config matrix (growers x hist_quant x sampling x world
+2/4/8) and exits non-zero on any finding.
+"""
+
+from tools.rxgbverify.checks import VERIFY_RULES, Finding, run_checks  # noqa: F401
+from tools.rxgbverify.walker import (  # noqa: F401
+    Collective,
+    TracedProgram,
+    analyze,
+    fingerprint,
+    trace_record,
+)
+
+
+def fingerprint_registry():
+    """Fingerprint every program currently in the progreg registry —
+    ``{program key: fingerprint}`` (or a ``trace-error:`` marker). This is
+    the mapping bench.py embeds in every BENCH snapshot."""
+    from xgboost_ray_tpu import progreg
+
+    out = {}
+    for rec in progreg.records():
+        t = trace_record(rec)
+        out[t.key()] = t.fingerprint if t.ok else f"trace-error: {t.error}"
+    return out

@@ -1,0 +1,324 @@
+"""Verification passes over abstractly re-traced programs.
+
+Every check consumes ``walker.TracedProgram`` lists — no execution, no
+compilation. Rule codes are VER*, disjoint from rxgblint's AST rules so a
+combined SARIF upload stays unambiguous.
+"""
+
+import dataclasses
+import os
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from tools.rxgbverify.walker import TracedProgram
+
+#: rule code -> one-line description (printed by --list-checks, embedded in
+#: the SARIF rule catalog, documented in README "Static analysis")
+VERIFY_RULES: Dict[str, str] = {
+    "VER001": (
+        "collective schedule differs across coexisting world sizes: an "
+        "elastic shrink/grow recompile would execute mismatched collective "
+        "sequences — the torn-allreduce cluster hang"
+    ),
+    "VER002": (
+        "collective inside a lax.cond branch: shard-divergent predicates "
+        "make some ranks skip the collective (hang) — invisible to "
+        "source-level SPMD001"
+    ),
+    "VER003": (
+        "collective axis name not in the declared mesh-axis catalog "
+        "(shared with rxgblint SPMD002)"
+    ),
+    "VER004": (
+        "quantized histogram contract broken: the int8/int16 payload is "
+        "upcast before the wire collective, or the f32 fallback psum of "
+        "the full histogram survives in a quantized program"
+    ),
+    "VER005": (
+        "float64 aval in a compiled program: TPU-hostile dtype, doubles "
+        "collective bytes, breaks f32 determinism assumptions"
+    ),
+    "VER006": (
+        "donated input buffer matches no output shape/dtype: the donation "
+        "frees nothing and silently invalidates the caller's array"
+    ),
+    "TRACE": "program failed to re-trace abstractly from its registered signature",
+}
+
+#: program names subject to the quantized precision-flow pass (the round
+#: steps that embed quantized_hist_allreduce)
+_HIST_QUANT_PROGRAMS = (
+    "engine.step", "engine.step_custom", "engine.step_many", "engine.step_dart",
+)
+
+_NARROW = {"int8": "int8", "int16": "int16"}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    program: str  # TracedProgram.key()
+    message: str
+    path: str = ""  # registration-site file (repo-relative), for SARIF
+    line: int = 1
+
+    def render(self) -> str:
+        return f"{self.program}: {self.rule} {self.message}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "program": self.program,
+            "message": self.message,
+            "path": self.path,
+            "line": self.line,
+        }
+
+
+def _rel(path: str, root: Optional[str]) -> str:
+    if not root:
+        return path.replace(os.sep, "/")
+    try:
+        rel = os.path.relpath(os.path.abspath(path), root)
+    except ValueError:  # different drive
+        return path.replace(os.sep, "/")
+    return (path if rel.startswith("..") else rel).replace(os.sep, "/")
+
+
+def _finding(t: TracedProgram, rule: str, message: str,
+             root: Optional[str]) -> Finding:
+    src_file, src_line = t.record.source
+    return Finding(
+        rule=rule,
+        program=t.key(),
+        message=message,
+        path=_rel(src_file, root),
+        line=src_line,
+    )
+
+
+def _group_key(t: TracedProgram) -> tuple:
+    """Cross-world grouping: everything but ``world``."""
+    return (
+        t.record.name,
+        tuple(sorted(
+            (k, v) for k, v in t.record.meta.items() if k != "world"
+        )),
+    )
+
+
+def check_trace_failures(traced: Sequence[TracedProgram],
+                         root: Optional[str] = None) -> List[Finding]:
+    return [
+        _finding(t, "TRACE", f"abstract re-trace failed: {t.error}", root)
+        for t in traced if not t.ok
+    ]
+
+
+def check_schedule_identity(traced: Sequence[TracedProgram],
+                            root: Optional[str] = None) -> List[Finding]:
+    """VER001: programs that only differ in ``world`` must run the identical
+    (prim, axes, dtype, rank) collective sequence — the deadlock-freedom
+    certificate for the elastic engine-cache's coexisting worlds."""
+    findings: List[Finding] = []
+    groups: Dict[tuple, Dict[int, List[TracedProgram]]] = {}
+    for t in traced:
+        if not t.ok or "world" not in t.record.meta:
+            continue
+        groups.setdefault(_group_key(t), {}).setdefault(
+            int(t.record.meta["world"]), []
+        ).append(t)
+    for key, by_world in sorted(groups.items()):
+        if len(by_world) < 2:
+            continue
+        worlds = sorted(by_world)
+        # per world: the sorted multiset of schedules (a name+meta can have
+        # several records at different shapes, all collective-free or alike)
+        def sched_set(w):
+            return sorted(t.analysis.schedule() for t in by_world[w])
+        ref_w = worlds[0]
+        ref = sched_set(ref_w)
+        for w in worlds[1:]:
+            cur = sched_set(w)
+            if cur == ref:
+                continue
+            t = by_world[w][0]
+            detail = _first_divergence(ref, cur, ref_w, w)
+            findings.append(_finding(
+                t, "VER001",
+                f"collective schedule at world={w} differs from world="
+                f"{ref_w}: {detail}",
+                root,
+            ))
+    return findings
+
+
+def _first_divergence(ref, cur, ref_w, w) -> str:
+    if len(ref) != len(cur):
+        return f"{len(ref)} vs {len(cur)} program variants"
+    for rs, cs in zip(ref, cur):
+        if rs == cs:
+            continue
+        n = min(len(rs), len(cs))
+        for i in range(n):
+            if rs[i] != cs[i]:
+                return (f"position {i}: world={ref_w} runs {rs[i]}, "
+                        f"world={w} runs {cs[i]}")
+        return (f"length {len(rs)} (world={ref_w}) vs {len(cs)} "
+                f"(world={w}) collectives")
+    return "schedules differ"
+
+
+def check_cond_collectives(traced: Sequence[TracedProgram],
+                           root: Optional[str] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for t in traced:
+        if not t.ok:
+            continue
+        for c in t.analysis.collectives:
+            if c.in_cond:
+                findings.append(_finding(
+                    t, "VER002",
+                    f"{c.describe()} executes inside a cond branch",
+                    root,
+                ))
+    return findings
+
+
+def check_axis_names(traced: Sequence[TracedProgram],
+                     mesh_axes: FrozenSet[str],
+                     root: Optional[str] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for t in traced:
+        if not t.ok:
+            continue
+        for c in t.analysis.collectives:
+            bad = [a for a in c.axes if a not in mesh_axes]
+            if bad:
+                findings.append(_finding(
+                    t, "VER003",
+                    f"{c.describe()} uses axis {bad} not in the mesh "
+                    f"catalog {sorted(mesh_axes)}",
+                    root,
+                ))
+    return findings
+
+
+def check_precision_flow(traced: Sequence[TracedProgram],
+                         root: Optional[str] = None) -> List[Finding]:
+    """VER004: in a hist_quant=int8/int16 round program the histogram wire
+    must stay narrow end to end — a single ``convert_element_type -> f32``
+    before the ``all_to_all`` silently re-inflates every byte the mode was
+    bought to save, and the f32 fallback psum of the full [nodes, F, bins, 2]
+    payload must be gone entirely."""
+    findings: List[Finding] = []
+    for t in traced:
+        if not t.ok or t.record.name not in _HIST_QUANT_PROGRAMS:
+            continue
+        narrow = _NARROW.get(str(t.record.meta.get("hist_quant", "none")))
+        if narrow is None:
+            continue
+        colls = t.analysis.collectives
+        a2a = [c for c in colls if c.prim == "all_to_all"]
+        ag = [c for c in colls if c.prim == "all_gather"]
+        if not a2a:
+            findings.append(_finding(
+                t, "VER004",
+                "no all_to_all in a quantized-histogram program: the "
+                "reduce-scatter stage traced away (f32 fallback engaged?)",
+                root,
+            ))
+        for c in a2a:
+            if c.dtype != narrow:
+                findings.append(_finding(
+                    t, "VER004",
+                    f"all_to_all payload is {c.dtype}, expected {narrow}: "
+                    f"upcast before the wire ({c.describe()})",
+                    root,
+                ))
+        if not any(c.dtype == narrow for c in ag):
+            findings.append(_finding(
+                t, "VER004",
+                f"no {narrow} all_gather: the packed requantized gather "
+                "stage is missing or upcast",
+                root,
+            ))
+        for c in colls:
+            if c.prim == "psum" and c.dtype == "float32" and len(c.shape) >= 4:
+                findings.append(_finding(
+                    t, "VER004",
+                    f"full-rank f32 histogram psum survives in a {narrow} "
+                    f"program ({c.describe()})",
+                    root,
+                ))
+    return findings
+
+
+def check_no_f64(traced: Sequence[TracedProgram],
+                 root: Optional[str] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for t in traced:
+        if not t.ok:
+            continue
+        bad = sorted(d for d in t.analysis.dtypes
+                     if d in ("float64", "complex128"))
+        if bad:
+            findings.append(_finding(
+                t, "VER005", f"64-bit dtypes in program: {bad}", root,
+            ))
+    return findings
+
+
+def check_donation(traced: Sequence[TracedProgram],
+                   root: Optional[str] = None) -> List[Finding]:
+    """VER006: every donated input aval must be matchable (shape+dtype) by
+    some output aval, else XLA cannot alias it and the donation only
+    poisons the caller's buffer."""
+    import jax
+
+    findings: List[Finding] = []
+    for t in traced:
+        if not t.ok or not t.record.donate_argnums:
+            continue
+        args = t.record.abstract_args
+        out_pool: List[Tuple[tuple, str]] = [
+            (tuple(a.shape), str(a.dtype)) for a in t.closed_jaxpr.out_avals
+        ]
+        for argnum in t.record.donate_argnums:
+            if argnum >= len(args):
+                findings.append(_finding(
+                    t, "VER006",
+                    f"donate_argnums={argnum} out of range for "
+                    f"{len(args)} args",
+                    root,
+                ))
+                continue
+            flat, _ = jax.tree.flatten(args[argnum])
+            for a in flat:
+                sig = (tuple(a.shape), str(a.dtype))
+                if sig in out_pool:
+                    out_pool.remove(sig)  # each output aliases once
+                else:
+                    findings.append(_finding(
+                        t, "VER006",
+                        f"donated arg {argnum} aval "
+                        f"{sig[1]}[{'x'.join(map(str, sig[0]))}] matches no "
+                        f"output buffer: donation is unused",
+                        root,
+                    ))
+    return findings
+
+
+def run_checks(traced: Sequence[TracedProgram],
+               mesh_axes: FrozenSet[str],
+               root: Optional[str] = None) -> List[Finding]:
+    """All passes, deterministic order."""
+    findings: List[Finding] = []
+    findings.extend(check_trace_failures(traced, root))
+    findings.extend(check_schedule_identity(traced, root))
+    findings.extend(check_cond_collectives(traced, root))
+    findings.extend(check_axis_names(traced, mesh_axes, root))
+    findings.extend(check_precision_flow(traced, root))
+    findings.extend(check_no_f64(traced, root))
+    findings.extend(check_donation(traced, root))
+    findings.sort(key=lambda f: (f.program, f.rule, f.message))
+    return findings

@@ -1,0 +1,150 @@
+"""Config-matrix tracer: build every engine variant, register, re-trace.
+
+Runs on the virtual CPU mesh (``JAX_PLATFORMS=cpu`` + 8 host-platform
+devices — the CLI forces this before jax imports). Engines are built over a
+tiny synthetic dataset; round programs are REGISTERED but never compiled or
+executed (``build_programs`` + ``jax.jit``'s laziness), then each registry
+record is re-traced abstractly. The only executed programs are the binning
+sketches that run inside engine construction and the 2-round training that
+mints the booster the serve predictor traces against.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.rxgbverify import walker
+
+#: shared training defaults: small enough to trace fast, deep enough that
+#: every level of the grower (and the quantized allreduce at min_bytes=0)
+#: appears in the jaxpr
+_BASE_PARAMS = {
+    "objective": "binary:logistic",
+    "max_depth": 3,
+    "eval_metric": ["logloss"],
+}
+
+_ROWS = 64
+_FEATURES = 5
+
+
+@dataclasses.dataclass(frozen=True)
+class MatrixEntry:
+    label: str
+    overrides: Dict[str, object]
+    worlds: Tuple[int, ...]
+
+
+#: the full CI matrix: grower x hist_quant(none/int8/int16) x sampling x
+#: world 2/4/8. Cross-world rows (len(worlds) > 1) feed VER001; the
+#: quantized rows feed VER004. hist_quant_min_bytes=0 because the synthetic
+#: histograms are tiny — without it the f32 fallback would hide the wire.
+FULL_MATRIX: Tuple[MatrixEntry, ...] = (
+    MatrixEntry("depthwise-f32", {}, (2, 4, 8)),
+    MatrixEntry(
+        "depthwise-int8",
+        {"hist_quant": "int8", "hist_quant_min_bytes": 0},
+        (2, 4, 8),
+    ),
+    MatrixEntry(
+        "depthwise-int16",
+        {"hist_quant": "int16", "hist_quant_min_bytes": 0},
+        (4, 8),
+    ),
+    MatrixEntry(
+        "lossguide",
+        {"grow_policy": "lossguide", "max_leaves": 8},
+        (2, 4),
+    ),
+    MatrixEntry("dart", {"booster": "dart"}, (4,)),
+    MatrixEntry("subsample", {"subsample": 0.5}, (2, 4)),
+    MatrixEntry(
+        "goss",
+        {"subsample": 0.5, "sampling_method": "gradient_based"},
+        (2, 4),
+    ),
+    MatrixEntry(
+        "goss-int8",
+        {"subsample": 0.5, "sampling_method": "gradient_based",
+         "hist_quant": "int8", "hist_quant_min_bytes": 0},
+        (4,),
+    ),
+)
+
+#: tier-1 test subset: the two keystone rows (plain + quantized) at two
+#: worlds — enough to exercise VER001 grouping and VER004 end to end while
+#: keeping the test under the fast-tier budget
+QUICK_MATRIX: Tuple[MatrixEntry, ...] = (
+    MatrixEntry("depthwise-f32", {}, (2, 4)),
+    MatrixEntry(
+        "depthwise-int8",
+        {"hist_quant": "int8", "hist_quant_min_bytes": 0},
+        (2, 4),
+    ),
+)
+
+_GBLINEAR_WORLDS = (2, 4)
+_SERVE_WORLD = 4
+
+
+def _dataset():
+    import numpy as np
+
+    rng = np.random.RandomState(7)
+    x = rng.rand(_ROWS, _FEATURES).astype(np.float32)
+    y = (rng.rand(_ROWS) > 0.5).astype(np.float32)
+    return [{"data": x, "label": y}]
+
+
+def trace_matrix(
+    quick: bool = False,
+    entries: Optional[Sequence[MatrixEntry]] = None,
+) -> List[walker.TracedProgram]:
+    """Build the matrix's engines under progreg capture and re-trace every
+    registered program. Returns one TracedProgram per registry record."""
+    import jax
+
+    from xgboost_ray_tpu import progreg
+    from xgboost_ray_tpu.engine import TpuEngine
+    from xgboost_ray_tpu.linear import LinearEngine
+    from xgboost_ray_tpu.params import parse_params
+
+    if entries is None:
+        entries = QUICK_MATRIX if quick else FULL_MATRIX
+    shards = _dataset()
+    booster = None
+    if not quick:
+        # mint the serve predictor's booster OUTSIDE capture: its training
+        # engine's programs are not part of the matrix and must not pollute
+        # the registry (this 2-round depth-2 train is the matrix's only
+        # compiled/executed round program)
+        params = parse_params({**_BASE_PARAMS, "max_depth": 2})
+        train_eng = TpuEngine(shards, params, num_actors=_SERVE_WORLD)
+        for i in range(2):
+            train_eng.step(i)
+        booster = train_eng.get_booster()
+    engines = []  # keep alive: records hold the engines' traceable closures
+    with progreg.capture():
+        progreg.clear()
+        for entry in entries:
+            for world in entry.worlds:
+                params = parse_params({**_BASE_PARAMS, **entry.overrides})
+                eng = TpuEngine(shards, params, num_actors=world)
+                eng.build_programs()
+                engines.append(eng)
+        if not quick:
+            for world in _GBLINEAR_WORLDS:
+                params = parse_params(
+                    {**_BASE_PARAMS, "booster": "gblinear"}
+                )
+                lin = LinearEngine(shards, params, num_actors=world)
+                lin.build_programs()
+                engines.append(lin)
+            from xgboost_ray_tpu.serve.predictor import CompiledPredictor
+
+            pred = CompiledPredictor(
+                booster, devices=jax.devices()[:_SERVE_WORLD]
+            )
+            pred.register_programs(kinds=("margin", "leaf", "contribs"))
+            engines.append(pred)
+        traced = [walker.trace_record(r) for r in progreg.records()]
+    return traced

@@ -1,0 +1,229 @@
+"""Jaxpr walker: collective schedules, dtype census, drift fingerprints.
+
+Operates on the ``ClosedJaxpr`` of an abstractly re-traced program (from
+``progreg.ProgramRecord.jaxpr()``), recursing into every sub-jaxpr a
+primitive carries (``pjit``/``scan``/``while``/``cond``/``shard_map``/
+custom-derivative calls) purely by duck typing — anything in an eqn's
+params that walks like a jaxpr (has ``eqns``, possibly behind a ``.jaxpr``
+attribute) is walked. No jax-internal imports, so the walker survives
+module reshuffles across jax versions.
+"""
+
+import dataclasses
+import hashlib
+from typing import Any, FrozenSet, Iterator, List, Tuple
+
+#: primitives that communicate across mesh axes — the ordered sequence of
+#: these IS the program's collective schedule (the thing that must match
+#: across every rank, and across the world sizes elastic can interleave)
+COLLECTIVE_PRIMS = frozenset(
+    {"psum", "pmax", "pmin", "all_gather", "all_to_all", "ppermute",
+     "reduce_scatter", "pbroadcast"}
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Collective:
+    """One collective eqn: primitive, axis names, payload aval, context."""
+
+    prim: str
+    axes: Tuple[str, ...]
+    shape: Tuple[int, ...]
+    dtype: str
+    path: str  # nesting chain, e.g. "/shard_map/scan"
+    in_cond: bool  # under a lax.cond branch (divergence hazard)
+
+    def identity(self) -> tuple:
+        """World-size-invariant identity: a shrink/grow recompile may change
+        shard extents but never the primitive, axes, dtype, or rank."""
+        return (self.prim, self.axes, self.dtype, len(self.shape))
+
+    def describe(self) -> str:
+        dims = "x".join(str(d) for d in self.shape)
+        return f"{self.prim}@{','.join(self.axes)}:{self.dtype}[{dims}]{self.path}"
+
+
+@dataclasses.dataclass
+class ProgramAnalysis:
+    collectives: List[Collective]
+    dtypes: FrozenSet[str]
+
+    def schedule(self) -> Tuple[tuple, ...]:
+        return tuple(c.identity() for c in self.collectives)
+
+
+def _open_jaxpr(obj):
+    """The open ``Jaxpr`` behind ``obj`` (ClosedJaxpr or Jaxpr), else None."""
+    inner = getattr(obj, "jaxpr", obj)
+    return inner if hasattr(inner, "eqns") and hasattr(inner, "invars") else None
+
+
+def _sub_jaxprs(eqn) -> Iterator[tuple]:
+    """Yield ``(open_jaxpr, param_key, index)`` for every sub-jaxpr in the
+    eqn's params, in deterministic (sorted-key, positional) order."""
+    for key in sorted(eqn.params):
+        val = eqn.params[key]
+        items = val if isinstance(val, (tuple, list)) else (val,)
+        for i, item in enumerate(items):
+            sub = _open_jaxpr(item)
+            if sub is not None:
+                yield sub, key, i
+
+
+def _axis_names(eqn) -> Tuple[str, ...]:
+    ax = eqn.params.get("axes", eqn.params.get("axis_name"))
+    if ax is None:
+        return ()
+    if not isinstance(ax, (tuple, list)):
+        ax = (ax,)
+    # positional (int) axes are intra-shard reductions, not mesh axes
+    return tuple(str(a) for a in ax if isinstance(a, str))
+
+
+def _payload_aval(eqn):
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        if aval is not None and hasattr(aval, "shape"):
+            return aval
+    return None
+
+
+def analyze(closed_jaxpr) -> ProgramAnalysis:
+    """Walk the whole (nested) program once; return schedule + dtype census."""
+    collectives: List[Collective] = []
+    dtypes = set()
+
+    def rec(open_j, path: str, in_cond: bool) -> None:
+        for eqn in open_j.eqns:
+            name = eqn.primitive.name
+            for var in list(eqn.invars) + list(eqn.outvars):
+                aval = getattr(var, "aval", None)
+                if aval is not None and hasattr(aval, "dtype"):
+                    dtypes.add(str(aval.dtype))
+            if name in COLLECTIVE_PRIMS:
+                aval = _payload_aval(eqn)
+                collectives.append(Collective(
+                    prim=name,
+                    axes=_axis_names(eqn),
+                    shape=tuple(aval.shape) if aval is not None else (),
+                    dtype=str(aval.dtype) if aval is not None else "?",
+                    path=path,
+                    in_cond=in_cond,
+                ))
+            for sub, key, _i in _sub_jaxprs(eqn):
+                rec(
+                    sub,
+                    f"{path}/{name}",
+                    in_cond or (name == "cond" and key == "branches"),
+                )
+
+    rec(closed_jaxpr.jaxpr, "", False)
+    return ProgramAnalysis(collectives=collectives, dtypes=frozenset(dtypes))
+
+
+# ---------------------------------------------------------------------------
+# Recompile-drift fingerprints
+# ---------------------------------------------------------------------------
+
+def _aval_str(aval) -> str:
+    if aval is None or not hasattr(aval, "shape"):
+        return "?"
+    return f"{aval.dtype}[{'x'.join(str(d) for d in aval.shape)}]"
+
+
+def _canon_param(val) -> str:
+    """Deterministic rendering of a non-jaxpr eqn param. Sets are sorted
+    (their repr order is salted), callables reduced to their name, and long
+    reprs hashed — the fingerprint must be stable across processes."""
+    if isinstance(val, (frozenset, set)):
+        return "{" + ",".join(sorted(repr(v) for v in val)) + "}"
+    if callable(val) and not isinstance(val, type):
+        return f"<fn:{getattr(val, '__name__', type(val).__name__)}>"
+    try:
+        r = repr(val)
+    except Exception:  # pragma: no cover - exotic param types
+        r = f"<{type(val).__name__}>"
+    if len(r) > 256:
+        r = f"sha256:{hashlib.sha256(r.encode()).hexdigest()[:16]}"
+    return r
+
+
+def _canon_lines(open_j, out: List[str], path: str) -> None:
+    out.append(
+        f"{path} in:" + ",".join(_aval_str(getattr(v, "aval", None))
+                                 for v in open_j.invars)
+    )
+    for eqn in open_j.eqns:
+        name = eqn.primitive.name
+        subs = list(_sub_jaxprs(eqn))
+        sub_keys = {key for _s, key, _i in subs}
+        parts = []
+        for key in sorted(eqn.params):
+            if key in sub_keys:
+                n = sum(1 for _s, k, _i in subs if k == key)
+                parts.append(f"{key}=<jaxpr*{n}>")
+            else:
+                parts.append(f"{key}={_canon_param(eqn.params[key])}")
+        ins = ",".join(_aval_str(getattr(v, "aval", None)) for v in eqn.invars)
+        outs = ",".join(_aval_str(getattr(v, "aval", None)) for v in eqn.outvars)
+        out.append(f"{path} {name}[{' '.join(parts)}] ({ins})->({outs})")
+        for i, (sub, key, idx) in enumerate(subs):
+            _canon_lines(sub, out, f"{path}/{name}.{key}.{idx}")
+    out.append(
+        f"{path} out:" + ",".join(_aval_str(getattr(v, "aval", None))
+                                  for v in open_j.outvars)
+    )
+
+
+def fingerprint(closed_jaxpr, donate_argnums: Tuple[int, ...] = ()) -> str:
+    """Stable hash of (jaxpr structure, avals, params, donation): the
+    recompile-drift certificate. A PR that changes a compiled program's
+    shapes, collective count, or donation shows up as a fingerprint diff."""
+    lines: List[str] = []
+    _canon_lines(closed_jaxpr.jaxpr, lines, "")
+    lines.append(f"donate={tuple(donate_argnums)}")
+    return hashlib.sha256("\n".join(lines).encode()).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# Traced program: registry record + its abstract re-trace
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class TracedProgram:
+    """One registry record re-traced abstractly (or the failure to)."""
+
+    record: Any  # progreg.ProgramRecord
+    closed_jaxpr: Any = None
+    analysis: ProgramAnalysis = None
+    fingerprint: str = ""
+    error: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return not self.error
+
+    def key(self) -> str:
+        """Stable artifact key: name + sorted meta coordinates + a short
+        input-signature hash (several records can share a name+meta at
+        different shapes, e.g. the per-chunk predict programs)."""
+        meta = "|".join(f"{k}={v}" for k, v in sorted(self.record.meta.items()))
+        sig = hashlib.sha256(
+            repr(self.record.signature()).encode()
+        ).hexdigest()[:8]
+        parts = [self.record.name, meta, f"in={sig}"]
+        return "|".join(p for p in parts if p)
+
+
+def trace_record(record) -> TracedProgram:
+    """Abstractly re-trace one registry record (no compile, no execution)."""
+    try:
+        closed = record.jaxpr()
+    except Exception as exc:  # trace failure is itself a finding (TRACE)
+        return TracedProgram(record=record, error=f"{type(exc).__name__}: {exc}")
+    return TracedProgram(
+        record=record,
+        closed_jaxpr=closed,
+        analysis=analyze(closed),
+        fingerprint=fingerprint(closed, record.donate_argnums),
+    )

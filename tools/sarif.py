@@ -1,0 +1,89 @@
+"""Minimal SARIF 2.1.0 serialization shared by rxgblint and rxgbverify.
+
+One writer so both static-analysis layers surface as code-review
+annotations with the same shape: a single run, the rule catalog under
+``tool.driver.rules``, and one result per open finding with a physical
+location. Only the subset of SARIF that annotation consumers (GitHub code
+scanning et al.) actually read is emitted; the golden-file test pins it.
+"""
+
+import json
+from typing import Dict, List, Sequence
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def sarif_doc(
+    tool_name: str,
+    rules: Dict[str, str],
+    results: Sequence[Dict[str, object]],
+) -> Dict[str, object]:
+    """Build the SARIF document dict.
+
+    ``results`` entries carry ``rule`` (id), ``message``, ``path`` (repo-
+    relative posix uri), ``line`` (1-based; clamped up from 0), and an
+    optional ``level`` (default "error" — both tools gate CI, so an open
+    finding is never informational).
+    """
+    rule_ids = sorted(rules)
+    index = {rid: i for i, rid in enumerate(rule_ids)}
+    out_results: List[Dict[str, object]] = []
+    for r in results:
+        rid = str(r["rule"])
+        res: Dict[str, object] = {
+            "ruleId": rid,
+            "level": str(r.get("level", "error")),
+            "message": {"text": str(r["message"])},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": str(r["path"])},
+                        "region": {"startLine": max(int(r.get("line", 1)), 1)},
+                    }
+                }
+            ],
+        }
+        if rid in index:
+            res["ruleIndex"] = index[rid]
+        out_results.append(res)
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": tool_name,
+                        # the url setup.py declares for THIS package (the
+                        # reference project's repo would send annotation
+                        # readers to the wrong codebase)
+                        "informationUri": (
+                            "https://github.com/example/xgboost_ray_tpu"
+                        ),
+                        "rules": [
+                            {
+                                "id": rid,
+                                "shortDescription": {"text": rules[rid]},
+                            }
+                            for rid in rule_ids
+                        ],
+                    }
+                },
+                "results": out_results,
+            }
+        ],
+    }
+
+
+def to_sarif_json(
+    tool_name: str,
+    rules: Dict[str, str],
+    results: Sequence[Dict[str, object]],
+) -> str:
+    return json.dumps(
+        sarif_doc(tool_name, rules, results), indent=2, sort_keys=True
+    )

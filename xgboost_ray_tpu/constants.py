@@ -1,0 +1,18 @@
+"""Shared SPMD constants — the single source of truth for mesh axis names.
+
+Every ``Mesh`` constructor, ``PartitionSpec`` and collective call site in the
+package names the actor axis through :data:`AXIS_ACTORS` instead of a string
+literal, so the axis name is declared exactly once. The static-analysis
+layers consume the same declaration: ``tools/rxgblint``'s SPMD002 mesh-axis
+catalog and ``tools/rxgbverify``'s jaxpr schedule checks both resolve
+``AXIS_*`` constants from this module by AST (never importing it), which is
+why the module must stay stdlib-only with plain string assignments at module
+scope — no computed values, no imports that drag in jax.
+"""
+
+#: the 1D data-parallel mesh axis: one slot per logical actor rank (the
+#: TPU-native replacement for the reference's one-OS-process-per-actor
+#: topology; see engine.py module docstring)
+AXIS_ACTORS = "actors"
+
+__all__ = ["AXIS_ACTORS"]

@@ -4,8 +4,9 @@ This is the TPU-native inversion of the reference's architecture (SURVEY §7.1):
 where xgboost_ray runs N OS-process actors each wrapping the xgboost C++ core
 and glues them with a Rabit TCP allreduce (``xgboost_ray/main.py:543-815``,
 ``compat/tracker.py``), here the N "actors" are slots of a
-``jax.sharding.Mesh`` axis and the per-round histogram allreduce is
-``lax.psum(hist, "actors")`` inside a shard_map-ed, jit-compiled round step.
+``jax.sharding.Mesh`` axis (named by ``constants.AXIS_ACTORS``) and the
+per-round histogram allreduce is ``lax.psum(hist, AXIS_ACTORS)`` inside a
+shard_map-ed, jit-compiled round step.
 There is no tracker, no rendezvous protocol, no sockets: XLA compiles the
 collective onto ICI.
 
@@ -23,7 +24,9 @@ The driver retry/checkpoint/elastic loop lives in ``main.py`` — mirroring the
 reference's split between actor hot loop and driver control flow.
 """
 
+import contextlib
 import logging
+import os
 import time
 from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
@@ -34,7 +37,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from xgboost_ray_tpu import obs
+from xgboost_ray_tpu import progreg
 from xgboost_ray_tpu.compat import shard_map_compat
+from xgboost_ray_tpu.constants import AXIS_ACTORS
 from xgboost_ray_tpu.models.booster import RayXGBoostBooster, stack_trees
 from xgboost_ray_tpu.ops import binning
 from xgboost_ray_tpu.ops.histogram import (
@@ -90,6 +95,27 @@ def resolve_hist_precision(precision: str) -> str:
     if precision != "auto":
         return precision
     return "highest" if jax.default_backend() == "cpu" else "fast"
+
+
+@contextlib.contextmanager
+def strict_transfer_guard(active: bool = True):
+    """Runtime counterpart of rxgblint's SYNC001: under ``RXGB_STRICT=1``,
+    steady-state round dispatch runs inside ``jax.transfer_guard("disallow")``
+    so ANY hidden implicit host<->device sync (a stray ``.item()``/
+    ``float()``/``np.asarray`` smuggled into a round closure) raises instead
+    of silently serializing the pipeline.
+
+    The documented host-sync boundaries stay out of scope by construction:
+    the guard wraps ONLY the compiled-program dispatch, not the metric
+    scalar reads / forest flushes that follow it, and callers pass
+    ``active=False`` for a program's first (compiling) dispatch — trace-time
+    closure-constant uploads are a legitimate one-off transfer.
+    """
+    if active and os.environ.get("RXGB_STRICT") == "1":
+        with jax.transfer_guard("disallow"):
+            yield
+    else:
+        yield
 
 
 class _EvalSet:
@@ -164,7 +190,7 @@ class TpuEngine:
                 num_actors,
                 len(devices),
             )
-        self.mesh = Mesh(np.array(devices[: self.n_devices]), ("actors",))
+        self.mesh = Mesh(np.array(devices[: self.n_devices]), (AXIS_ACTORS,))
         self.num_actors = num_actors
 
         self.objective = (
@@ -338,7 +364,7 @@ class TpuEngine:
         self.n_rows, self._local_pad, pad_to = self._global_row_layout(
             self._local_rows
         )
-        self._row_sharding = NamedSharding(self.mesh, P("actors"))
+        self._row_sharding = NamedSharding(self.mesh, P(AXIS_ACTORS))
 
         from xgboost_ray_tpu.distributed import put_rows_global
 
@@ -480,6 +506,9 @@ class TpuEngine:
         self._step_fn_custom = None
         self._scan_fn = None
         self._dart_fn = None
+        # programs that have dispatched at least once: RXGB_STRICT's
+        # transfer guard only arms for warm (non-compiling) dispatches
+        self._warm_programs: set = set()
         # device-resident payload-byte counter of the latest round's tree
         # allreduces (materialized lazily — see hist_allreduce_bytes_per_round)
         self._ar_bytes_dev = None
@@ -556,10 +585,10 @@ class TpuEngine:
 
         def fn(x, v, w):
             mn, mx = binning.feature_min_max(x, v)
-            mn = jax.lax.pmin(mn, "actors")
-            mx = jax.lax.pmax(mx, "actors")
+            mn = jax.lax.pmin(mn, AXIS_ACTORS)
+            mx = jax.lax.pmax(mx, AXIS_ACTORS)
             hist = binning.sketch_histogram(x, v, mn, mx, weight=w)
-            hist = jax.lax.psum(hist, "actors")
+            hist = jax.lax.psum(hist, AXIS_ACTORS)
             cuts = binning.cuts_from_sketch(mn, mx, hist, max_bin)
             if cat_features:
                 # categorical columns: cut k sits at k + 0.5, so the bin index
@@ -577,21 +606,33 @@ class TpuEngine:
             miss_cnt = jnp.sum(
                 ((bins == max_bin) & v[:, None]).astype(jnp.float32), axis=0
             )
-            has_missing = jax.lax.psum(miss_cnt, "actors") > 0
+            has_missing = jax.lax.psum(miss_cnt, AXIS_ACTORS) > 0
             return bins, cuts, has_missing
 
         mapped = shard_map(
             fn,
             mesh=self.mesh,
-            in_specs=(P("actors"), P("actors"), P("actors")),
-            out_specs=(P("actors"), P(), P()),
+            in_specs=(P(AXIS_ACTORS), P(AXIS_ACTORS), P(AXIS_ACTORS)),
+            out_specs=(P(AXIS_ACTORS), P(), P()),
         )
-        bins, cuts, has_missing = jax.jit(mapped)(x_dev, valid, weight_dev)
+        jit_fn = progreg.register_jit(
+            "engine.sketch_cuts",
+            mapped,
+            example_args=(x_dev, valid, weight_dev),
+            meta=self._program_meta(),
+        )
+        bins, cuts, has_missing = jit_fn(x_dev, valid, weight_dev)
         return bins, cuts, has_missing
 
     def _bin_with_cuts(self, x_dev):
         max_bin = self.params.max_bin
-        return jax.jit(lambda x, c: binning.bin_matrix(x, c, max_bin))(x_dev, self.cuts)
+        jit_fn = progreg.register_jit(
+            "engine.bin_matrix",
+            lambda x, c: binning.bin_matrix(x, c, max_bin),
+            example_args=(x_dev, self.cuts),
+            meta=self._program_meta(),
+        )
+        return jit_fn(x_dev, self.cuts)
 
     def _build_sharded_groups(self, qid, n_rows=None, pad_to=None):
         """Per-device-block padded group gather maps, stacked + sharded.
@@ -731,7 +772,7 @@ class TpuEngine:
         n_evals_dev = (
             sum(1 for e in self.evals if not e.is_train) if update_evals else 0
         )
-        psum = lambda x: jax.lax.psum(x, "actors")
+        psum = lambda x: jax.lax.psum(x, AXIS_ACTORS)
         n_actors = self.n_devices
 
         is_survival = self.is_survival
@@ -749,11 +790,11 @@ class TpuEngine:
             # fresh per trace: counts the ring-model wire bytes of every
             # tree-path allreduce (histograms + small exact reductions)
             counter = AllreduceBytes(n_actors)
-            tree_psum = counting_psum("actors", counter)
+            tree_psum = counting_psum(AXIS_ACTORS, counter)
 
             def hist_ar(h):
                 return quantized_hist_allreduce(
-                    h, "actors", cfg.hist_quant, n_actors, counter,
+                    h, AXIS_ACTORS, cfg.hist_quant, n_actors, counter,
                     min_bytes=cfg.hist_quant_min_bytes,
                 )
 
@@ -790,7 +831,7 @@ class TpuEngine:
                         )
                         skey = jax.random.fold_in(
                             jax.random.fold_in(key, salt),
-                            jax.lax.axis_index("actors"),
+                            jax.lax.axis_index(AXIS_ACTORS),
                         )
                         rows_sel, ghk = sampling.sample_rows(
                             ghk, valid, skey, samp_spec
@@ -912,12 +953,106 @@ class TpuEngine:
             if es.is_train:
                 continue
             specs.append(_EvalArrs(
-                P("actors"), P("actors"), P("actors"), P("actors"), P("actors"),
-                P("actors") if es.group_rows_dev is not None else P(),
-                P("actors") if es.margins_static is not None else P(),
-                (P("actors"), P("actors")) if es.bounds_dev is not None else P(),
+                P(AXIS_ACTORS), P(AXIS_ACTORS), P(AXIS_ACTORS), P(AXIS_ACTORS), P(AXIS_ACTORS),
+                P(AXIS_ACTORS) if es.group_rows_dev is not None else P(),
+                P(AXIS_ACTORS) if es.margins_static is not None else P(),
+                (P(AXIS_ACTORS), P(AXIS_ACTORS)) if es.bounds_dev is not None else P(),
             ))
         return tuple(specs)
+
+    # ------------------------------------------------------------------
+    # Program registry (tools/rxgbverify): abstract signatures of every
+    # compiled program, so the verifier can re-trace them without running.
+    # ------------------------------------------------------------------
+    def _program_meta(self) -> Dict[str, Any]:
+        """Config coordinates the jaxpr verifier groups programs by. The
+        cross-world schedule-identity check compares records that agree on
+        everything here except ``world``."""
+        samp = sampling.spec_from_params(self.params)
+        # derived from params, not self.dart: the sketch program registers
+        # during __init__ before the dart attribute exists
+        is_dart = self.params.booster == "dart"
+        return {
+            "world": int(self.n_devices),
+            "grower": "dart" if is_dart else self.params.grow_policy,
+            "hist_quant": self.cfg.hist_quant,
+            "sampling": samp.policy if samp is not None else "none",
+            "n_outputs": int(self.n_outputs),
+            # program-shape coordinates: two engines differing here trace
+            # legitimately different programs and must not share a
+            # cross-world identity group
+            "max_depth": int(self.cfg.max_depth),
+            "max_leaves": int(self.cfg.max_leaves),
+        }
+
+    def _default_group_rows(self):
+        """The ``group_rows`` dispatch argument (scalar sentinel when the
+        data is ungrouped) — shared by the real dispatch sites and the
+        ``*_example_args`` signature capture, so the registered abstract
+        program cannot drift from the dispatched one."""
+        if self.group_rows is not None:
+            return self.group_rows
+        return jnp.zeros((), jnp.int32)
+
+    def _default_bounds(self):
+        """The label-bounds dispatch argument (scalar sentinel when not
+        survival training) — shared like :meth:`_default_group_rows`."""
+        if self.bounds_dev is not None:
+            return self.bounds_dev
+        return jnp.zeros((), jnp.float32)
+
+    def _step_example_args(self, custom: bool) -> tuple:
+        """The ``step()`` call site's argument tuple, for signature capture.
+        Must mirror :meth:`step` exactly — the registered abstract trace IS
+        the program the verifier certifies."""
+        group_rows = self._default_group_rows()
+        gh_in = (
+            (self.margins, self.margins) if custom
+            else jnp.zeros((), jnp.float32)
+        )
+        bounds = self._default_bounds()
+        rng = jax.random.PRNGKey(self.params.seed)
+        return (self.bins, self.valid, self.label_dev, self.weight_dev,
+                self.margins, group_rows, gh_in, rng, bounds,
+                self._eval_arrs())
+
+    def _scan_example_args(self) -> tuple:
+        """``step_many``'s signature at a representative 2-round chunk (the
+        collective schedule inside the scan body is chunk-length blind)."""
+        group_rows = self._default_group_rows()
+        bounds = self._default_bounds()
+        return (self.bins, self.valid, self.label_dev, self.weight_dev,
+                self.margins, group_rows, jnp.arange(2), bounds,
+                self._eval_arrs())
+
+    def _dart_example_args(self) -> tuple:
+        group_rows = self._default_group_rows()
+        bounds = self._default_bounds()
+        return (self.bins, self.valid, self.label_dev, self.weight_dev,
+                self._margins_static_dev, group_rows, bounds,
+                self.dart_forest_dev, jnp.asarray(self.dart_weights),
+                jnp.asarray(self.dart_weights), jnp.float32(1.0),
+                jnp.int32(0), jax.random.PRNGKey(self.params.seed),
+                self._eval_arrs())
+
+    def build_programs(self) -> None:
+        """Force-build every round program this engine configuration can
+        dispatch (without compiling or executing any of them — ``jax.jit``
+        is lazy). Under :func:`progreg.capture` this is how the verifier
+        populates the registry for a config without running a round."""
+        if self.dart:
+            if self._dart_fn is None:
+                self._dart_fn = self._make_dart_step()
+            return
+        if self._step_fn is None:
+            self._step_fn = self._make_step(custom=False)
+        if self._step_fn_custom is None:
+            # the custom-objective variant dispatches the same collectives
+            # from externally-supplied g/h; it must be certified too (a
+            # user's obj callback can reach every grower/hist_quant config)
+            self._step_fn_custom = self._make_step(custom=True)
+        if self.can_batch_rounds() and self._scan_fn is None:
+            self._scan_fn = self._make_scan_step()
 
     def _make_step(self, custom: bool):
         tree_round, metric_contribs = self._round_closures()
@@ -942,20 +1077,20 @@ class TpuEngine:
             step,
             mesh=self.mesh,
             in_specs=(
-                P("actors"),  # bins
-                P("actors"),  # valid
-                P("actors"),  # label
-                P("actors"),  # weight
-                P("actors"),  # margins
-                P("actors") if self.group_rows is not None else P(),
-                (P("actors"), P("actors")) if custom else P(),
+                P(AXIS_ACTORS),  # bins
+                P(AXIS_ACTORS),  # valid
+                P(AXIS_ACTORS),  # label
+                P(AXIS_ACTORS),  # weight
+                P(AXIS_ACTORS),  # margins
+                P(AXIS_ACTORS) if self.group_rows is not None else P(),
+                (P(AXIS_ACTORS), P(AXIS_ACTORS)) if custom else P(),
                 P(),  # rng
-                (P("actors"), P("actors")) if self.bounds_dev is not None else P(),
+                (P(AXIS_ACTORS), P(AXIS_ACTORS)) if self.bounds_dev is not None else P(),
                 eval_specs,
             ),
             out_specs=(
-                P("actors"),
-                tuple(P("actors") for _ in eval_specs),
+                P(AXIS_ACTORS),
+                tuple(P(AXIS_ACTORS) for _ in eval_specs),
                 P(),
                 tuple(
                     tuple((P(), P()) for _ in self._device_metrics)
@@ -964,7 +1099,13 @@ class TpuEngine:
                 P(),  # allreduce payload bytes (identical on every shard)
             ),
         )
-        return jax.jit(mapped, donate_argnums=(4,))
+        return progreg.register_jit(
+            "engine.step_custom" if custom else "engine.step",
+            mapped,
+            donate_argnums=(4,),
+            example_args=lambda: self._step_example_args(custom),
+            meta=self._program_meta(),
+        )
 
     # ------------------------------------------------------------------
     def _make_scan_step(self):
@@ -1006,25 +1147,31 @@ class TpuEngine:
             run,
             mesh=self.mesh,
             in_specs=(
-                P("actors"),
-                P("actors"),
-                P("actors"),
-                P("actors"),
-                P("actors"),
-                P("actors") if self.group_rows is not None else P(),
+                P(AXIS_ACTORS),
+                P(AXIS_ACTORS),
+                P(AXIS_ACTORS),
+                P(AXIS_ACTORS),
+                P(AXIS_ACTORS),
+                P(AXIS_ACTORS) if self.group_rows is not None else P(),
                 P(),  # iterations
-                (P("actors"), P("actors")) if self.bounds_dev is not None else P(),
+                (P(AXIS_ACTORS), P(AXIS_ACTORS)) if self.bounds_dev is not None else P(),
                 eval_specs,
             ),
             out_specs=(
-                P("actors"),
-                tuple(P("actors") for _ in eval_specs),
+                P(AXIS_ACTORS),
+                tuple(P(AXIS_ACTORS) for _ in eval_specs),
                 P(),
                 tuple(tuple((P(), P()) for _ in self._device_metrics) for _ in self.evals),
                 P(),  # per-round allreduce payload bytes [n_rounds]
             ),
         )
-        return jax.jit(mapped, donate_argnums=(4,))
+        return progreg.register_jit(
+            "engine.step_many",
+            mapped,
+            donate_argnums=(4,),
+            example_args=self._scan_example_args,
+            meta=self._program_meta(),
+        )
 
     def can_batch_rounds(self) -> bool:
         return not self._host_metrics and not self.dart
@@ -1064,21 +1211,24 @@ class TpuEngine:
             self.iteration_offset + iteration0 + n_rounds,
         )
         eval_data = self._eval_arrs()
-        group_rows = (
-            self.group_rows if self.group_rows is not None else jnp.zeros((), jnp.int32)
-        )
-        bounds = self.bounds_dev if self.bounds_dev is not None else jnp.zeros((), jnp.float32)
-        new_margins, new_eval_margins, forests, contribs, ar_bytes = self._scan_fn(
-            self.bins,
-            self.valid,
-            self.label_dev,
-            self.weight_dev,
-            self.margins,
-            group_rows,
-            iterations,
-            bounds,
-            eval_data,
-        )
+        group_rows = self._default_group_rows()
+        bounds = self._default_bounds()
+        # the scan program compiles once per distinct chunk length; the
+        # strict guard arms only for chunk lengths already dispatched
+        prog = ("scan", n_rounds)
+        with strict_transfer_guard(active=prog in self._warm_programs):
+            new_margins, new_eval_margins, forests, contribs, ar_bytes = self._scan_fn(
+                self.bins,
+                self.valid,
+                self.label_dev,
+                self.weight_dev,
+                self.margins,
+                group_rows,
+                iterations,
+                bounds,
+                eval_data,
+            )
+        self._warm_programs.add(prog)
         # keep the device scalar; materialized lazily by the accessor so the
         # steady-state step path adds NO host reads (transfer-count contract)
         self._ar_bytes_dev = ar_bytes[0]
@@ -1154,7 +1304,7 @@ class TpuEngine:
             jax.random.PRNGKey(self.params.seed), self.iteration_offset + iteration
         )
         eval_data = self._eval_arrs()
-        group_rows = self.group_rows if self.group_rows is not None else jnp.zeros((), jnp.int32)
+        group_rows = self._default_group_rows()
         if custom:
             # g/h hold THIS process's rows (the driver computes the custom
             # objective from get_margins_local + process-local labels — the
@@ -1173,19 +1323,22 @@ class TpuEngine:
             )
         else:
             gh_in = jnp.zeros((), jnp.float32)
-        bounds = self.bounds_dev if self.bounds_dev is not None else jnp.zeros((), jnp.float32)
-        new_margins, new_eval_margins, forest, contribs, ar_bytes = fn(
-            self.bins,
-            self.valid,
-            self.label_dev,
-            self.weight_dev,
-            self.margins,
-            group_rows,
-            gh_in,
-            rng,
-            bounds,
-            eval_data,
-        )
+        bounds = self._default_bounds()
+        prog = "step_custom" if custom else "step"
+        with strict_transfer_guard(active=prog in self._warm_programs):
+            new_margins, new_eval_margins, forest, contribs, ar_bytes = fn(
+                self.bins,
+                self.valid,
+                self.label_dev,
+                self.weight_dev,
+                self.margins,
+                group_rows,
+                gh_in,
+                rng,
+                bounds,
+                eval_data,
+            )
+        self._warm_programs.add(prog)
         self._ar_bytes_dev = ar_bytes
         self.margins = new_margins
         ei = 0
@@ -1608,13 +1761,13 @@ class TpuEngine:
             dart_step,
             mesh=self.mesh,
             in_specs=(
-                P("actors"),  # bins
-                P("actors"),  # valid
-                P("actors"),  # label
-                P("actors"),  # weight
-                P("actors"),  # static margins
-                P("actors") if self.group_rows is not None else P(),
-                (P("actors"), P("actors")) if self.bounds_dev is not None else P(),
+                P(AXIS_ACTORS),  # bins
+                P(AXIS_ACTORS),  # valid
+                P(AXIS_ACTORS),  # label
+                P(AXIS_ACTORS),  # weight
+                P(AXIS_ACTORS),  # static margins
+                P(AXIS_ACTORS) if self.group_rows is not None else P(),
+                (P(AXIS_ACTORS), P(AXIS_ACTORS)) if self.bounds_dev is not None else P(),
                 P(),  # forest (replicated)
                 P(),  # w_eff
                 P(),  # w_post
@@ -1624,8 +1777,8 @@ class TpuEngine:
                 eval_specs,
             ),
             out_specs=(
-                P("actors"),
-                tuple(P("actors") for _ in eval_specs),
+                P(AXIS_ACTORS),
+                tuple(P(AXIS_ACTORS) for _ in eval_specs),
                 P(),
                 P(),
                 tuple(
@@ -1635,7 +1788,13 @@ class TpuEngine:
                 P(),  # allreduce payload bytes
             ),
         )
-        return jax.jit(mapped, donate_argnums=(7,))
+        return progreg.register_jit(
+            "engine.step_dart",
+            mapped,
+            donate_argnums=(7,),
+            example_args=self._dart_example_args,
+            meta=self._program_meta(),
+        )
 
     def _dart_sample_drops(self, iteration: int):
         """Host-side dropout sampling; deterministic in (seed, iteration)."""
@@ -1686,28 +1845,35 @@ class TpuEngine:
             jax.random.PRNGKey(params.seed), self.iteration_offset + iteration
         )
         eval_data = self._eval_arrs()
-        group_rows = (
-            self.group_rows if self.group_rows is not None else jnp.zeros((), jnp.int32)
-        )
-        bounds = (
-            self.bounds_dev if self.bounds_dev is not None else jnp.zeros((), jnp.float32)
-        )
-        m_full, new_eval_margins, forest, round_forest, contribs, ar_bytes = self._dart_fn(
-            self.bins,
-            self.valid,
-            self.label_dev,
-            self.weight_dev,
-            self._margins_static_dev,
-            group_rows,
-            bounds,
-            self.dart_forest_dev,
-            jnp.asarray(w_eff),
-            jnp.asarray(w_post),
-            jnp.float32(new_w),
-            jnp.int32(self.dart_t),
-            rng,
-            eval_data,
-        )
+        group_rows = self._default_group_rows()
+        bounds = self._default_bounds()
+        # the per-round drop weights / tree index are legitimate host
+        # inputs of the dart program: place them explicitly (replicated)
+        # BEFORE entering the strict guard, which rejects the implicit
+        # upload-and-reshard the bare jnp conversions would trigger
+        repl = NamedSharding(self.mesh, P())
+        w_eff_dev = jax.device_put(np.asarray(w_eff), repl)
+        w_post_dev = jax.device_put(np.asarray(w_post), repl)
+        new_w_dev = jax.device_put(np.float32(new_w), repl)
+        dart_t_dev = jax.device_put(np.int32(self.dart_t), repl)
+        with strict_transfer_guard(active="dart" in self._warm_programs):
+            m_full, new_eval_margins, forest, round_forest, contribs, ar_bytes = self._dart_fn(
+                self.bins,
+                self.valid,
+                self.label_dev,
+                self.weight_dev,
+                self._margins_static_dev,
+                group_rows,
+                bounds,
+                self.dart_forest_dev,
+                w_eff_dev,
+                w_post_dev,
+                new_w_dev,
+                dart_t_dev,
+                rng,
+                eval_data,
+            )
+        self._warm_programs.add("dart")
         self.margins = m_full
         self._ar_bytes_dev = ar_bytes
         self.dart_forest_dev = forest
@@ -1920,7 +2086,7 @@ class TpuEngine:
         arr = jnp.zeros((last_nodes, n_feat, nbt, 2), jnp.float32)
         ar_fn = jax.jit(
             shard_map(
-                lambda a: jax.lax.psum(a, "actors"),
+                lambda a: jax.lax.psum(a, AXIS_ACTORS),
                 mesh=self.mesh,
                 in_specs=(P(),),
                 out_specs=P(),

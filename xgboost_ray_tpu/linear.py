@@ -31,6 +31,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from xgboost_ray_tpu import progreg
+from xgboost_ray_tpu.constants import AXIS_ACTORS
+from xgboost_ray_tpu.engine import strict_transfer_guard
 from xgboost_ray_tpu.ops.metrics import compute_metric, parse_metric_name
 from xgboost_ray_tpu.ops.objectives import get_objective
 from xgboost_ray_tpu.params import TrainParams
@@ -318,8 +321,8 @@ class LinearEngine:
 
         devices = list(devices if devices is not None else jax.devices())
         self.n_devices = max(1, min(num_actors, len(devices)))
-        self.mesh = Mesh(np.array(devices[: self.n_devices]), ("actors",))
-        self._rows_sharding = NamedSharding(self.mesh, P("actors"))
+        self.mesh = Mesh(np.array(devices[: self.n_devices]), (AXIS_ACTORS,))
+        self._rows_sharding = NamedSharding(self.mesh, P(AXIS_ACTORS))
         self._repl = NamedSharding(self.mesh, P())
 
         if jax.process_count() > 1:
@@ -383,6 +386,7 @@ class LinearEngine:
             ))
 
         self._round_fn = None
+        self._warm = False  # armed after the first (compiling) dispatch
 
     @property
     def num_round_trees(self) -> int:
@@ -404,7 +408,7 @@ class LinearEngine:
         # LinearTrainParam::DenormalizePenalties)
         lam = self.params.reg_lambda * sum_w
         alp = self.params.reg_alpha * sum_w
-        psum = lambda v: jax.lax.psum(v, "actors")
+        psum = lambda v: jax.lax.psum(v, AXIS_ACTORS)
 
         def coordinate_delta(sg, sh, w):
             # xgboost coordinate_common.h CoordinateDelta (elastic net)
@@ -443,11 +447,31 @@ class LinearEngine:
 
         mapped = shard_map(
             fn, mesh=self.mesh,
-            in_specs=(P("actors"), P("actors"), P("actors"), P("actors"),
-                      P("actors"), P(), P()),
+            in_specs=(P(AXIS_ACTORS), P(AXIS_ACTORS), P(AXIS_ACTORS), P(AXIS_ACTORS),
+                      P(AXIS_ACTORS), P(), P()),
             out_specs=(P(), P()),
         )
-        return jax.jit(mapped)
+        return progreg.register_jit(
+            "linear.update",
+            mapped,
+            example_args=lambda: (self._x, self._label, self._valid,
+                                  self._weight, self._user_margin, self._w,
+                                  self._b),
+            meta={
+                "world": int(self.n_devices),
+                "grower": "gblinear",
+                "hist_quant": "none",
+                "sampling": "none",
+                "n_outputs": int(self.n_outputs),
+            },
+        )
+
+    def build_programs(self) -> None:
+        """Force-build the coordinate-update program (jit is lazy — nothing
+        compiles); under ``progreg.capture`` this registers it for the jaxpr
+        verifier."""
+        if self._round_fn is None:
+            self._round_fn = self._build_round_fn()
 
     def step(self, i: int, gh_custom=None) -> Dict[str, Dict[str, float]]:
         if gh_custom is not None:
@@ -456,10 +480,14 @@ class LinearEngine:
             )
         if self._round_fn is None:
             self._round_fn = self._build_round_fn()
-        self._w, self._b = self._round_fn(
-            self._x, self._label, self._valid, self._weight,
-            self._user_margin, self._w, self._b,
-        )
+        # RXGB_STRICT arms only after the first (compiling) dispatch, same
+        # warm-path contract as TpuEngine's round steps
+        with strict_transfer_guard(active=self._warm):
+            self._w, self._b = self._round_fn(
+                self._x, self._label, self._valid, self._weight,
+                self._user_margin, self._w, self._b,
+            )
+        self._warm = True
         self._rounds_done += 1
         return self._eval_metrics()
 

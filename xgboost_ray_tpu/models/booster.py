@@ -18,6 +18,8 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from xgboost_ray_tpu import progreg
+from xgboost_ray_tpu.constants import AXIS_ACTORS
 from xgboost_ray_tpu.ops.grow import Tree
 from xgboost_ray_tpu.ops.objectives import get_objective
 from xgboost_ray_tpu.ops import predict as predict_ops
@@ -48,7 +50,7 @@ def _spmd_margin_fn(devices, k, max_depth, npt, ntree_limit, has_tw,
     mapped = _SPMD_MARGIN_FNS.get(key)
     if mapped is not None:
         return mapped
-    mesh = Mesh(np.asarray(devices), ("actors",))
+    mesh = Mesh(np.asarray(devices), (AXIS_ACTORS,))
 
     def fn(forest, tw, xb, bb):
         return predict_ops.predict_margin(
@@ -62,8 +64,8 @@ def _spmd_margin_fn(devices, k, max_depth, npt, ntree_limit, has_tw,
     mapped = jax.jit(
         shard_map(
             fn, mesh=mesh,
-            in_specs=(P(), P(), P("actors"), P("actors")),
-            out_specs=P("actors"),
+            in_specs=(P(), P(), P(AXIS_ACTORS), P(AXIS_ACTORS)),
+            out_specs=P(AXIS_ACTORS),
         )
     )
     if len(_SPMD_MARGIN_FNS) > 16:  # bound retained programs; evict oldest
@@ -349,9 +351,9 @@ class RayXGBoostBooster:
             quantile_alpha=self.params.quantile_alpha,
         )
         m0 = obj.base_score_to_margin(self.base_score)
-        mesh = Mesh(np.asarray(devices), ("actors",))
+        mesh = Mesh(np.asarray(devices), (AXIS_ACTORS,))
         repl = NamedSharding(mesh, P())
-        rows = NamedSharding(mesh, P("actors"))
+        rows = NamedSharding(mesh, P(AXIS_ACTORS))
         forest_dev = Tree(*[jax.device_put(np.asarray(f), repl) for f in self.forest])
         has_tw = self.tree_weights is not None
         tw_dev = jax.device_put(
@@ -377,6 +379,11 @@ class RayXGBoostBooster:
                 base[:rows_n] += np.asarray(
                     base_margin[lo:hi], np.float32
                 ).reshape(rows_n, -1)
+            progreg.note_jit_call(
+                "booster.margin_spmd", mapped, (forest_dev, tw_dev, xb, base),
+                meta={"world": n_dev, "grower": "predict",
+                      "hist_quant": "none", "sampling": "none"},
+            )
             margin = mapped(
                 forest_dev, tw_dev,
                 jax.device_put(xb, rows), jax.device_put(base, rows),
@@ -425,9 +432,9 @@ class RayXGBoostBooster:
         ).ravel()
         block = max(1, int(-(-int(counts.max()) // per_proc)))
 
-        mesh = Mesh(np.asarray(devices), ("actors",))
+        mesh = Mesh(np.asarray(devices), (AXIS_ACTORS,))
         repl = NamedSharding(mesh, P())
-        rows_sh = NamedSharding(mesh, P("actors"))
+        rows_sh = NamedSharding(mesh, P(AXIS_ACTORS))
 
         def put_repl(arr):
             # replicated multi-host placement: every process holds the same
@@ -499,7 +506,7 @@ class RayXGBoostBooster:
         methods (VERDICT r4 weak #3: the SPMD fast path used to exclude
         exactly these outputs). Unlike the margin walk (hand shard_map'd),
         these kernels carry internal scans, so the row parallelism is
-        expressed the GSPMD way: rows placed with a P("actors") sharding
+        expressed the GSPMD way: rows placed with a P(AXIS_ACTORS) sharding
         into the ALREADY-jitted kernels and XLA's sharding propagation
         partitions the row-parallel walk — no manual axes to fight.
         Single-process meshes only; the driver falls back to the host loop
@@ -514,9 +521,9 @@ class RayXGBoostBooster:
         k = self.num_outputs
         f1 = self.num_features + 1
         t = int(np.asarray(self.forest.feature).shape[0])
-        mesh = Mesh(np.asarray(devices), ("actors",))
+        mesh = Mesh(np.asarray(devices), (AXIS_ACTORS,))
         repl = NamedSharding(mesh, P())
-        rows = NamedSharding(mesh, P("actors"))
+        rows = NamedSharding(mesh, P(AXIS_ACTORS))
         forest_dev = Tree(*[jax.device_put(np.asarray(f), repl)
                             for f in self.forest])
         tw_dev = (

@@ -18,6 +18,8 @@ from typing import Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from xgboost_ray_tpu.constants import AXIS_ACTORS
+
 
 @dataclasses.dataclass(frozen=True)
 class Objective:
@@ -277,9 +279,9 @@ def gather_global_rows(*arrays):
     try/except idiom the cross-shard objectives/metrics (cox) share."""
     try:
         out = tuple(
-            jax.lax.all_gather(a, "actors").reshape(-1) for a in arrays
+            jax.lax.all_gather(a, AXIS_ACTORS).reshape(-1) for a in arrays
         )
-        offset = jax.lax.axis_index("actors") * arrays[0].shape[0]
+        offset = jax.lax.axis_index(AXIS_ACTORS) * arrays[0].shape[0]
         return out, offset
     except NameError:  # not under shard_map
         return arrays, 0

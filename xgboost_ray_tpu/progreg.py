@@ -1,0 +1,175 @@
+"""Compiled-program registry: the abstract signatures of every SPMD program.
+
+Every ``jax.jit``/shard_map program the package can produce (engine round
+steps, the fused scan, dart, binning/sketch, the gblinear coordinate update,
+serve predictor buckets, the booster's SPMD margin walk) registers
+``(name, traceable fn, abstract arg signature, donate_argnums, meta)`` here,
+so ``tools/rxgbverify`` can enumerate them and re-trace each one abstractly
+(``jax.make_jaxpr`` — tracing only, no XLA compile, no execution) to check
+collective schedules, precision flow, and recompile-drift fingerprints.
+
+Capture is OFF by default and costs one early-returning branch per
+registration site: production training/serving never records anything and
+never retains program references (a record keeps the engine closure — and
+with it device data — alive, which a long-running server must not do).
+The verifier, the fingerprinting bench section, and the tests opt in via
+:func:`capture`; registrations only happen while capture is enabled, so
+callers must enable it BEFORE building engines/predictors.
+
+Records are keyed by ``(name, meta, input signature)`` — re-building the
+same program over the same shapes (the elastic engine-cache's grow-back
+path) bumps ``registrations`` on the existing record instead of adding a
+new one, which is what the no-silent-recompile test pins.
+"""
+
+import contextlib
+import dataclasses
+import sys
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+
+__all__ = [
+    "ProgramRecord",
+    "capture",
+    "clear",
+    "enabled",
+    "note_jit_call",
+    "records",
+    "register_jit",
+]
+
+_lock = threading.Lock()
+_capture = False
+_records: "Dict[tuple, ProgramRecord]" = {}
+
+
+def _aval(x) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+
+
+@dataclasses.dataclass
+class ProgramRecord:
+    """One compiled program's abstract identity.
+
+    ``fn`` is the UN-jitted traceable callable (``jax.jit(fn).__wrapped__``),
+    ``abstract_args`` the pytree of ``ShapeDtypeStruct`` mirroring the real
+    call site's arguments, ``meta`` the config coordinates the cross-world
+    checks group by (``world`` plus grower/hist_quant/sampling), and
+    ``source`` the ``(file, line)`` of the registration site — what SARIF
+    annotations point at.
+    """
+
+    name: str
+    fn: Callable
+    abstract_args: Any
+    donate_argnums: Tuple[int, ...]
+    meta: Dict[str, Any]
+    source: Tuple[str, int]
+    registrations: int = 1
+
+    def signature(self) -> Tuple[Tuple[Tuple[int, ...], str], ...]:
+        flat, _ = jax.tree.flatten(self.abstract_args)
+        return tuple((tuple(a.shape), str(a.dtype)) for a in flat)
+
+    def meta_key(self) -> Tuple[Tuple[str, Any], ...]:
+        return tuple(sorted(self.meta.items()))
+
+    def key(self) -> tuple:
+        return (self.name, self.meta_key(), self.signature())
+
+    def jaxpr(self):
+        """Abstract re-trace: the program's ClosedJaxpr (no execution)."""
+        return jax.make_jaxpr(self.fn)(*self.abstract_args)
+
+
+def enabled() -> bool:
+    return _capture
+
+
+@contextlib.contextmanager
+def capture():
+    """Enable registration for the scope (nesting-safe)."""
+    global _capture
+    with _lock:
+        prev, _capture = _capture, True
+    try:
+        yield
+    finally:
+        with _lock:
+            _capture = prev
+
+
+def clear() -> None:
+    with _lock:
+        _records.clear()
+
+
+def records() -> List[ProgramRecord]:
+    with _lock:
+        return list(_records.values())
+
+
+def _record(
+    name: str,
+    fn: Callable,
+    example_args: Any,
+    donate_argnums: Tuple[int, ...],
+    meta: Optional[Dict[str, Any]],
+    depth: int,
+) -> None:
+    if callable(example_args) and not isinstance(example_args, tuple):
+        example_args = example_args()
+    frame = sys._getframe(depth)
+    rec = ProgramRecord(
+        name=name,
+        fn=fn,
+        abstract_args=jax.tree.map(_aval, tuple(example_args)),
+        donate_argnums=tuple(donate_argnums),
+        meta=dict(meta or {}),
+        source=(frame.f_code.co_filename, frame.f_lineno),
+    )
+    key = rec.key()
+    with _lock:
+        existing = _records.get(key)
+        if existing is not None:
+            existing.registrations += 1
+        else:
+            _records[key] = rec
+
+
+def register_jit(
+    name: str,
+    fn: Callable,
+    *,
+    example_args: Any = None,
+    donate_argnums: Tuple[int, ...] = (),
+    meta: Optional[Dict[str, Any]] = None,
+):
+    """``jax.jit(fn, donate_argnums=...)`` plus (capture-gated) registration.
+
+    ``example_args`` is the real call site's argument tuple — or a thunk
+    returning it, so building it (e.g. ``_eval_arrs()``) costs nothing when
+    capture is off. Only shapes/dtypes are kept.
+    """
+    jitted = jax.jit(fn, donate_argnums=donate_argnums)
+    if _capture and example_args is not None:
+        _record(name, fn, example_args, donate_argnums, meta, depth=2)
+    return jitted
+
+
+def note_jit_call(
+    name: str,
+    jit_fn: Callable,
+    args: Tuple[Any, ...],
+    *,
+    meta: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Record an already-jitted program at its call site (for programs whose
+    input shapes are only known per call, e.g. serve's padded buckets).
+    No-op unless capture is enabled."""
+    if not _capture:
+        return
+    fn = getattr(jit_fn, "__wrapped__", jit_fn)
+    _record(name, fn, tuple(args), (), meta, depth=2)

@@ -30,6 +30,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from xgboost_ray_tpu import progreg
+from xgboost_ray_tpu.constants import AXIS_ACTORS
 from xgboost_ray_tpu.ops import predict as predict_ops
 from xgboost_ray_tpu.ops.grow import Tree
 
@@ -120,9 +122,9 @@ class CompiledPredictor:
         if n_dev > 1:
             from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-            self._mesh = Mesh(np.asarray(self.devices), ("actors",))
+            self._mesh = Mesh(np.asarray(self.devices), (AXIS_ACTORS,))
             self._repl = NamedSharding(self._mesh, P())
-            self._rows = NamedSharding(self._mesh, P("actors"))
+            self._rows = NamedSharding(self._mesh, P(AXIS_ACTORS))
             put = lambda a: jax.device_put(a, self._repl)  # noqa: E731
         else:
             dev = self.devices[0]
@@ -174,8 +176,8 @@ class CompiledPredictor:
                 return jax.jit(
                     shard_map(
                         body, mesh=self._mesh,
-                        in_specs=(P(), P(), P("actors"), P("actors")),
-                        out_specs=P("actors"),
+                        in_specs=(P(), P(), P(AXIS_ACTORS), P(AXIS_ACTORS)),
+                        out_specs=P(AXIS_ACTORS),
                     )
                 )
             return jax.jit(body)
@@ -241,11 +243,44 @@ class CompiledPredictor:
         else:
             xb_dev = jax.device_put(xb, self.devices[0])
             base_dev = jax.device_put(base, self.devices[0])
-        res = self._program(kind)(
-            self.forest_dev, self.tw_dev, xb_dev, base_dev
-        )
+        prog = self._program(kind)
+        self._note_program(kind, bucket, prog, (xb_dev, base_dev))
+        res = prog(self.forest_dev, self.tw_dev, xb_dev, base_dev)
         out = np.asarray(res)[:n]
         return self._finalize(out, kind), bucket
+
+    def _note_program(self, kind: str, bucket: int, prog, row_args) -> None:
+        """Register the bucket's program signature with the progreg registry
+        (no-op unless capture is on — the serve hot path pays one early
+        return). ``row_args`` are the (x, base) batch arrays; only shapes
+        and dtypes are read, so host arrays work as well as device ones."""
+        if not progreg.enabled():
+            return
+        prog_kind = "margin" if kind == "value" else kind
+        progreg.note_jit_call(
+            f"serve.predict_{prog_kind}",
+            prog,
+            (self.forest_dev, self.tw_dev) + tuple(row_args),
+            meta={
+                "world": len(self.devices),
+                "bucket": int(bucket),
+                "grower": "serve",
+                "hist_quant": "none",
+                "sampling": "none",
+            },
+        )
+
+    def register_programs(self, kinds=KINDS, batch: int = 8) -> None:
+        """Build + register the bucket programs for ``batch`` rows WITHOUT
+        executing (jit stays lazy): the jaxpr verifier's entry point. Uses
+        the exact argument assembly of :meth:`predict_with_bucket`."""
+        b = self.booster
+        n_dev = len(self.devices)
+        bucket = bucket_rows(batch, self.min_bucket, n_dev)
+        xb = np.zeros((bucket, b.num_features), np.float32)
+        base = np.full((bucket, b.num_outputs), self.m0, np.float32)
+        for kind in kinds:
+            self._note_program(kind, bucket, self._program(kind), (xb, base))
 
     def _finalize(self, out: np.ndarray, kind: str) -> np.ndarray:
         b = self.booster
