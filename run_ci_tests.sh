@@ -13,8 +13,17 @@ echo "================= rxgbverify jaxpr verification (tier-1 gate) ============
 # fingerprints on the jaxprs; exits non-zero on any finding. The JSON
 # artifact (incl. per-program fingerprints) is what future PRs diff.
 python -m tools.rxgbverify --json /tmp/rxgbverify.json --sarif /tmp/rxgbverify.sarif --fingerprints /tmp/rxgbverify_fingerprints.json
+echo "================= rxgbrace interleaving exploration (tier-1 gate) ================="
+# third static-analysis layer, schedule-level: exhaustively explores the
+# threaded host plane's scenario units under a deterministic cooperative
+# scheduler (DPOR sleep-set pruning) and runs the vector-clock + lockset
+# race detector over every explored schedule; exits non-zero on any
+# RACE*/SCHED* finding. Failing schedules replay bit-identically via
+# `python -m tools.rxgbrace --replay <fingerprint>`.
+python -m tools.rxgbrace --json /tmp/rxgbrace.json --sarif /tmp/rxgbrace.sarif
 python -m pytest tests/test_lint.py -v -x
 python -m pytest tests/test_verify.py -v -x
+python -m pytest tests/test_race.py -v -x
 python -m pytest tests/test_matrix.py -v -x
 python -m pytest tests/test_data_source.py -v -x
 python -m pytest tests/test_ops.py -v -x

@@ -247,8 +247,9 @@ def test_elastic_grace_clock_disarms_when_ready_pending_lost(monkeypatch):
     assert elastic._update_scheduled_actor_states(state) is False  # arms
     assert state.restart_training_at is not None
     # the armed worker is lost to a (late) load error and gets dropped
-    state.pending_actors[2].error = RuntimeError("load failed")
-    state.pending_actors[2].ready_at = None
+    # (mark_error is the locked writer the load thread itself uses; an
+    # errored worker is dropped regardless of its ready flag)
+    state.pending_actors[2].mark_error(RuntimeError("load failed"))
     assert elastic._update_scheduled_actor_states(state) is False
     assert state.restart_training_at is None  # clock disarmed
     # a fresh ready worker arms a FRESH grace period; with the long grace

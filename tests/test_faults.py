@@ -399,6 +399,43 @@ def test_async_checkpoint_writer_surfaces_write_errors(tmp_path):
     assert load_round_checkpoint(ok_path)[1] == 2
 
 
+def test_async_checkpoint_writer_bounded_exit_join(tmp_path, monkeypatch, caplog):
+    """Satellite acceptance: a hung commit can no longer wedge driver exit.
+    Under a forced-slow ``checkpoint.save`` fault (injected delay AFTER the
+    commit), the context-manager exit joins for at most
+    ``RXGB_CKPT_EXIT_JOIN_S`` seconds, logs loudly, and abandons the daemon
+    writer instead of blocking forever."""
+    import logging
+    import time as _time
+
+    from xgboost_ray_tpu.launcher import AsyncCheckpointWriter
+
+    x, y = _data(64)
+    bst = train(_PARAMS, RayDMatrix(x, y), 2,
+                ray_params=RayParams(num_actors=2))
+    ckpt = str(tmp_path / "ckpt.json")
+    monkeypatch.setenv("RXGB_CKPT_EXIT_JOIN_S", "0.2")
+    plan = faults.FaultPlan(rules=[
+        {"site": "checkpoint.save", "action": "delay", "delay_s": 0.9},
+    ])
+    w = AsyncCheckpointWriter()
+    with faults.active_plan(plan):
+        t0 = _time.monotonic()
+        with caplog.at_level(logging.ERROR, logger="xgboost_ray_tpu.launcher"):
+            with w:
+                w.submit(bst, ckpt, 1)
+        exit_s = _time.monotonic() - t0
+    assert exit_s < 0.8, f"exit blocked {exit_s:.2f}s despite the bounded join"
+    assert any("NOT confirmed" in r.message for r in caplog.records), (
+        "abandoning the join must be LOUD"
+    )
+    # the injected delay fires AFTER the atomic rename: once the abandoned
+    # writer finishes, the checkpoint is intact on disk and a later
+    # unbounded wait() can still collect the thread
+    assert w.wait() is True
+    assert load_round_checkpoint(ckpt)[1] == 2
+
+
 def test_checkpoint_load_fault_site(tmp_path):
     plan = faults.FaultPlan(rules=[
         {"site": "checkpoint.load", "action": "raise", "exc": "OSError"},

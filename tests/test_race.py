@@ -1,0 +1,546 @@
+"""rxgbrace: instrumentation, vector-clock/lockset detector, deterministic
+schedule explorer, shipped scenarios, catalog cross-check, SARIF golden.
+
+The heavyweight scenarios (batcher, tracer — ~1100/~750 schedules) are
+exercised by the ``python -m tools.rxgbrace`` CI gate in run_ci_tests.sh;
+the pytest tier keeps the fast subset so the suite stays quick while every
+scenario still runs clean somewhere in tier-1.
+"""
+
+import ast
+import json
+import os
+import textwrap
+import threading
+import time
+
+import pytest
+
+from tools.rxgbrace import RACE_RULES
+from tools.rxgbrace.detector import detect, race003_findings
+from tools.rxgbrace.events import Recorder
+from tools.rxgbrace.explore import (
+    events_digest,
+    explore,
+    fingerprint_of,
+    parse_fingerprint,
+    replay,
+    run_scenario,
+)
+from tools.rxgbrace.instrument import Instrumentation, resolve_catalog_classes
+from tools.rxgbrace.scenarios import SCENARIOS, Scenario, by_name
+
+
+# ---------------------------------------------------------------------------
+# satellite: the lock-owning-class catalog is ONE list shared by both tools
+# ---------------------------------------------------------------------------
+
+
+def test_lock_owning_catalog_contents():
+    from tools.rxgblint import catalog
+
+    recs = {r.qualname: r for r in catalog.lock_owning_classes()}
+    expected = {
+        "FaultPlan", "Tracer", "MicroBatcher", "ModelRegistry",
+        "ServeMetrics", "Counter", "Gauge", "LatencyHistogram",
+        "MetricsRegistry", "PendingActor",
+    }
+    assert expected <= set(recs), sorted(recs)
+    assert dict(recs["ModelRegistry"].locks) == {
+        "_cond": "condition", "_load_lock": "lock",
+    }
+    assert dict(recs["MicroBatcher"].locks) == {"_cond": "condition"}
+    # the PR's race fix: PendingActor is now catalogued with its guarded set
+    assert set(recs["PendingActor"].shared) == {"_ready_at", "_error"}
+    assert "_swapping" in recs["ModelRegistry"].shared
+    assert "_seq" in recs["Tracer"].shared
+
+
+def test_catalog_agreement_lint_vs_instrumenter():
+    """Cross-check: rxgblint's LOCK001 and rxgbrace's instrumenter must
+    agree on which classes own locks — structurally (LOCK001 delegates to
+    the same extraction) AND at runtime (every record resolves to a real
+    class of the same name, with no import errors)."""
+    from tools.rxgblint import catalog, rules
+
+    # AST side: LOCK001's per-class extraction == the catalog's
+    for path in catalog._package_files(catalog.REPO_ROOT):
+        with open(path, "r", encoding="utf-8") as f:
+            try:
+                tree = ast.parse(f.read())
+            except SyntaxError:
+                continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                assert rules._lock_attrs_of_class(node) == set(
+                    catalog.lock_attr_kinds(node)
+                ), f"{path}:{node.name}"
+    # runtime side: the instrumenter resolves the identical list
+    pairs, errors = resolve_catalog_classes()
+    assert errors == []
+    resolved = {cls.__qualname__ for cls, _ in pairs}
+    assert resolved == {r.qualname for r in catalog.lock_owning_classes()}
+
+
+# ---------------------------------------------------------------------------
+# instrumentation + detector (record-only mode)
+# ---------------------------------------------------------------------------
+
+
+class _Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def bump_bare(self):
+        self._n += 1
+
+
+def _two_threads(*targets):
+    ts = [
+        threading.Thread(target=t, name=f"t{i}")
+        for i, t in enumerate(targets)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+
+def test_record_only_capture_and_locksets():
+    rec = Recorder()
+    with Instrumentation(recorder=rec, classes=[(_Guarded, ["_n"])]):
+        g = _Guarded()
+        g.bump()
+    ops = [(e.op, e.obj, e.attr) for e in rec.snapshot()]
+    assert ("acquire", "Lock#1", "") in ops
+    assert ("release", "Lock#1", "") in ops
+    reads = [e for e in rec.snapshot() if e.op == "read" and e.attr == "_n"]
+    writes = [e for e in rec.snapshot() if e.op == "write" and e.attr == "_n"]
+    assert reads and writes
+    # the += under the lock carries the held lockset
+    assert writes[-1].locks == ("Lock#1",)
+
+
+def test_race001_true_positive_and_clean_negative():
+    rec = Recorder()
+    with Instrumentation(recorder=rec, classes=[(_Guarded, ["_n"])]):
+        g = _Guarded()
+        _two_threads(g.bump, g.bump_bare)
+    races = [f for f in detect(rec.snapshot()) if f.rule == "RACE001"]
+    assert races and "_n" in races[0].message
+
+    rec2 = Recorder()
+    with Instrumentation(recorder=rec2, classes=[(_Guarded, ["_n"])]):
+        g = _Guarded()
+        _two_threads(g.bump, g.bump)
+    assert detect(rec2.snapshot()) == []
+
+
+def test_race001_fork_join_edges_order_accesses():
+    """__init__ writes by the parent are fork-ordered before child reads;
+    a parent write AFTER forking (without joining first) races."""
+    rec = Recorder()
+    with Instrumentation(recorder=rec, classes=[(_Guarded, ["_n"])]):
+        g = _Guarded()  # parent writes _n = 0
+        t = threading.Thread(target=g.bump_bare, name="child")
+        t.start()
+        t.join()
+        g.bump_bare()  # ordered AFTER the join: no race either
+    assert detect(rec.snapshot()) == []
+
+    rec2 = Recorder()
+    with Instrumentation(recorder=rec2, classes=[(_Guarded, ["_n"])]):
+        g = _Guarded()
+        t = threading.Thread(target=g.bump_bare, name="child")
+        t.start()
+        g.bump_bare()  # concurrent with the child: races
+        t.join()
+    assert any(f.rule == "RACE001" for f in detect(rec2.snapshot()))
+
+
+def test_race001_event_edge_orders_handoff():
+    """producer-write -> Event.set -> consumer-wait -> consumer-read is the
+    batcher's result-handoff pattern; the set->wait edge must order it."""
+    rec = Recorder()
+    with Instrumentation(recorder=rec, classes=[(_Guarded, ["_n"])]):
+        g = _Guarded()
+        done = threading.Event()
+
+        def producer():
+            g.bump_bare()
+            done.set()
+
+        def consumer():
+            done.wait()
+            assert g._n == 1
+
+        _two_threads(producer, consumer)
+    assert detect(rec.snapshot()) == []
+
+
+def test_race002_lock_order_inversion_and_clean():
+    rec = Recorder()
+    with Instrumentation(recorder=rec, classes=None):
+        a, b = threading.Lock(), threading.Lock()
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        # run sequentially: the cycle is detected from the GRAPH, not from
+        # an actual deadlock occurring
+        t = threading.Thread(target=ab, name="x")
+        t.start()
+        t.join()
+        t = threading.Thread(target=ba, name="y")
+        t.start()
+        t.join()
+    races = [f for f in detect(rec.snapshot()) if f.rule == "RACE002"]
+    assert races and "inversion cycle" in races[0].message
+
+    rec2 = Recorder()
+    with Instrumentation(recorder=rec2, classes=None):
+        a, b = threading.Lock(), threading.Lock()
+
+        def ab2():
+            with a:
+                with b:
+                    pass
+
+        for _ in range(2):
+            t = threading.Thread(target=ab2, name="z")
+            t.start()
+            t.join()
+    assert [f for f in detect(rec2.snapshot()) if f.rule == "RACE002"] == []
+
+
+# ---------------------------------------------------------------------------
+# RACE003 (static)
+# ---------------------------------------------------------------------------
+
+
+def _fixture_pkg(tmp_path, source: str) -> str:
+    pkg = tmp_path / "xgboost_ray_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(source))
+    return str(tmp_path)
+
+
+def test_race003_wait_outside_loop(tmp_path):
+    root = _fixture_pkg(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._cond = threading.Condition(threading.Lock())
+                self._items = []
+
+            def bad_get(self):
+                with self._cond:
+                    if not self._items:
+                        self._cond.wait()   # planted: if, not while
+                    return self._items.pop()
+
+            def good_get(self):
+                with self._cond:
+                    while not self._items:
+                        self._cond.wait()
+                    return self._items.pop()
+    """)
+    fs = race003_findings(root)
+    assert len(fs) == 1 and fs[0].rule == "RACE003"
+    assert "bad_get" in fs[0].message and "_cond" in fs[0].message
+
+
+def test_race003_shipped_package_clean():
+    assert race003_findings() == []
+
+
+# ---------------------------------------------------------------------------
+# scheduler + explorer
+# ---------------------------------------------------------------------------
+
+
+class _Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+
+def _toy_scenario():
+    def body(ctx):
+        b = ctx.box = _Box()
+        _two_threads(b.bump, b.bump)
+
+    def inv(ctx):
+        # explicit raise: pytest's assert-rewrite would embed object reprs
+        # (memory addresses) in the message and break stable failure dedup
+        if ctx.box._n != 2:
+            raise AssertionError(f"lost update: {ctx.box._n}")
+
+    return Scenario("toy", "toy", body, inv, classes=[(_Box, ["_n"])])
+
+
+def test_explorer_exhaustive_and_deterministic():
+    scn = _toy_scenario()
+    res = explore(scn)
+    assert res.clean and res.schedules >= 2 and not res.truncated
+    r1 = run_scenario(scn, [1])
+    r2 = run_scenario(scn, [1])
+    assert events_digest(r1.events) == events_digest(r2.events)
+
+
+class _ClaimFlag:
+    """check-then-act across two critical sections: a classic TOCTOU only
+    visible to interleaving exploration (each section alone is guarded, so
+    no data race exists for the detector)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._claimed = False
+
+    def try_claim(self) -> bool:
+        with self._lock:
+            c = self._claimed
+        if not c:
+            with self._lock:
+                self._claimed = True
+            return True
+        return False
+
+
+def _claim_scenario():
+    def body(ctx):
+        f = ctx.flag = _ClaimFlag()
+        ctx.w0 = False
+        ctx.w1 = False
+
+        def worker(tag):
+            if f.try_claim():
+                setattr(ctx, tag, True)
+
+        _two_threads(lambda: worker("w0"), lambda: worker("w1"))
+
+    def inv(ctx):
+        if ctx.w0 + ctx.w1 != 1:  # explicit raise: stable message for dedup
+            raise AssertionError(f"double claim: {ctx.w0}, {ctx.w1}")
+
+    return Scenario("claim", "x", body, inv, classes=[(_ClaimFlag, ["_claimed"])])
+
+
+def test_explorer_finds_toctou_and_replays_bit_identically():
+    scn = _claim_scenario()
+    res = explore(scn)
+    fails = [f for f in res.failures if f.kind == "invariant"]
+    assert fails, "the double-claim schedule was not found"
+    fp = fails[0].fingerprint
+    name, forced = parse_fingerprint(fp)
+    assert name == "claim" and forced
+    assert fingerprint_of(name, forced) == fp
+    r1 = replay(scn, fp)
+    r2 = replay(scn, fp)
+    assert r1.invariant_error and r2.invariant_error == r1.invariant_error
+    assert events_digest(r1.events) == events_digest(r2.events)
+
+
+def test_pruning_preserves_findings():
+    scn = _claim_scenario()
+    pruned = explore(scn, prune=True)
+    full = explore(scn, prune=False)
+    get = lambda r: {f.detail for f in r.failures if f.kind == "invariant"}
+    assert get(pruned) == get(full) != set()
+    assert full.schedules >= pruned.schedules
+    assert pruned.pruned > 0
+
+
+def test_explorer_detects_real_deadlock():
+    def body(ctx):
+        a, b = threading.Lock(), threading.Lock()
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        _two_threads(ab, ba)
+
+    scn = Scenario("dl", "x", body, lambda ctx: None, classes=None)
+    res = explore(scn)
+    assert any(f.kind == "deadlock" for f in res.failures)
+    assert any(f.rule == "RACE002" for f in res.races)
+
+
+# ---------------------------------------------------------------------------
+# shipped scenarios (fast subset; the CLI gate runs all seven)
+# ---------------------------------------------------------------------------
+
+_FAST_SCENARIOS = (
+    "registry_hot_swap",
+    "ckpt_writer_commit_vs_restart",
+    "faultplan_fire_vs_reset",
+    "metrics_record_vs_render",
+    "elastic_pending_load_vs_poll",
+)
+
+
+@pytest.mark.parametrize("name", _FAST_SCENARIOS)
+def test_shipped_scenario_explores_clean(name):
+    res = explore(by_name(name))
+    assert not res.truncated, "scenario outgrew its exhaustiveness cap"
+    assert res.schedules >= 1
+    assert res.failures == [], [
+        (f.kind, f.fingerprint, f.detail) for f in res.failures
+    ]
+    assert res.races == [], [f.render() for f in res.races]
+
+
+def test_scenario_suite_covers_six_plus():
+    assert len(SCENARIOS) >= 6
+    assert len({s.name for s in SCENARIOS}) == len(SCENARIOS)
+
+
+class _OldPendingActor:
+    """Replica of the PRE-FIX elastic.PendingActor hot path: ready_at
+    written by the load thread, polled by the driver, no lock — pins that
+    rxgbrace catches exactly the shipped bug this PR fixed."""
+
+    def __init__(self):
+        self._lock = threading.Lock()  # existed, but the hot path skipped it
+        self._ready_at = None
+
+    def mark_ready_bare(self):
+        self._ready_at = time.time()
+
+    @property
+    def ready(self):
+        return self._ready_at is not None
+
+
+def test_prefix_pendingactor_shape_is_flagged():
+    def body(ctx):
+        p = _OldPendingActor()
+
+        def loader():
+            p.mark_ready_bare()
+
+        def driver():
+            ctx.outs = [p.ready for _ in range(2)]
+
+        _two_threads(loader, driver)
+
+    scn = Scenario(
+        "old_pending", "x", body, lambda ctx: None,
+        classes=[(_OldPendingActor, ["_ready_at"])],
+    )
+    res = explore(scn)
+    assert any(
+        f.rule == "RACE001" and "_ready_at" in f.message for f in res.races
+    )
+
+
+def test_fixed_pendingactor_scenario_is_clean():
+    # the shipped scenario instruments the REAL PendingActor via the
+    # catalog; post-fix it must run exhaustively clean
+    res = explore(by_name("elastic_pending_load_vs_poll"))
+    assert res.clean, ([f.render() for f in res.races], res.failures)
+
+
+# ---------------------------------------------------------------------------
+# SARIF golden (byte-exact RACE001 document) + CLI
+# ---------------------------------------------------------------------------
+
+_GOLDEN = os.path.join(
+    os.path.dirname(__file__), "goldens", "sarif_race_golden.json"
+)
+
+
+def test_sarif_race001_golden_file():
+    """Byte-stable RACE001 SARIF document through the shared writer —
+    the same pin test_sarif_golden_file gives rxgbverify."""
+    from tools.sarif import to_sarif_json
+
+    doc = to_sarif_json(
+        "rxgbrace", RACE_RULES,
+        [
+            {
+                "rule": "RACE001",
+                "message": (
+                    "unordered write/read of PendingActor#1._ready_at: "
+                    "elastic-load-rank-0 vs driver — no ordering edge, "
+                    "disjoint locksets"
+                ),
+                "path": "xgboost_ray_tpu/elastic.py",
+                "line": 92,
+            },
+        ],
+    )
+    with open(_GOLDEN) as fh:
+        assert json.loads(doc) == json.load(fh)
+        fh.seek(0)
+        assert doc + "\n" == fh.read()  # byte-for-byte, trailing newline
+
+
+def test_cli_lists_and_single_scenario_gate(tmp_path):
+    from tools.rxgbrace.__main__ import main
+
+    assert main(["--list-rules"]) == 0
+    assert main(["--list-scenarios"]) == 0
+    j = tmp_path / "race.json"
+    s = tmp_path / "race.sarif"
+    rc = main([
+        "--scenario", "faultplan_fire_vs_reset",
+        "--json", str(j), "--sarif", str(s),
+    ])
+    assert rc == 0
+    doc = json.loads(j.read_text())
+    assert doc["tool"] == "rxgbrace" and doc["findings"] == []
+    rep = doc["scenarios"]["faultplan_fire_vs_reset"]
+    assert rep["schedules"] >= 2 and rep["status"] == "clean"
+    assert not rep["truncated"]
+    sarif_doc = json.loads(s.read_text())
+    assert sarif_doc["runs"][0]["results"] == []
+    assert sarif_doc["runs"][0]["tool"]["driver"]["name"] == "rxgbrace"
+    rules = {r["id"] for r in sarif_doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert rules == set(RACE_RULES)
+
+
+def test_cli_replay_roundtrip(capsys):
+    from tools.rxgbrace.__main__ import main
+
+    rc = main(["--replay", "faultplan_fire_vs_reset@0"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "status=complete" in out and "digest=" in out
+    # unknown scenario name is a usage error, not a crash
+    assert main(["--replay", "nope@1"]) == 2
+
+
+def test_instrumentation_restores_threading(tmp_path):
+    real_lock = threading.Lock
+    real_thread = threading.Thread
+    with Instrumentation(classes=None):
+        assert threading.Lock is not real_lock
+    assert threading.Lock is real_lock
+    assert threading.Thread is real_thread
+    assert time.monotonic.__module__ == "time"

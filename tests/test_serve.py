@@ -319,6 +319,105 @@ def test_hot_swap_under_load_no_dropped_or_mixed(binary_model, binary_model_b):
                               ref[v].astype(np.float32)), v
 
 
+def test_http_handlers_concurrent_with_hot_swap(binary_model, binary_model_b):
+    """Satellite acceptance (rxgbrace PR): /predict, /metrics and /healthz
+    all running concurrently with registry hot-swaps — no request may ever
+    observe a half-swapped model: every /predict response's predictions are
+    bitwise those of the version it reports, /healthz always reports a
+    committed version (never a mid-drain intermediate), and /metrics stays
+    servable and internally consistent throughout."""
+    bst_a, x = binary_model
+    bst_b, _ = binary_model_b
+    q = x[:3]
+    ref = {}  # committed version -> expected predictions
+    h = serve.create_server(bst_a, max_batch=32, max_delay_ms=1.0)
+    ref[1] = bst_a.predict(q)
+    errors, preds, healths, metrics = [], [], [], []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def predict_client():
+        while not stop.is_set():
+            try:
+                status, r = _post(h.url, "/predict", {"data": q.tolist()})
+                with lock:
+                    preds.append((status, r["model_version"],
+                                  np.asarray(r["predictions"])))
+            except Exception as exc:  # noqa: BLE001 - recorded
+                with lock:
+                    errors.append(("predict", repr(exc)))
+
+    def health_client():
+        while not stop.is_set():
+            try:
+                status, r = _get(h.url, "/healthz")
+                with lock:
+                    healths.append((status, r))
+            except Exception as exc:  # noqa: BLE001 - recorded
+                with lock:
+                    errors.append(("healthz", repr(exc)))
+
+    def metrics_client():
+        while not stop.is_set():
+            try:
+                status, r = _get(h.url, "/metrics")
+                with lock:
+                    metrics.append((status, r))
+            except Exception as exc:  # noqa: BLE001 - recorded
+                with lock:
+                    errors.append(("metrics", repr(exc)))
+
+    threads = [
+        threading.Thread(target=predict_client),
+        threading.Thread(target=predict_client),
+        threading.Thread(target=health_client),
+        threading.Thread(target=metrics_client),
+    ]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        # two hot-swaps under sustained mixed traffic (A -> B -> A shape:
+        # same buckets, different trees)
+        assert h.registry.load(bst_b) == 2
+        ref[2] = bst_b.predict(q)
+        time.sleep(0.2)
+        assert h.registry.load(bst_a) == 3
+        ref[3] = ref[1]
+        time.sleep(0.2)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(30.0)
+        h.shutdown()
+    assert not errors, errors[:3]
+    assert len(preds) > 10 and len(healths) > 3 and len(metrics) > 3
+    seen_versions = {v for _, v, _ in preds}
+    assert seen_versions <= {1, 2, 3} and len(seen_versions) >= 2
+    for status, version, got in preds:
+        # the half-swap pin: the response is wholly from the version it
+        # reports — bitwise equal to that committed model's predictions
+        assert status == 200
+        assert np.array_equal(
+            got.astype(np.float32), ref[version].astype(np.float32)
+        ), f"half-swapped response for v{version}"
+    for status, doc in healths:
+        assert status == 200, doc
+        assert doc["status"] == "ok"
+        assert doc["model_version"] in (1, 2, 3), (
+            f"/healthz reported uncommitted version: {doc}"
+        )
+    swaps_seen = 0
+    for status, doc in metrics:
+        assert status == 200
+        # every successful /predict records requests+=1 and rows+=3 under
+        # ONE lock, and snapshot() cuts under the same lock: any mid-run
+        # snapshot must see them exactly in lockstep
+        assert doc["rows"] == doc["requests"] * 3, f"torn counters: {doc}"
+        swaps_seen = max(swaps_seen, doc["model_swaps"])
+    assert swaps_seen <= 2  # two live swaps (initial load is not a swap)
+
+
 # ---------------------------------------------------------------------------
 # registry loading surfaces
 # ---------------------------------------------------------------------------

@@ -11,7 +11,7 @@ import ast
 import functools
 import os
 import re
-from typing import FrozenSet, Set, Tuple
+from typing import Dict, FrozenSet, List, NamedTuple, Optional, Set, Tuple
 
 #: repository root = two levels above this file (tools/rxgblint/)
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -197,6 +197,164 @@ REQUIRED_EXPORTS: FrozenSet[str] = frozenset({
     "validate_trace_records",         # PR 6
     "recovery_time_s",                # PR 6 obs helper
 })
+
+# ---------------------------------------------------------------------------
+# LOCK: the lock-owning-class catalog (shared with tools/rxgbrace)
+# ---------------------------------------------------------------------------
+
+#: threading primitive type names whose presence in an attribute's assigned
+#: value (or annotation) marks the attribute as a lock
+LOCK_TYPES: FrozenSet[str] = frozenset({"Lock", "RLock", "Condition"})
+
+
+def _is_self_attr(node: ast.AST) -> Optional[str]:
+    """``self.<attr>`` -> "<attr>" (None otherwise)."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _mentions(node: ast.AST, idents: FrozenSet[str]) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Name, ast.Attribute)):
+            tail = sub.attr if isinstance(sub, ast.Attribute) else sub.id
+            if tail in idents:
+                return True
+    return False
+
+
+def lock_attr_kinds(cls: ast.ClassDef) -> Dict[str, str]:
+    """Lock-typed attributes of one class AST node, mapped to their kind
+    (``condition`` | ``rlock`` | ``lock``). This is THE definition of
+    "lock-owning class" — rxgblint's LOCK001 and rxgbrace's runtime
+    instrumenter both key off it, so the two tools can never disagree on
+    which classes own locks."""
+
+    def _kind(node: ast.AST) -> Optional[str]:
+        # Condition(threading.Lock()) mentions both; the outermost wins
+        if _mentions(node, frozenset({"Condition"})):
+            return "condition"
+        if _mentions(node, frozenset({"RLock"})):
+            return "rlock"
+        if _mentions(node, frozenset({"Lock"})):
+            return "lock"
+        return None
+
+    kinds: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        target_attr = None
+        value = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            attr = _is_self_attr(tgt)
+            if attr:
+                target_attr, value = attr, node.value
+            elif isinstance(tgt, ast.Name):  # class-body field
+                target_attr, value = tgt.id, node.value
+        elif isinstance(node, ast.AnnAssign):
+            attr = _is_self_attr(node.target)
+            if attr:
+                target_attr = attr
+            elif isinstance(node.target, ast.Name):
+                target_attr = node.target.id
+            value = node.value if node.value is not None else node.annotation
+        if target_attr is None or value is None:
+            continue
+        kind = _kind(value)
+        if kind is None and isinstance(node, ast.AnnAssign):
+            # the annotation counts too: `_cond: threading.Condition = field()`
+            kind = _kind(node.annotation)
+        if kind is not None:
+            kinds[target_attr] = kind
+    return kinds
+
+
+def shared_attrs_of_class(cls: ast.ClassDef, locks: FrozenSet[str]) -> FrozenSet[str]:
+    """The class's shared-mutable attribute set: every ``self._x`` assigned
+    inside a ``with self.<lock>`` block or inside a ``*_locked``
+    (caller-holds-the-lock) method — the same definition LOCK001 guards and
+    the attribute set rxgbrace's instrumenter records accesses to."""
+    shared: Set[str] = set()
+
+    def visit(node: ast.AST, holding: bool, fn_name: str) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_name = node.name
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if _is_self_attr(item.context_expr) in locks:
+                    holding = True
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for tgt in targets:
+            if isinstance(tgt, ast.Subscript):
+                tgt = tgt.value
+            attr = _is_self_attr(tgt)
+            if (
+                attr
+                and attr.startswith("_")
+                and attr not in locks
+                and (holding or fn_name.endswith("_locked"))
+            ):
+                shared.add(attr)
+        for child in ast.iter_child_nodes(node):
+            visit(child, holding, fn_name)
+
+    visit(cls, False, "")
+    return frozenset(shared)
+
+
+class LockClassRecord(NamedTuple):
+    """One lock-owning class: where it lives, its locks, and the shared
+    attribute set its locks guard."""
+
+    path: str  # repo-relative posix path of the defining module
+    module: str  # dotted import path (for runtime instrumentation)
+    qualname: str  # class qualname within the module ("Outer.Inner" if nested)
+    locks: Tuple[Tuple[str, str], ...]  # sorted (attr, kind) pairs
+    shared: Tuple[str, ...]  # sorted shared-mutable attr names
+
+
+@functools.lru_cache(maxsize=None)
+def lock_owning_classes(root: str = REPO_ROOT) -> Tuple[LockClassRecord, ...]:
+    """Every lock-owning class in the package, extracted by AST (the linter
+    never imports the package). Public API consumed by rxgbrace's runtime
+    instrumenter — one catalog, two tools."""
+    records: List[LockClassRecord] = []
+
+    def collect(body, prefix: str, rel: str, module: str) -> None:
+        for node in body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            qual = f"{prefix}{node.name}"
+            kinds = lock_attr_kinds(node)
+            if kinds:
+                locks = frozenset(kinds)
+                records.append(LockClassRecord(
+                    path=rel,
+                    module=module,
+                    qualname=qual,
+                    locks=tuple(sorted(kinds.items())),
+                    shared=tuple(sorted(shared_attrs_of_class(node, locks))),
+                ))
+            collect(node.body, f"{qual}.", rel, module)
+
+    for path in _package_files(root):
+        try:
+            tree = _parse(path)
+        except SyntaxError:
+            continue
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        module = rel[:-3].replace("/", ".")
+        collect(tree.body, "", rel, module)
+    return tuple(sorted(records, key=lambda r: (r.path, r.qualname)))
+
 
 # ---------------------------------------------------------------------------
 # shared helpers

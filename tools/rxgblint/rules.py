@@ -57,7 +57,6 @@ _SET_CONSUMERS = frozenset({
 })
 _SYNC_BUILTINS = frozenset({"float", "bool"})
 _EXEMPT_METHODS = frozenset({"__init__", "__post_init__", "__new__", "__del__"})
-_LOCK_TYPES = frozenset({"Lock", "RLock", "Condition"})
 
 
 def _terminal(node: ast.AST) -> str:
@@ -81,21 +80,10 @@ def _chain(node: ast.AST) -> List[str]:
     return []
 
 
-def _is_self_attr(node: ast.AST) -> Optional[str]:
-    if (
-        isinstance(node, ast.Attribute)
-        and isinstance(node.value, ast.Name)
-        and node.value.id == "self"
-    ):
-        return node.attr
-    return None
-
-
-def _mentions(node: ast.AST, idents: frozenset) -> bool:
-    for sub in ast.walk(node):
-        if isinstance(sub, (ast.Name, ast.Attribute)) and _terminal(sub) in idents:
-            return True
-    return False
+# shared with the lock-owning-class catalog (catalog.lock_owning_classes is
+# the single definition rxgbrace's instrumenter reuses)
+_is_self_attr = catalog._is_self_attr
+_mentions = catalog._mentions
 
 
 def _rank_tainted(cond: ast.AST) -> bool:
@@ -527,34 +515,9 @@ def check_sync001(mod: _Module) -> List[Finding]:
 
 
 def _lock_attrs_of_class(cls: ast.ClassDef) -> Set[str]:
-    locks: Set[str] = set()
-    for node in ast.walk(cls):
-        target_attr = None
-        value = None
-        if isinstance(node, ast.Assign) and len(node.targets) == 1:
-            tgt = node.targets[0]
-            attr = _is_self_attr(tgt)
-            if attr:
-                target_attr, value = attr, node.value
-            elif isinstance(tgt, ast.Name):  # class-body field
-                target_attr, value = tgt.id, node.value
-        elif isinstance(node, ast.AnnAssign):
-            attr = _is_self_attr(node.target)
-            if attr:
-                target_attr = attr
-            elif isinstance(node.target, ast.Name):
-                target_attr = node.target.id
-            value = node.value if node.value is not None else node.annotation
-        if target_attr and value is not None and _mentions(value, _LOCK_TYPES):
-            # the annotation counts too: `_cond: threading.Condition = field(...)`
-            locks.add(target_attr)
-        elif (
-            target_attr
-            and isinstance(node, ast.AnnAssign)
-            and _mentions(node.annotation, _LOCK_TYPES)
-        ):
-            locks.add(target_attr)
-    return locks
+    """Delegates to the shared catalog extraction — LOCK001's notion of
+    "lock-owning" and the rxgbrace instrumenter's are the same function."""
+    return set(catalog.lock_attr_kinds(cls))
 
 
 def _held_locks(cls: ast.ClassDef, locks: Set[str]) -> Dict[ast.AST, frozenset]:

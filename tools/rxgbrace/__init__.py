@@ -1,0 +1,70 @@
+"""rxgbrace: deterministic interleaving explorer + vector-clock race
+detector for the threaded host plane.
+
+The third static-analysis layer (and third tier-1 CI gate), covering what
+rxgblint's lexical LOCK001 and rxgbverify's jaxpr checks structurally
+cannot see: *interleavings*. Three parts:
+
+1. **Instrumentation** (`instrument.py`): a context manager that
+   monkeypatches ``threading.Lock/RLock/Condition/Event/Thread`` and hooks
+   attribute access on the lock-owning classes from rxgblint's LOCK001
+   catalog (``tools.rxgblint.catalog.lock_owning_classes`` — one catalog,
+   two tools), recording per-thread event logs (acquire / release / wait /
+   notify / set / read / write / fork / join). Outside the context manager
+   nothing is patched and production code pays nothing.
+
+2. **Detector** (`detector.py`): a FastTrack-style vector-clock +
+   lockset pass over those logs. Ordering edges are fork/join,
+   ``Event.set -> wait`` and ``Condition.notify -> wake`` (lock
+   release→acquire is mutual exclusion, not ordering — the Eraser
+   insight, so a race is reported even when one schedule happened to
+   serialize it); properly lock-guarded state is recognized through the
+   recorded locksets. RACE001 = conflicting unordered access, RACE002 =
+   lock-order-inversion cycle in the global acquisition graph (the
+   deadlock certificate LOCK001 cannot give), RACE003 = a condition wait
+   outside a predicate re-check loop (AST pass over the same catalog).
+
+3. **Explorer** (`sched.py` + `explore.py` + `scenarios.py`): a
+   cooperative scheduler that serializes scenario threads at instrumented
+   sync points and exhaustively enumerates interleavings of small
+   shipped-code scenario units (registry hot-swap vs lease, batcher
+   deadline-flush vs shutdown vs shed, AsyncCheckpointWriter commit vs
+   driver exit, tracer emit vs snapshot, FaultPlan fire vs reset, metrics
+   record vs Prometheus render, elastic pending-load vs driver poll) with
+   DPOR-style sleep-set pruning. Every terminal state checks the
+   scenario's invariant; a failing schedule is captured as a seedable
+   fingerprint (``scenario@choice.choice. ...``) that replays
+   bit-identically.
+
+Findings flow through the shared ``tools/sarif.py`` writer; the CLI
+(``python -m tools.rxgbrace``) exits 1 on any finding.
+"""
+
+from typing import Dict
+
+#: rule code -> one-line description (the catalog printed by --list-rules,
+#: embedded in the SARIF driver, and documented in README "Static analysis")
+RACE_RULES: Dict[str, str] = {
+    "RACE001": (
+        "conflicting cross-thread access to shared state with no ordering "
+        "edge (fork/join/event/notify) and disjoint locksets — a torn read "
+        "or lost update some interleaving can realize"
+    ),
+    "RACE002": (
+        "lock-order inversion: a cycle in the global lock-acquisition "
+        "graph (thread holds A while taking B elsewhere B is held while "
+        "taking A) — a deadlock certificate, independent of whether this "
+        "run deadlocked"
+    ),
+    "RACE003": (
+        "condition wait outside a predicate re-check loop — a spurious or "
+        "stolen wakeup proceeds on a stale predicate"
+    ),
+    "SCHED001": (
+        "a scenario invariant failed (or the scenario deadlocked) at an "
+        "explored terminal state; the attached schedule fingerprint "
+        "replays the failing interleaving bit-identically"
+    ),
+}
+
+__all__ = ["RACE_RULES"]

@@ -1,0 +1,197 @@
+"""CLI: ``python -m tools.rxgbrace [--json F] [--sarif F] ...``.
+
+Runs the RACE003 AST pass over the package, then exhaustively explores
+every shipped scenario (deterministic interleavings + vector-clock/lockset
+detection on each explored schedule). Exit status mirrors the other two
+analysis gates: 0 = clean, 1 = findings, 2 = usage error.
+
+``--replay scenario@i.j.k`` re-executes one recorded schedule fingerprint
+and prints its event log — the bit-identical reproduction recipe for a
+SCHED001/RACE001 finding.
+"""
+
+import argparse
+import inspect
+import json
+import os
+import sys
+
+
+def _force_cpu() -> None:
+    """The scenarios import the serve layer (and therefore jax); keep it on
+    CPU and quiet, same treatment as rxgbverify."""
+    if "jax" not in sys.modules:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None) -> int:
+    from tools.rxgbrace import RACE_RULES
+
+    parser = argparse.ArgumentParser(
+        prog="rxgbrace",
+        description=(
+            "deterministic interleaving explorer + vector-clock race "
+            "detector for the threaded host plane of xgboost_ray_tpu"
+        ),
+    )
+    parser.add_argument(
+        "--json", metavar="FILE",
+        help="write the machine-readable report (the CI artifact: per-"
+             "scenario schedule counts + findings)",
+    )
+    parser.add_argument(
+        "--sarif", metavar="FILE",
+        help="write findings as SARIF 2.1.0 for code-review annotations",
+    )
+    parser.add_argument(
+        "--scenario", action="append", metavar="NAME",
+        help="explore only the named scenario(s) (repeatable)",
+    )
+    parser.add_argument(
+        "--replay", metavar="FINGERPRINT",
+        help="replay one schedule fingerprint (scenario@i.j.k) and print "
+             "its event log",
+    )
+    parser.add_argument(
+        "--max-schedules", type=int, default=30000,
+        help="per-scenario exhaustiveness cap; hitting it is itself a "
+             "finding (default 30000)",
+    )
+    parser.add_argument(
+        "--no-prune", action="store_true",
+        help="disable sleep-set pruning (slower, same findings — pinned by "
+             "tests)",
+    )
+    parser.add_argument(
+        "--list-scenarios", action="store_true",
+        help="print the scenario catalog",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for code in sorted(RACE_RULES):
+            print(f"{code}: {RACE_RULES[code]}")
+        return 0
+
+    _force_cpu()
+    from tools.rxgbrace import detector as det
+    from tools.rxgbrace import explore as exp
+    from tools.rxgbrace import scenarios as scn_mod
+
+    if args.list_scenarios:
+        for scn in scn_mod.SCENARIOS:
+            print(f"{scn.name}: {scn.description}")
+        return 0
+
+    if args.replay:
+        name, _ = exp.parse_fingerprint(args.replay)
+        try:
+            scn = scn_mod.by_name(name)
+        except KeyError as e:
+            print(f"rxgbrace: {e}", file=sys.stderr)
+            return 2
+        run = exp.replay(scn, args.replay)
+        for ev in run.events:
+            print(ev.key())
+        print(
+            f"rxgbrace replay: status={run.status} "
+            f"invariant={'FAILED: ' + run.invariant_error if run.invariant_error else 'ok'} "
+            f"digest={exp.events_digest(run.events)}"
+        )
+        return 0
+
+    if args.scenario:
+        try:
+            scenarios = [scn_mod.by_name(n) for n in args.scenario]
+        except KeyError as e:
+            print(f"rxgbrace: {e}", file=sys.stderr)
+            return 2
+    else:
+        scenarios = list(scn_mod.SCENARIOS)
+
+    findings = []
+    # static pass first: RACE003 over the package's condition catalog
+    findings.extend(det.race003_findings())
+
+    scenario_reports = {}
+    for scn in scenarios:
+        res = exp.explore(
+            scn, prune=not args.no_prune, max_schedules=args.max_schedules,
+        )
+        scn_findings = []
+        scn_line = inspect.getsourcelines(scn.body)[1]
+        for fail in res.failures:
+            scn_findings.append(det.RaceFinding(
+                rule="SCHED001",
+                path="tools/rxgbrace/scenarios.py", line=scn_line,
+                scenario=scn.name, fingerprint=fail.fingerprint,
+                message=(
+                    f"{scn.name}: {fail.kind} — {fail.detail} "
+                    f"(replay: python -m tools.rxgbrace --replay "
+                    f"{fail.fingerprint or scn.name + '@'})"
+                ),
+            ))
+        scn_findings.extend(res.races)
+        findings.extend(scn_findings)
+        scenario_reports[scn.name] = {
+            "description": scn.description,
+            "schedules": res.schedules,
+            "runs": res.runs,
+            "pruned": res.pruned,
+            "max_choice_depth": res.max_choice_depth,
+            "events": res.events_total,
+            "truncated": res.truncated,
+            "findings": [f.to_dict() for f in scn_findings],
+            "status": "clean" if not scn_findings else "findings",
+        }
+
+    counts = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+
+    # artifacts + exit status settle BEFORE stdout (a closed pipe must not
+    # turn findings into a pass — same hardening as rxgblint/rxgbverify)
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(
+                {
+                    "tool": "rxgbrace",
+                    "rules": RACE_RULES,
+                    "scenarios": scenario_reports,
+                    "counts": counts,
+                    "findings": [f.to_dict() for f in findings],
+                },
+                fh, indent=2, sort_keys=True,
+            )
+            fh.write("\n")
+    if args.sarif:
+        from tools.sarif import to_sarif_json
+
+        with open(args.sarif, "w") as fh:
+            fh.write(to_sarif_json(
+                "rxgbrace", RACE_RULES,
+                [f.to_dict() for f in findings],
+            ) + "\n")
+    status = 1 if findings else 0
+
+    try:
+        for f in findings:
+            print(f.render())
+        n_sched = sum(r["schedules"] for r in scenario_reports.values())
+        print(
+            f"rxgbrace: {len(scenario_reports)} scenarios, {n_sched} "
+            f"schedules explored, {len(findings)} finding(s)"
+        )
+    except BrokenPipeError:
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return status
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        sys.exit(1)

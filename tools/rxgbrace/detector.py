@@ -1,0 +1,352 @@
+"""FastTrack-style vector-clock + lockset race detection over event logs,
+plus the RACE003 AST pass.
+
+Ordering model (the Eraser hybrid): happens-before edges are program
+order, ``fork -> child begin``, ``child end -> join``, ``Event.set ->
+(successful) wait``, and ``Condition.notify -> (notified) wake``. Lock
+``release -> acquire`` is deliberately NOT an ordering edge — mutual
+exclusion is not ordering, and treating it as ordering hides races that
+the observed schedule happened to serialize. Correctly lock-guarded state
+is instead recognized through the recorded locksets: two conflicting
+accesses sharing a lock can never race.
+
+RACE001 — conflicting (>=1 write) cross-thread accesses to one
+``instance.attr`` that are HB-unordered AND hold disjoint locksets.
+RACE002 — a cycle in the global lock-acquisition graph (edge A->B when a
+thread acquired B while holding A), reported with witness sites: the
+deadlock certificate, independent of whether any run deadlocked.
+RACE003 — static: a ``self.<condition>.wait()`` call with no enclosing
+``while``/``for`` loop inside its function (stale-predicate wakeups),
+checked over the condition-kind attributes of the shared lock-owning-class
+catalog.
+"""
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.rxgbrace.events import Event
+
+# ---------------------------------------------------------------------------
+# findings
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RaceFinding:
+    rule: str
+    message: str
+    path: str = "tools/rxgbrace/detector.py"
+    line: int = 1
+    scenario: str = ""
+    fingerprint: str = ""
+
+    def key(self) -> Tuple:
+        return (self.rule, self.message)
+
+    def to_dict(self) -> Dict[str, object]:
+        out = {
+            "rule": self.rule, "message": self.message,
+            "path": self.path, "line": self.line,
+        }
+        if self.scenario:
+            out["scenario"] = self.scenario
+        if self.fingerprint:
+            out["fingerprint"] = self.fingerprint
+        return out
+
+    def render(self) -> str:
+        where = f" [{self.scenario}]" if self.scenario else ""
+        return f"{self.path}:{self.line}: {self.rule}{where} {self.message}"
+
+
+def _site_loc(site: str) -> Tuple[str, int]:
+    if ":" in site:
+        path, _, line = site.rpartition(":")
+        try:
+            return path, int(line)
+        except ValueError:
+            pass
+    return (site or "tools/rxgbrace/detector.py"), 1
+
+
+# ---------------------------------------------------------------------------
+# vector clocks
+# ---------------------------------------------------------------------------
+
+
+class _VC(dict):
+    """Sparse vector clock: thread label -> logical time."""
+
+    def join(self, other: Dict[str, int]) -> None:
+        for k, v in other.items():
+            if v > self.get(k, 0):
+                self[k] = v
+
+
+@dataclass
+class _Access:
+    thread: str
+    epoch: int  # vc[thread] at access time
+    write: bool
+    locks: frozenset
+    site: str
+
+
+@dataclass
+class _VarState:
+    # last access per (thread, is_write): enough for pairwise race checks
+    accesses: Dict[Tuple[str, bool], _Access] = field(default_factory=dict)
+
+
+def detect(
+    events: Sequence[Event],
+    scenario: str = "",
+    fingerprint: str = "",
+) -> List[RaceFinding]:
+    """Run the vector-clock + lockset pass over one totally-ordered log."""
+    vc: Dict[str, _VC] = {}
+    obj_vc: Dict[str, _VC] = {}
+    child_init: Dict[str, _VC] = {}
+    final_vc: Dict[str, _VC] = {}
+    variables: Dict[Tuple[str, str], _VarState] = {}
+    # lock-order graph: (held, acquired) -> witness "siteA -> siteB"
+    edges: Dict[Tuple[str, str], str] = {}
+    findings: List[RaceFinding] = []
+    seen: Set[Tuple] = set()
+
+    def clock(t: str) -> _VC:
+        c = vc.get(t)
+        if c is None:
+            c = vc[t] = _VC({t: 1})
+        return c
+
+    def inc(t: str) -> None:
+        c = clock(t)
+        c[t] = c.get(t, 0) + 1
+
+    for ev in events:
+        t = ev.thread
+        c = clock(t)
+        if ev.op == "fork":
+            snap = _VC(c)
+            child_init[ev.target] = snap
+            inc(t)
+        elif ev.op == "begin":
+            init = child_init.pop(t, None)
+            if init is not None:
+                c.join(init)
+        elif ev.op == "end":
+            final_vc[t] = _VC(c)
+        elif ev.op == "join":
+            fin = final_vc.get(ev.target)
+            if fin is not None:
+                c.join(fin)
+        elif ev.op in ("ev_set", "notify"):
+            o = obj_vc.setdefault(ev.obj, _VC())
+            o.join(c)
+            inc(t)
+        elif ev.op in ("ev_wake", "wake"):
+            if ev.variant == "notified":
+                c.join(obj_vc.setdefault(ev.obj, _VC()))
+        elif ev.op == "acquire":
+            # lock-order edges: every lock already held -> this one
+            for held in ev.locks:
+                if held != ev.obj:
+                    edges.setdefault((held, ev.obj), f"{ev.site}")
+        elif ev.op in ("read", "write"):
+            is_write = ev.op == "write"
+            var = (ev.obj, ev.attr)
+            st = variables.setdefault(var, _VarState())
+            locks = frozenset(ev.locks)
+            cur_epoch = c.get(t, 0)
+            for (other_t, other_w), prev in list(st.accesses.items()):
+                if other_t == t or not (is_write or other_w):
+                    continue
+                # HB: prev happens-before current iff prev's epoch is
+                # covered by the current thread's clock entry for it
+                if prev.epoch <= c.get(other_t, 0):
+                    continue
+                if prev.locks & locks:
+                    continue  # a common lock serializes them
+                pair = tuple(sorted((prev.site, ev.site)))
+                key = ("RACE001", var, pair)
+                if key in seen:
+                    continue
+                seen.add(key)
+                w_site = ev.site if is_write else prev.site
+                path, line = _site_loc(w_site)
+                a, b = (
+                    (prev, "write" if other_w else "read"),
+                    (_Access(t, cur_epoch, is_write, locks, ev.site),
+                     "write" if is_write else "read"),
+                )
+                findings.append(RaceFinding(
+                    rule="RACE001",
+                    path=path, line=line,
+                    scenario=scenario, fingerprint=fingerprint,
+                    message=(
+                        f"unordered {a[1]}/{b[1]} of {ev.obj}.{ev.attr}: "
+                        f"{a[0].thread} @ {a[0].site or '?'} (locks "
+                        f"{sorted(a[0].locks) or '[]'}) vs {b[0].thread} @ "
+                        f"{b[0].site or '?'} (locks {sorted(b[0].locks) or '[]'})"
+                        f" — no fork/join/event/notify edge orders them and "
+                        f"no common lock serializes them"
+                    ),
+                ))
+            st.accesses[(t, is_write)] = _Access(
+                t, cur_epoch, is_write, locks, ev.site
+            )
+
+    findings.extend(_lock_order_cycles(edges, scenario, fingerprint, seen))
+    return findings
+
+
+def _lock_order_cycles(
+    edges: Dict[Tuple[str, str], str],
+    scenario: str,
+    fingerprint: str,
+    seen: Set[Tuple],
+) -> List[RaceFinding]:
+    """Cycle detection over the acquisition graph -> RACE002."""
+    graph: Dict[str, List[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, []).append(b)
+    for vs in graph.values():
+        vs.sort()
+    findings: List[RaceFinding] = []
+    visiting: List[str] = []
+    visited: Set[str] = set()
+
+    def dfs(node: str) -> Optional[List[str]]:
+        if node in visiting:
+            return visiting[visiting.index(node):] + [node]
+        if node in visited:
+            return None
+        visiting.append(node)
+        for nxt in graph.get(node, ()):
+            cyc = dfs(nxt)
+            if cyc is not None:
+                return cyc
+        visiting.pop()
+        visited.add(node)
+        return None
+
+    for start in sorted(graph):
+        cyc = dfs(start)
+        if cyc is None:
+            continue
+        # canonical rotation for dedup
+        body = cyc[:-1]
+        k = body.index(min(body))
+        canon = tuple(body[k:] + body[:k])
+        key = ("RACE002", canon)
+        if key in seen:
+            visiting.clear()
+            continue
+        seen.add(key)
+        witness = [
+            f"{a}->{b} @ {edges.get((a, b), '?')}"
+            for a, b in zip(cyc, cyc[1:])
+        ]
+        path, line = _site_loc(edges.get((cyc[0], cyc[1]), ""))
+        findings.append(RaceFinding(
+            rule="RACE002",
+            path=path, line=line,
+            scenario=scenario, fingerprint=fingerprint,
+            message=(
+                f"lock-order inversion cycle {' -> '.join(canon + (canon[0],))}"
+                f"; witness acquisitions: {'; '.join(witness)} — two threads "
+                f"taking these locks in opposing order can deadlock"
+            ),
+        ))
+        visiting.clear()
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# RACE003: condition wait outside a predicate loop (AST, package-wide)
+# ---------------------------------------------------------------------------
+
+
+def race003_findings(root: Optional[str] = None) -> List[RaceFinding]:
+    """Every ``self.<cond>.wait(...)`` in a catalogued lock-owning class
+    must sit inside a ``while``/``for`` of its enclosing function."""
+    from tools.rxgblint import catalog
+
+    records = (
+        catalog.lock_owning_classes(root)
+        if root is not None else catalog.lock_owning_classes()
+    )
+    repo_root = root or catalog.REPO_ROOT
+    findings: List[RaceFinding] = []
+    for recd in records:
+        conds = {attr for attr, kind in recd.locks if kind == "condition"}
+        if not conds:
+            continue
+        path = os.path.join(repo_root, recd.path)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (OSError, SyntaxError):
+            continue
+        cls = _find_class(tree, recd.qualname)
+        if cls is None:
+            continue
+        findings.extend(_check_waits(cls, conds, recd))
+    return findings
+
+
+def _find_class(tree: ast.Module, qualname: str) -> Optional[ast.ClassDef]:
+    parts = qualname.split(".")
+    body = tree.body
+    node: Optional[ast.ClassDef] = None
+    for part in parts:
+        node = next(
+            (n for n in body if isinstance(n, ast.ClassDef) and n.name == part),
+            None,
+        )
+        if node is None:
+            return None
+        body = node.body
+    return node
+
+
+def _check_waits(
+    cls: ast.ClassDef, conds: Set[str], recd
+) -> List[RaceFinding]:
+    findings: List[RaceFinding] = []
+
+    def walk(node: ast.AST, loop_depth: int, fn: str) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = node.name
+            loop_depth = 0  # a loop outside the function does not re-check
+        if isinstance(node, (ast.While, ast.For)):
+            loop_depth += 1
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            callee = node.func
+            if callee.attr == "wait":
+                owner = callee.value
+                if (
+                    isinstance(owner, ast.Attribute)
+                    and isinstance(owner.value, ast.Name)
+                    and owner.value.id == "self"
+                    and owner.attr in conds
+                    and loop_depth == 0
+                ):
+                    findings.append(RaceFinding(
+                        rule="RACE003",
+                        path=recd.path, line=node.lineno,
+                        message=(
+                            f"{recd.qualname}.{fn}: self.{owner.attr}.wait() "
+                            f"outside any while/for loop — a spurious or "
+                            f"stolen wakeup proceeds on a stale predicate; "
+                            f"re-check the predicate in a loop around the wait"
+                        ),
+                    ))
+        for child in ast.iter_child_nodes(node):
+            walk(child, loop_depth, fn)
+
+    walk(cls, 0, cls.name)
+    return findings

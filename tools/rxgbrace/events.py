@@ -1,0 +1,160 @@
+"""Event records and the per-run recorder.
+
+Every instrumented operation appends one :class:`Event` to the run's
+:class:`Recorder` under a RAW (never-instrumented) lock, so the log is a
+total order (``seq``) consistent with real execution: ``acquire`` is
+recorded while the lock is already held, ``release`` while it is still
+held — two critical sections on one lock can never interleave their
+events. Under the cooperative scheduler only one scenario thread runs at
+a time, so the order is additionally deterministic.
+
+Object labels (``Lock#1``, ``ModelRegistry#1``) are assigned in
+first-sight order per recorder; with a deterministic schedule the same
+schedule always yields the same labels, which is what makes schedule
+fingerprints replay to bit-identical logs.
+"""
+
+import os
+import sys
+import _thread
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+#: repository root (tools/rxgbrace/ is two levels down)
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: frames from these basenames are skipped when attributing a call site
+_INTERNAL_FILES = frozenset({
+    "events.py", "instrument.py", "sched.py", "explore.py", "detector.py",
+    "threading.py", "contextlib.py",
+})
+
+
+@dataclass(frozen=True)
+class Event:
+    """One instrumented operation.
+
+    ``op`` is one of: ``begin end fork join join_timeout acquire release
+    wait notify wake ev_set ev_clear ev_wait ev_wake sleep read write``.
+    ``obj`` is the sync-object or instance label; ``attr`` is set for
+    read/write; ``locks`` is the thread's held lockset at the operation;
+    ``target`` names the other thread for fork/join; ``variant`` is
+    ``"notified"`` / ``"timeout"`` on wake-style events.
+    """
+
+    seq: int
+    thread: str
+    op: str
+    obj: str = ""
+    attr: str = ""
+    locks: Tuple[str, ...] = ()
+    site: str = ""
+    target: str = ""
+    variant: str = ""
+
+    def key(self) -> Tuple:
+        """Canonical tuple for log hashing / bit-identical replay checks."""
+        return (
+            self.seq, self.thread, self.op, self.obj, self.attr,
+            self.locks, self.site, self.target, self.variant,
+        )
+
+
+def call_site() -> str:
+    """Attribute the current operation to the nearest non-internal frame,
+    as a repo-relative ``path:line`` string ('' when none is found)."""
+    frame = sys._getframe(1)
+    while frame is not None:
+        fn = frame.f_code.co_filename
+        if os.path.basename(fn) not in _INTERNAL_FILES:
+            try:
+                rel = os.path.relpath(fn, REPO_ROOT)
+            except ValueError:  # different drive
+                rel = fn
+            if not rel.startswith(".."):
+                return f"{rel.replace(os.sep, '/')}:{frame.f_lineno}"
+            return f"{os.path.basename(fn)}:{frame.f_lineno}"
+        frame = frame.f_back
+    return ""
+
+
+class Recorder:
+    """Thread-safe, totally-ordered event log for one run."""
+
+    def __init__(self):
+        # raw OS lock: the recorder must never route through the
+        # instrumented wrappers it serves
+        self._lock = _thread.allocate_lock()
+        self.events: List[Event] = []
+        self._labels: Dict[int, str] = {}
+        self._counts: Dict[str, int] = {}
+
+    def label_for(self, obj: Any, kind: Optional[str] = None) -> str:
+        """Stable per-run label for ``obj`` (``Kind#n`` in first-sight
+        order)."""
+        with self._lock:
+            got = self._labels.get(id(obj))
+            if got is not None:
+                return got
+            k = kind or type(obj).__name__
+            n = self._counts.get(k, 0) + 1
+            self._counts[k] = n
+            label = f"{k}#{n}"
+            self._labels[id(obj)] = label
+            return label
+
+    def record(
+        self,
+        thread: str,
+        op: str,
+        obj: str = "",
+        attr: str = "",
+        locks: Tuple[str, ...] = (),
+        site: str = "",
+        target: str = "",
+        variant: str = "",
+    ) -> Event:
+        with self._lock:
+            ev = Event(
+                seq=len(self.events), thread=thread, op=op, obj=obj,
+                attr=attr, locks=locks, site=site, target=target,
+                variant=variant,
+            )
+            self.events.append(ev)
+            return ev
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self.events)
+
+    def snapshot(self) -> List[Event]:
+        with self._lock:
+            return list(self.events)
+
+
+@dataclass
+class ChoicePoint:
+    """One branch point of a scheduled run: the enabled transition
+    signatures (sorted, deterministic) and the index that was taken."""
+
+    sigs: Tuple[Tuple, ...]
+    chosen: int
+    event_index: int = 0  # len(recorder) when the choice was made
+
+
+@dataclass
+class RunResult:
+    """Outcome of one scheduled execution of a scenario."""
+
+    status: str  # "complete" | "deadlock" | "overflow"
+    events: List[Event] = field(default_factory=list)
+    choices: List[ChoicePoint] = field(default_factory=list)
+    errors: List[Tuple[str, str]] = field(default_factory=list)  # (thread, repr)
+    deadlocked: List[Tuple[str, str]] = field(default_factory=list)  # (thread, op desc)
+    footprints: Dict[Tuple, Tuple[str, ...]] = field(default_factory=dict)
+    invariant_error: Optional[str] = None
+    steps: int = 0
+
+    @property
+    def chosen(self) -> List[int]:
+        return [c.chosen for c in self.choices]

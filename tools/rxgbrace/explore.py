@@ -1,0 +1,242 @@
+"""Replay-based exhaustive schedule exploration with sleep-set pruning.
+
+The explorer enumerates the choice tree of a scenario: a *choice point*
+is any scheduler state with >=2 enabled transitions, and a schedule is the
+list of indices taken at the choice points. Exploration is replay-based
+stateless DFS — every tree node costs one deterministic re-execution —
+with Godefroid-style sleep sets for partial-order reduction: after
+exploring transition ``a`` at a state, sibling subtrees inherit ``a`` in
+their sleep set for as long as ``a`` stays independent of the transitions
+taken, and a sleeping transition is not re-explored.
+
+Independence is measured, not declared: the scheduler records each macro
+step's *footprint* (every sync object and ``instance.attr`` touched while
+the thread held the turn — exact, because exactly one thread runs at a
+time). Two transitions are independent iff they belong to different
+threads and their footprints are disjoint; an unmeasured footprint is
+conservatively dependent. This relies on the scenario contract (see
+``scenarios.py``): scenario threads share state only through instrumented
+objects, so disjoint footprints really do commute. ``prune=False``
+switches to plain exhaustive DFS — the equivalence of the two on planted
+bugs is pinned by tests.
+
+A failing terminal state is captured as ``scenario@i.j.k`` — the choice
+indices — which replays bit-identically (the determinism contract of the
+scheduler; also pinned by tests).
+"""
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from tools.rxgbrace.detector import RaceFinding, detect
+from tools.rxgbrace.events import RunResult
+from tools.rxgbrace.instrument import Instrumentation
+from tools.rxgbrace.sched import Scheduler
+
+
+@dataclass
+class Failure:
+    kind: str  # "invariant" | "deadlock" | "exception" | "overflow" | "explosion"
+    fingerprint: str
+    detail: str
+
+
+@dataclass
+class ExploreResult:
+    scenario: str
+    schedules: int = 0  # complete terminal schedules explored
+    runs: int = 0  # total executions (tree nodes)
+    pruned: int = 0  # sleep-set-pruned branches
+    max_choice_depth: int = 0
+    events_total: int = 0
+    truncated: bool = False
+    failures: List[Failure] = field(default_factory=list)
+    races: List[RaceFinding] = field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return not self.failures and not self.races and not self.truncated
+
+
+def fingerprint_of(scenario_name: str, chosen: Sequence[int]) -> str:
+    return f"{scenario_name}@{'.'.join(map(str, chosen))}"
+
+
+def parse_fingerprint(fp: str) -> Tuple[str, List[int]]:
+    name, _, rest = fp.partition("@")
+    if not rest:
+        return name, []
+    return name, [int(x) for x in rest.split(".")]
+
+
+def events_digest(events) -> str:
+    """Stable digest of a run's full event log (replay bit-identity)."""
+    h = hashlib.sha256()
+    for ev in events:
+        h.update(repr(ev.key()).encode())
+    return h.hexdigest()[:16]
+
+
+def run_scenario(scenario, forced: Sequence[int] = ()) -> RunResult:
+    """One deterministic execution of ``scenario`` under ``forced``.
+
+    The ambient fault plan (programmatic or ``RXGB_FAULT_PLAN``) is
+    suspended for the run: scenario code hits real ``faults.fire()`` sites
+    (registry.swap, serve.predict), so an inherited plan would both inject
+    faults into the scenario and perturb the schedule count — the reported
+    counts must depend on the shipped locking alone."""
+    import os
+
+    from tools.rxgbrace.events import Recorder
+
+    ctx = scenario.new_ctx()
+    recorder = Recorder()
+    sched = Scheduler(recorder, forced=forced, max_steps=scenario.max_steps)
+    prev_env_plan = os.environ.pop("RXGB_FAULT_PLAN", None)
+    prev_plan = None
+    try:
+        from xgboost_ray_tpu import faults as _faults
+
+        prev_plan = _faults._PLAN  # the programmatic slot, not the env view
+        _faults.install_plan(None)
+    except Exception:  # noqa: BLE001 - package import is the scenario's job
+        _faults = None
+    try:
+        # setup INSIDE the try: a raising setup must still unwind the
+        # patches it already applied (teardown restores ctx._patches) and
+        # put the suspended fault plan back
+        scenario.setup(ctx)
+        with Instrumentation(
+            recorder=recorder, controller=sched, classes=scenario.classes
+        ):
+            result = sched.run(lambda: scenario.body(ctx), main_name="main")
+    finally:
+        scenario.teardown(ctx)
+        if _faults is not None:
+            _faults.install_plan(prev_plan)
+        if prev_env_plan is not None:
+            os.environ["RXGB_FAULT_PLAN"] = prev_env_plan
+    if result.status == "complete" and not result.errors:
+        try:
+            scenario.invariant(ctx)
+        except AssertionError as exc:
+            result.invariant_error = str(exc) or "invariant failed"
+        except Exception as exc:  # noqa: BLE001 - an invariant crash is a failure
+            result.invariant_error = f"invariant raised {exc!r}"
+    return result
+
+
+def _independent(
+    a: Tuple, b: Tuple, footprints: Dict[Tuple, FrozenSet[str]]
+) -> bool:
+    if a[0] == b[0]:  # same thread: program order, never independent
+        return False
+    fa = footprints.get(a)
+    fb = footprints.get(b)
+    if fa is None or fb is None:
+        return False  # unmeasured: conservatively dependent
+    return not (fa & fb)
+
+
+def explore(
+    scenario,
+    prune: bool = True,
+    max_schedules: Optional[int] = None,
+    collect_races: bool = True,
+) -> ExploreResult:
+    """Exhaustively explore ``scenario``'s schedules."""
+    limit = max_schedules or scenario.max_schedules
+    res = ExploreResult(scenario=scenario.name)
+    footprints: Dict[Tuple, FrozenSet[str]] = {}
+    race_keys = set()
+    failure_keys = set()
+
+    def evaluate(run: RunResult) -> None:
+        res.schedules += 1
+        res.events_total += len(run.events)
+        fp = fingerprint_of(scenario.name, run.chosen)
+        if run.status == "deadlock":
+            key = ("deadlock", tuple(sorted(run.deadlocked)))
+            if key not in failure_keys:
+                failure_keys.add(key)
+                res.failures.append(Failure(
+                    "deadlock", fp,
+                    f"threads stuck: {run.deadlocked}",
+                ))
+        elif run.status == "overflow":
+            key = ("overflow",)
+            if key not in failure_keys:
+                failure_keys.add(key)
+                res.failures.append(Failure(
+                    "overflow", fp,
+                    f"run exceeded {scenario.max_steps} transitions "
+                    f"(livelock or scenario too large)",
+                ))
+        if run.errors:
+            key = ("exception", tuple(run.errors))
+            if key not in failure_keys:
+                failure_keys.add(key)
+                res.failures.append(Failure(
+                    "exception", fp, f"uncaught in threads: {run.errors}",
+                ))
+        if run.invariant_error:
+            key = ("invariant", run.invariant_error)
+            if key not in failure_keys:
+                failure_keys.add(key)
+                res.failures.append(Failure("invariant", fp, run.invariant_error))
+        if collect_races:
+            for f in detect(run.events, scenario=scenario.name, fingerprint=fp):
+                if f.key() not in race_keys:
+                    race_keys.add(f.key())
+                    res.races.append(f)
+
+    def dfs(prefix: List[int], sleep: FrozenSet[Tuple]) -> None:
+        if res.schedules >= limit:
+            res.truncated = True
+            return
+        run = run_scenario(scenario, prefix)
+        res.runs += 1
+        for sig, foot in run.footprints.items():
+            # union across runs, same reasoning as within a run: dependence
+            # must be monotone or pruning loses soundness
+            footprints[sig] = footprints.get(sig, frozenset()) | foot
+        if len(run.choices) > res.max_choice_depth:
+            res.max_choice_depth = len(run.choices)
+        if len(run.choices) <= len(prefix):
+            evaluate(run)
+            return
+        cp = run.choices[len(prefix)]
+        done: List[Tuple] = []
+        for i, sig in enumerate(cp.sigs):
+            if prune and sig in sleep:
+                res.pruned += 1
+                continue
+            child_sleep = frozenset(
+                u for u in (set(sleep) | set(done))
+                if _independent(u, sig, footprints)
+            )
+            dfs(prefix + [i], child_sleep)
+            if res.truncated:
+                return
+            done.append(sig)
+
+    dfs([], frozenset())
+    if res.truncated and not any(f.kind == "explosion" for f in res.failures):
+        res.failures.append(Failure(
+            "explosion", "",
+            f"schedule count exceeded the {limit} cap before exhaustion — "
+            f"shrink the scenario or raise max_schedules",
+        ))
+    return res
+
+
+def replay(scenario, fingerprint: str) -> RunResult:
+    """Re-run the exact schedule a fingerprint names."""
+    name, forced = parse_fingerprint(fingerprint)
+    if name != scenario.name:
+        raise ValueError(
+            f"fingerprint {fingerprint!r} names scenario {name!r}, "
+            f"not {scenario.name!r}"
+        )
+    return run_scenario(scenario, forced)

@@ -1,0 +1,618 @@
+"""Runtime instrumentation: patched threading primitives + attribute hooks.
+
+``Instrumentation`` is a context manager that, for its scope only,
+replaces ``threading.Lock/RLock/Condition/Event/Thread`` with recording
+wrappers, patches ``time.monotonic/time/perf_counter/sleep`` to a logical
+clock (only for scheduler-managed threads), and installs
+``__getattribute__``/``__setattr__`` hooks on the lock-owning classes from
+rxgblint's LOCK001 catalog (``tools.rxgblint.catalog.lock_owning_classes``
+— the instrumenter has NO class list of its own). Everything is restored
+on exit; production code that never enters the context manager pays
+nothing.
+
+Three execution modes per thread, decided per operation:
+
+* **scheduled** — the thread is managed by a cooperative
+  :class:`~tools.rxgbrace.sched.Scheduler`; sync operations route through
+  it (virtual lock/condition/event state, deterministic interleaving).
+* **record-only** — the thread is tracked (it entered the context or was
+  spawned through the patched ``Thread`` while tracking): operations
+  delegate to the real primitives and are recorded.
+* **passthrough** — unrelated threads (pytest plumbing, jax internals)
+  see the real behavior, unrecorded.
+"""
+
+import importlib
+import threading
+import time
+import _thread
+from typing import List, Optional, Tuple
+
+from tools.rxgbrace.events import Recorder, call_site
+
+# real primitives, saved before any patching can occur
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+_REAL_EVENT = threading.Event
+_REAL_THREAD = threading.Thread
+_REAL_MONOTONIC = time.monotonic
+_REAL_TIME = time.time
+_REAL_PERF = time.perf_counter
+_REAL_SLEEP = time.sleep
+
+#: the active Instrumentation (at most one; enforced on __enter__)
+_STATE: Optional["Instrumentation"] = None
+
+_tls = threading.local()
+
+
+class _Killed(BaseException):
+    """Raised inside abandoned scenario threads during scheduler cleanup;
+    BaseException so ``except Exception`` handlers in scenario code cannot
+    swallow the teardown."""
+
+
+class RawGate:
+    """Binary-semaphore turnstile on a raw ``_thread`` lock: ``set()``
+    opens it once, ``wait()`` passes and re-closes. Half the cost of an
+    ``Event`` round trip, immune to patching — the scheduler's turn
+    handoff uses nothing else."""
+
+    __slots__ = ("_lock",)
+
+    def __init__(self):
+        self._lock = _thread.allocate_lock()
+        self._lock.acquire()
+
+    def set(self) -> None:
+        try:
+            self._lock.release()
+        except RuntimeError:
+            pass  # already open (double set is idempotent)
+
+    def wait(self) -> None:
+        self._lock.acquire()
+
+    def clear(self) -> None:
+        pass  # wait() consumes the open state
+
+
+def raw_event():
+    """A REAL ``threading.Event`` immune to the patched factories.
+
+    ``Event.__init__`` calls ``Condition(Lock())`` through the threading
+    module's (patched) globals, so a plain ``_REAL_EVENT()`` created inside
+    the patch window would secretly wrap our own wrappers — the scheduler's
+    gates and ``Thread``'s internal ``_started`` event must never route
+    through the instrumentation they serve. Built piecewise from raw parts
+    (``Condition.wait`` itself only uses ``_thread.allocate_lock``, which
+    is never patched)."""
+    ev = _REAL_EVENT.__new__(_REAL_EVENT)
+    cond = _REAL_CONDITION.__new__(_REAL_CONDITION)
+    _REAL_CONDITION.__init__(cond, _thread.allocate_lock())
+    ev._cond = cond
+    ev._flag = False
+    return ev
+
+
+# -- per-thread bookkeeping --------------------------------------------------
+
+
+def _tracked() -> bool:
+    return getattr(_tls, "tracked", False)
+
+
+def _held() -> List[str]:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _held_add(label: str) -> None:
+    _held().append(label)
+
+
+def _held_remove(label: str) -> None:
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == label:
+            del held[i]
+            return
+
+
+def _lockset() -> Tuple[str, ...]:
+    return tuple(sorted(set(_held())))
+
+
+def _thread_label() -> str:
+    m = getattr(_tls, "managed", None)
+    if m is not None:
+        return m.label
+    label = getattr(_tls, "label", None)
+    if label is not None:
+        return label
+    return threading.current_thread().name
+
+
+def _ctl():
+    """The scheduler controlling the CURRENT thread (None otherwise)."""
+    st = _STATE
+    if st is None or st.controller is None:
+        return None
+    if getattr(_tls, "managed", None) is not None:
+        return st.controller
+    return None
+
+
+def _rec() -> Optional[Recorder]:
+    st = _STATE
+    if st is None or not _tracked():
+        return None
+    return st.recorder
+
+
+def _record(op: str, obj, kind: str, **kw) -> None:
+    rec = _rec()
+    if rec is None:
+        return
+    rec.record(
+        _thread_label(), op, obj=rec.label_for(obj, kind),
+        locks=_lockset(), site=call_site(), **kw,
+    )
+
+
+# -- wrapper primitives ------------------------------------------------------
+
+
+class TLock:
+    """Wrapper for ``threading.Lock``."""
+
+    _kind = "Lock"
+
+    def __init__(self):
+        self._real = _REAL_LOCK()
+        # virtual state (scheduled mode only)
+        self._v_owner = None
+
+    def _label(self) -> str:
+        rec = _STATE.recorder if _STATE else None
+        return rec.label_for(self, self._kind) if rec else self._kind
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ctl = _ctl()
+        if ctl is not None:
+            return ctl.lock_acquire(self, blocking=blocking)
+        if _tracked():
+            ok = self._real.acquire(blocking, timeout)
+            if ok:
+                _record("acquire", self, self._kind)
+                _held_add(self._label())
+            return ok
+        return self._real.acquire(blocking, timeout)
+
+    def release(self):
+        ctl = _ctl()
+        if ctl is not None:
+            return ctl.lock_release(self)
+        if _tracked():
+            _record("release", self, self._kind)
+            _held_remove(self._label())
+        return self._real.release()
+
+    def locked(self) -> bool:
+        ctl = _ctl()
+        if ctl is not None:
+            return self._v_owner is not None
+        return self._real.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class TRLock(TLock):
+    """Wrapper for ``threading.RLock`` (reentrant)."""
+
+    _kind = "RLock"
+
+    def __init__(self):
+        self._real = _REAL_RLOCK()
+        self._v_owner = None
+        self._v_count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ctl = _ctl()
+        if ctl is not None:
+            return ctl.lock_acquire(self, blocking=blocking, reentrant=True)
+        if _tracked():
+            ok = self._real.acquire(blocking, timeout)
+            if ok:
+                _record("acquire", self, self._kind)
+                _held_add(self._label())
+            return ok
+        return self._real.acquire(blocking, timeout)
+
+    def release(self):
+        ctl = _ctl()
+        if ctl is not None:
+            return ctl.lock_release(self, reentrant=True)
+        if _tracked():
+            _record("release", self, self._kind)
+            _held_remove(self._label())
+        return self._real.release()
+
+
+class TCondition:
+    """Wrapper for ``threading.Condition`` over a (wrapped) lock."""
+
+    _kind = "Condition"
+
+    def __init__(self, lock=None):
+        if lock is None:
+            # stdlib parity: a bare threading.Condition() defaults to an
+            # RLock, and re-entrant acquire patterns must not become
+            # spurious scheduler deadlocks
+            lock = TRLock()
+        self._lock = lock
+        # real condition over the real underlying lock (record-only mode)
+        self._real = _REAL_CONDITION(getattr(lock, "_real", lock))
+        self._v_waiters: List = []  # scheduled mode: waiter queue
+
+    def _label(self) -> str:
+        rec = _STATE.recorder if _STATE else None
+        return rec.label_for(self, self._kind) if rec else self._kind
+
+    def __enter__(self):
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._lock.release()
+        return False
+
+    def acquire(self, *a, **kw):
+        return self._lock.acquire(*a, **kw)
+
+    def release(self):
+        return self._lock.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        ctl = _ctl()
+        if ctl is not None:
+            return ctl.cond_wait(self, timeout)
+        if _tracked():
+            _record("wait", self, self._kind)
+            lock_label = (
+                self._lock._label() if hasattr(self._lock, "_label") else ""
+            )
+            _held_remove(lock_label)
+            res = self._real.wait(timeout)
+            _held_add(lock_label)
+            _record(
+                "wake", self, self._kind,
+                variant="notified" if res else "timeout",
+            )
+            return res
+        return self._real.wait(timeout)
+
+    def notify(self, n: int = 1) -> None:
+        ctl = _ctl()
+        if ctl is not None:
+            return ctl.cond_notify(self, n)
+        if _tracked():
+            _record("notify", self, self._kind)
+        return self._real.notify(n)
+
+    def notify_all(self) -> None:
+        return self.notify(1 << 30)
+
+
+class TEvent:
+    """Wrapper for ``threading.Event``."""
+
+    _kind = "Event"
+
+    def __init__(self):
+        self._real = _REAL_EVENT()
+        self._v_set = False
+
+    def is_set(self) -> bool:
+        ctl = _ctl()
+        if ctl is not None:
+            return self._v_set
+        return self._real.is_set()
+
+    def set(self) -> None:
+        ctl = _ctl()
+        if ctl is not None:
+            return ctl.ev_set(self)
+        if _tracked():
+            _record("ev_set", self, self._kind)
+        return self._real.set()
+
+    def clear(self) -> None:
+        ctl = _ctl()
+        if ctl is not None:
+            self._v_set = False
+            return None
+        if _tracked():
+            _record("ev_clear", self, self._kind)
+        return self._real.clear()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        ctl = _ctl()
+        if ctl is not None:
+            return ctl.ev_wait(self, timeout)
+        if _tracked():
+            _record("ev_wait", self, self._kind)
+            res = self._real.wait(timeout)
+            _record(
+                "ev_wake", self, self._kind,
+                variant="notified" if res else "timeout",
+            )
+            return res
+        return self._real.wait(timeout)
+
+
+class TThread(_REAL_THREAD):
+    """Patched ``threading.Thread``: threads started while tracking are
+    recorded (fork/begin/end/join); threads started from a scheduler-managed
+    thread become managed themselves."""
+
+    def __init__(self, *args, **kwargs):
+        _REAL_THREAD.__init__(self, *args, **kwargs)
+        # Thread.__init__ created its _started event through the patched
+        # factories; swap in a raw one so the interpreter's own start/join
+        # handshake never routes through the instrumentation
+        self._started = raw_event()
+
+    def start(self):
+        st = _STATE
+        ctl = _ctl()
+        if ctl is not None:
+            return ctl.thread_spawn(self)
+        if st is not None and _tracked():
+            rec = st.recorder
+            rec.record(
+                _thread_label(), "fork",
+                target=rec.label_for(self, self.name),
+                locks=_lockset(), site=call_site(),
+            )
+            self._rxgb_track = True
+        return _REAL_THREAD.start(self)
+
+    def run(self):
+        m = getattr(self, "_rxgb_managed", None)
+        if m is not None:
+            sched = m.scheduler
+            _tls.managed = m
+            _tls.tracked = True
+            _tls.held = []
+            try:
+                # begin() can itself raise _Killed (cleanup of a thread that
+                # never got a turn) — it must stay inside the handler
+                sched.thread_begin(m)
+                _REAL_THREAD.run(self)
+            except _Killed:
+                pass
+            except BaseException as exc:  # noqa: BLE001 - surfaced in RunResult
+                m.error = exc
+            finally:
+                sched.thread_end(m)
+            return
+        if getattr(self, "_rxgb_track", False) and _STATE is not None:
+            st = _STATE
+            _tls.tracked = True
+            _tls.held = []
+            # the thread's event label must MATCH the fork record's target
+            # label, or the detector loses the fork/join ordering edges
+            _tls.label = st.recorder.label_for(self, self.name)
+            st.recorder.record(_tls.label, "begin")
+            try:
+                _REAL_THREAD.run(self)
+            finally:
+                st.recorder.record(_tls.label, "end")
+            return
+        return _REAL_THREAD.run(self)
+
+    def join(self, timeout: Optional[float] = None):
+        ctl = _ctl()
+        if ctl is not None:
+            return ctl.thread_join(self, timeout)
+        st = _STATE
+        res = _REAL_THREAD.join(self, timeout)
+        if st is not None and _tracked() and getattr(self, "_rxgb_track", False):
+            rec = st.recorder
+            op = "join_timeout" if self.is_alive() else "join"
+            rec.record(
+                _thread_label(), op, target=rec.label_for(self, self.name),
+                locks=_lockset(), site=call_site(),
+            )
+        return res
+
+
+# -- logical clock (scheduled threads only) ----------------------------------
+
+
+def _fake_monotonic() -> float:
+    ctl = _ctl()
+    return ctl.now() if ctl is not None else _REAL_MONOTONIC()
+
+
+def _fake_time() -> float:
+    ctl = _ctl()
+    return (1_700_000_000.0 + ctl.now()) if ctl is not None else _REAL_TIME()
+
+
+def _fake_perf_counter() -> float:
+    ctl = _ctl()
+    return ctl.now() if ctl is not None else _REAL_PERF()
+
+
+def _fake_sleep(secs: float) -> None:
+    ctl = _ctl()
+    if ctl is not None:
+        return ctl.sleep(secs)
+    return _REAL_SLEEP(secs)
+
+
+# -- attribute hooks ---------------------------------------------------------
+
+
+def _note_access(instance, cls, name: str, kind: str) -> None:
+    st = _STATE
+    if st is None or not _tracked():
+        return
+    if getattr(_tls, "in_note", False):
+        return
+    _tls.in_note = True
+    try:
+        rec = st.recorder
+        rec.record(
+            _thread_label(), kind,
+            obj=rec.label_for(instance, cls.__name__), attr=name,
+            locks=_lockset(), site=call_site(),
+        )
+    finally:
+        _tls.in_note = False
+
+
+def _install_attr_hooks(cls, watched: frozenset):
+    """Install read/write hooks for ``watched`` attribute names on ``cls``;
+    returns the restore closure."""
+    had_get = "__getattribute__" in cls.__dict__
+    had_set = "__setattr__" in cls.__dict__
+    orig_get = cls.__getattribute__
+    orig_set = cls.__setattr__
+    saved_get = cls.__dict__.get("__getattribute__")
+    saved_set = cls.__dict__.get("__setattr__")
+
+    def hooked_get(self, name, _w=watched, _c=cls, _o=orig_get):
+        if name in _w:
+            _note_access(self, _c, name, "read")
+        return _o(self, name)
+
+    def hooked_set(self, name, value, _w=watched, _c=cls, _o=orig_set):
+        if name in _w:
+            _note_access(self, _c, name, "write")
+        return _o(self, name, value)
+
+    cls.__getattribute__ = hooked_get
+    cls.__setattr__ = hooked_set
+
+    def restore():
+        if had_get:
+            cls.__getattribute__ = saved_get
+        else:
+            del cls.__getattribute__
+        if had_set:
+            cls.__setattr__ = saved_set
+        else:
+            del cls.__setattr__
+
+    return restore
+
+
+def resolve_catalog_classes(root: Optional[str] = None):
+    """Resolve rxgblint's lock-owning-class catalog to runtime
+    ``(cls, watched_attrs)`` pairs — the instrumenter's class list IS the
+    linter's. Returns (pairs, errors)."""
+    from tools.rxgblint import catalog
+
+    pairs: List[Tuple[type, frozenset]] = []
+    errors: List[str] = []
+    records = (
+        catalog.lock_owning_classes(root)
+        if root is not None else catalog.lock_owning_classes()
+    )
+    for recd in records:
+        try:
+            mod = importlib.import_module(recd.module)
+            obj = mod
+            for part in recd.qualname.split("."):
+                obj = getattr(obj, part)
+            pairs.append((obj, frozenset(recd.shared)))
+        except Exception as exc:  # noqa: BLE001 - surfaced to the CLI
+            errors.append(f"{recd.module}.{recd.qualname}: {exc!r}")
+    return pairs, errors
+
+
+# -- the context manager -----------------------------------------------------
+
+
+class Instrumentation:
+    """Install the wrappers + hooks for a scope.
+
+    ``classes`` — "catalog" (default) hooks every lock-owning class from
+    rxgblint's catalog; an explicit iterable of ``(cls, attrs)`` pairs
+    hooks exactly those; ``None`` hooks nothing. ``controller`` is a
+    :class:`~tools.rxgbrace.sched.Scheduler` for deterministic runs (or
+    None for record-only mode).
+    """
+
+    def __init__(
+        self,
+        recorder: Optional[Recorder] = None,
+        controller=None,
+        classes="catalog",
+        root: Optional[str] = None,
+    ):
+        self.recorder = recorder if recorder is not None else Recorder()
+        self.controller = controller
+        self._classes_arg = classes
+        self._root = root
+        self._restores: List = []
+        self.hooked: List[Tuple[type, frozenset]] = []
+        self.hook_errors: List[str] = []
+
+    def __enter__(self) -> "Instrumentation":
+        global _STATE
+        if _STATE is not None:
+            raise RuntimeError("rxgbrace instrumentation is not reentrant")
+        # patch the threading factories
+        patches = [
+            (threading, "Lock", TLock),
+            (threading, "RLock", TRLock),
+            (threading, "Condition", TCondition),
+            (threading, "Event", TEvent),
+            (threading, "Thread", TThread),
+            (time, "monotonic", _fake_monotonic),
+            (time, "time", _fake_time),
+            (time, "perf_counter", _fake_perf_counter),
+            (time, "sleep", _fake_sleep),
+        ]
+        for mod, name, repl in patches:
+            orig = getattr(mod, name)
+            setattr(mod, name, repl)
+            self._restores.append(lambda m=mod, n=name, o=orig: setattr(m, n, o))
+        # attribute hooks
+        if self._classes_arg == "catalog":
+            pairs, self.hook_errors = resolve_catalog_classes(self._root)
+        elif self._classes_arg is None:
+            pairs = []
+        else:
+            pairs = [(c, frozenset(a)) for c, a in self._classes_arg]
+        for cls, watched in pairs:
+            if watched:
+                self._restores.append(_install_attr_hooks(cls, watched))
+            self.hooked.append((cls, watched))
+        _STATE = self
+        self._prev_tracked = getattr(_tls, "tracked", False)
+        _tls.tracked = True
+        _tls.held = []
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        global _STATE
+        _tls.tracked = self._prev_tracked
+        for restore in reversed(self._restores):
+            restore()
+        self._restores = []
+        _STATE = None
+        return False

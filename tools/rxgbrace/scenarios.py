@@ -1,0 +1,547 @@
+"""Shipped-code scenario units for the schedule explorer.
+
+Each scenario drives REAL shipped classes (the registry's lock dance, the
+batcher's deadline flush, the tracer's ring buffer, ...) under the
+cooperative scheduler, declares an invariant checked at every terminal
+state, and is sized so exhaustive exploration stays in the
+hundreds-to-thousands of schedules. Heavy leaves (XLA predictor compiles,
+checkpoint serialization) are stubbed via module patches — the
+concurrency logic under test lives in the shipped classes, not the
+stubs.
+
+Scenario contract (what makes sleep-set pruning and replay sound here):
+
+* scenario threads share state ONLY through instrumented objects (the
+  shipped classes + wrapped sync primitives); per-thread results go into
+  ctx fields written by a single thread each;
+* no real time, randomness, or OS identifiers — the scheduler's logical
+  clock and seeded RNGs only;
+* every non-daemon thread the body spawns is joined by the body.
+"""
+
+import contextlib
+import os
+import threading
+import time
+from types import SimpleNamespace
+from typing import Callable, Optional, Tuple
+
+
+class Scenario:
+    """One explorable scenario unit."""
+
+    def __init__(
+        self,
+        name: str,
+        description: str,
+        body: Callable,
+        invariant: Callable,
+        setup: Optional[Callable] = None,
+        teardown: Optional[Callable] = None,
+        classes="catalog",
+        max_steps: int = 4000,
+        max_schedules: int = 20000,
+    ):
+        self.name = name
+        self.description = description
+        self.body = body
+        self.invariant = invariant
+        self._setup = setup
+        self._teardown = teardown
+        self.classes = classes
+        self.max_steps = max_steps
+        self.max_schedules = max_schedules
+
+    def new_ctx(self) -> SimpleNamespace:
+        return SimpleNamespace(_patches=[], _env=[])
+
+    def setup(self, ctx) -> None:
+        if self._setup is not None:
+            self._setup(ctx)
+
+    def teardown(self, ctx) -> None:
+        try:
+            if self._teardown is not None:
+                self._teardown(ctx)
+        finally:
+            for obj, attr, orig in reversed(ctx._patches):
+                setattr(obj, attr, orig)
+            for key, orig in reversed(ctx._env):
+                if orig is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = orig
+
+
+def _patch(ctx, obj, attr: str, value) -> None:
+    ctx._patches.append((obj, attr, getattr(obj, attr)))
+    setattr(obj, attr, value)
+
+
+def _setenv(ctx, key: str, value: Optional[str]) -> None:
+    ctx._env.append((key, os.environ.get(key)))
+    if value is None:
+        os.environ.pop(key, None)
+    else:
+        os.environ[key] = value
+
+
+# ---------------------------------------------------------------------------
+# 1. registry: load (hot-swap) vs load vs lease
+# ---------------------------------------------------------------------------
+
+
+class _StubBooster:
+    num_features = 3
+
+    def __init__(self, tag: int):
+        self.tag = tag
+
+
+class _StubPredictor:
+    """Stands in for CompiledPredictor: no XLA, but carries its booster's
+    tag so a half-swapped (booster from v2, predictor from v1) entry is
+    detectable."""
+
+    def __init__(self, booster, devices=None, min_bucket=8):
+        self.booster = booster
+        self.tag = booster.tag
+
+    def warmup(self, kinds=(), max_batch=0):
+        pass
+
+    def predict_with_bucket(self, x, kind):
+        import numpy as np
+
+        return np.full((x.shape[0],), float(self.tag), np.float32), int(x.shape[0])
+
+
+def _registry_setup(ctx):
+    from xgboost_ray_tpu.serve import registry as regmod
+
+    _patch(ctx, regmod, "CompiledPredictor", _StubPredictor)
+    _patch(ctx, regmod, "coerce_model", lambda m: m)
+
+
+def _registry_body(ctx):
+    from xgboost_ray_tpu.serve.registry import ModelRegistry
+
+    reg = ctx.reg = ModelRegistry(warm_kinds=())
+    reg.load(_StubBooster(1), warm=False)  # v1 committed before concurrency
+    ctx.reads = []
+
+    def loader():
+        reg.load(_StubBooster(2), warm=False)
+
+    def reader():
+        seen = []
+        for _ in range(2):
+            with reg.lease() as entry:
+                seen.append(
+                    (entry.version, entry.booster.tag, entry.predictor.tag)
+                )
+        ctx.reads = seen
+
+    t1 = threading.Thread(target=loader, name="loader")
+    t2 = threading.Thread(target=reader, name="reader")
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+
+
+def _registry_invariant(ctx):
+    reg = ctx.reg
+    assert reg._version == 2, f"committed version {reg._version} != 2"
+    assert reg._current is not None and reg._current.version == 2
+    assert reg._inflight == 0 and not reg._swapping
+    last = 0
+    for version, booster_tag, predictor_tag in ctx.reads:
+        # never half-swapped: the leased entry is wholly one model version
+        assert version == booster_tag == predictor_tag, (
+            f"half-swapped lease: v{version} booster{booster_tag} "
+            f"predictor{predictor_tag}"
+        )
+        assert version >= last, "reader saw versions go backwards"
+        last = version
+
+
+# ---------------------------------------------------------------------------
+# 2. batcher: deadline flush vs shutdown vs shed
+# ---------------------------------------------------------------------------
+
+
+class _StubRegistry:
+    """Lock-free registry stand-in: the scenario targets the BATCHER's
+    condition dance, so the lease is a plain snapshot."""
+
+    def __init__(self):
+        self.entry = SimpleNamespace(
+            version=1,
+            booster=_StubBooster(1),
+            predictor=_StubPredictor(_StubBooster(1)),
+        )
+
+    @contextlib.contextmanager
+    def lease(self):
+        yield self.entry
+
+
+def _batcher_body(ctx):
+    import numpy as np
+
+    from xgboost_ray_tpu.serve.batcher import MicroBatcher
+
+    b = ctx.batcher = MicroBatcher(
+        _StubRegistry(), max_batch=4, max_delay_ms=2.0, max_queue_rows=1,
+    )
+
+    def client(tag: str):
+        x = np.zeros((1, 3), np.float32)
+        try:
+            out, version = b.submit(x, "value", timeout=None)
+            setattr(ctx, tag, ("ok", int(out.shape[0]), version))
+        except BaseException as exc:  # noqa: BLE001 - outcome recorded
+            setattr(ctx, tag, ("err", type(exc).__name__))
+
+    ts = [
+        threading.Thread(target=client, args=("a",), name="client-a"),
+        threading.Thread(target=client, args=("b",), name="client-b"),
+    ]
+    for t in ts:
+        t.start()
+    # main IS the stopper: shutdown races the in-flight submissions and the
+    # flusher's deadline wakeup. timeout=None = unbounded flusher join,
+    # which keeps the schedule space exhaustively explorable in CI time
+    # (the bounded-join arm only adds an abandoned-daemon tail)
+    b.shutdown(timeout=None)
+    for t in ts:
+        t.join()
+
+
+def _batcher_invariant(ctx):
+    b = ctx.batcher
+    allowed_errors = {"OverloadedError", "ShuttingDownError"}
+    for tag in ("a", "b"):
+        out = getattr(ctx, tag, None)
+        assert out is not None, f"client {tag} never completed (lost request)"
+        if out[0] == "ok":
+            assert out[1] == 1 and out[2] == 1, f"client {tag} torn: {out}"
+        else:
+            assert out[1] in allowed_errors, (
+                f"client {tag} got unexpected error {out[1]}"
+            )
+    assert b._depth == 0, f"queue depth {b._depth} leaked"
+    assert b._queued_rows == 0, f"queued rows {b._queued_rows} leaked"
+    # _executing may read 1 when shutdown's bounded join timed out and the
+    # daemon flusher was abandoned mid-batch (real interpreter exit does the
+    # same); it must never go negative or exceed the single flusher
+    assert b._executing in (0, 1), f"executing tore: {b._executing}"
+    assert b._closed, "shutdown did not latch closed"
+
+
+# ---------------------------------------------------------------------------
+# 3. AsyncCheckpointWriter: background commit vs driver exit / restart
+# ---------------------------------------------------------------------------
+
+
+class _RestartSim(RuntimeError):
+    """Stands in for the elastic-restart exception unwinding the driver."""
+
+
+def _ckpt_setup(ctx):
+    from xgboost_ray_tpu import launcher
+
+    ctx.commits = []
+
+    def stub_save(booster, path, completed_round, keep_last=None, fsync=True):
+        # the sleep is a scheduler yield point: the commit genuinely
+        # OVERLAPS the driver's continuing round work, which is the design
+        # claim under test
+        time.sleep(0.001)
+        ctx.commits.append(int(completed_round))
+
+    _patch(ctx, launcher, "save_round_checkpoint", stub_save)
+    # the scenario pins in-order commit semantics; the bounded exit join is
+    # separately covered by tests/test_faults.py under a forced-slow fault
+    _setenv(ctx, "RXGB_CKPT_EXIT_JOIN_S", "0")
+
+
+def _ckpt_body(ctx):
+    from xgboost_ray_tpu.launcher import AsyncCheckpointWriter
+
+    ctx.restarted = False
+    round_lock = threading.Lock()
+    ctx.rounds_done = 0
+    try:
+        with AsyncCheckpointWriter() as w:
+            w.submit(object(), "/tmp/rxgbrace-ckpt.json", 1)
+            # the round loop keeps boosting while the commit runs behind it
+            for _ in range(2):
+                with round_lock:
+                    ctx.rounds_done += 1
+            w.submit(object(), "/tmp/rxgbrace-ckpt.json", 2)
+            raise _RestartSim("simulated elastic restart at a round boundary")
+    except _RestartSim:
+        ctx.restarted = True
+
+
+def _ckpt_invariant(ctx):
+    assert ctx.restarted, "restart exception was swallowed"
+    assert ctx.rounds_done == 2, f"round loop stalled: {ctx.rounds_done}"
+    assert ctx.commits == [1, 2], (
+        f"commits {ctx.commits} != [1, 2]: out-of-order or dropped write"
+    )
+
+
+# ---------------------------------------------------------------------------
+# 4. tracer: emit vs export vs snapshot
+# ---------------------------------------------------------------------------
+
+
+def _tracer_body(ctx):
+    from xgboost_ray_tpu.obs.trace import Tracer
+
+    tr = ctx.tracer = Tracer(capacity=2, enabled=True, trace_dir="", rank=0)
+
+    def emitter_spans():
+        with tr.span("round", round=0):
+            tr.event("fault.injected", site="serve.predict")
+
+    def emitter_events():
+        tr.event("checkpoint.commit", round=1)
+
+    def reader():
+        ctx.mid_snapshot = tr.snapshot()
+        ctx.mid_dropped = tr.dropped
+
+    ts = [
+        threading.Thread(target=emitter_spans, name="emit-span"),
+        threading.Thread(target=emitter_events, name="emit-event"),
+        threading.Thread(target=reader, name="reader"),
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+
+def _tracer_invariant(ctx):
+    from xgboost_ray_tpu.obs.trace import validate_trace_records
+
+    tr = ctx.tracer
+    recs = tr.records()
+    snap = tr.snapshot()
+    # 3 records were emitted into a 2-slot ring: accounting must be exact
+    assert len(recs) == 2, f"ring holds {len(recs)} != capacity 2"
+    assert tr.dropped == 1, f"dropped {tr.dropped} != 1"
+    assert snap["records"] + snap["dropped_spans"] == 3, f"torn: {snap}"
+    seqs = [r["seq"] for r in recs]
+    assert len(set(seqs)) == len(seqs), f"duplicate seq in {seqs}"
+    assert validate_trace_records(recs) == []
+    # the concurrent mid-run snapshot was itself a consistent cut
+    mid = ctx.mid_snapshot
+    assert 0 <= mid["dropped_spans"] <= 1 and mid["records"] <= 2, mid
+    assert 0 <= ctx.mid_dropped <= 1
+
+
+# ---------------------------------------------------------------------------
+# 5. faults: fire vs reset
+# ---------------------------------------------------------------------------
+
+
+def _faults_body(ctx):
+    from xgboost_ray_tpu.faults import FaultPlan
+
+    plan = ctx.plan = FaultPlan(
+        rules=[{"site": "serve.predict", "action": "raise", "at": 99}],
+        seed=3,
+    )
+
+    def firer():
+        plan.fire("serve.predict", rows=1)
+        plan.fire("serve.predict", rows=2)
+
+    def resetter():
+        plan.reset()
+
+    t1 = threading.Thread(target=firer, name="firer")
+    t2 = threading.Thread(target=resetter, name="resetter")
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+
+
+def _faults_invariant(ctx):
+    plan = ctx.plan
+    assert len(plan._seen) == len(plan.rules) == 1
+    assert 0 <= plan._seen[0] <= 2, f"torn counter {plan._seen}"
+    assert len(plan._rngs) == 1
+
+
+# ---------------------------------------------------------------------------
+# 6. metrics: record vs snapshot / Prometheus render
+# ---------------------------------------------------------------------------
+
+
+def _metrics_body(ctx):
+    from xgboost_ray_tpu.serve.metrics import ServeMetrics
+
+    m = ctx.metrics = ServeMetrics()
+    ctx.snaps = []
+
+    def worker():
+        m.observe_request(0.0015, 1)
+
+    def renderer():
+        ctx.snaps.append(m.snapshot())
+        ctx.prom = m.prometheus_text()
+
+    t1 = threading.Thread(target=worker, name="worker")
+    t2 = threading.Thread(target=renderer, name="renderer")
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+
+
+def _metrics_invariant(ctx):
+    m = ctx.metrics
+    assert m.requests == 1 and m.rows == 1, (m.requests, m.rows)
+    hist = m._hist.snapshot()
+    assert hist["total"] == 1 and sum(hist["counts"]) == 1, hist["total"]
+    for snap in ctx.snaps:
+        # observe_request incs requests+rows under one lock; any snapshot
+        # cut must see them together (n_rows == 1 per request)
+        assert snap["rows"] == snap["requests"], f"torn snapshot: {snap}"
+    assert "rxgb_serve_requests_total" in ctx.prom
+    assert ctx.prom.endswith("\n")
+
+
+# ---------------------------------------------------------------------------
+# 7. elastic: background pending-load vs driver poll (the PR's fixed race)
+# ---------------------------------------------------------------------------
+
+
+def _elastic_setup(ctx):
+    _setenv(ctx, "RXGB_ELASTIC_RESTART_GRACE_PERIOD_S", "0")
+    _setenv(ctx, "RXGB_TRACE_DIR", None)
+
+
+def _elastic_teardown(ctx):
+    from xgboost_ray_tpu import obs
+
+    obs.set_default_tracer(None)
+
+
+def _elastic_body(ctx):
+    from xgboost_ray_tpu import obs
+    from xgboost_ray_tpu.elastic import (
+        PendingActor,
+        _update_scheduled_actor_states,
+    )
+
+    # fresh tracer created INSIDE the scenario so its lock is instrumented
+    obs.set_default_tracer(
+        obs.Tracer(capacity=64, enabled=True, trace_dir="", rank=0)
+    )
+    pending = ctx.pending = PendingActor(actor=object(), created_at=time.time())
+    state = SimpleNamespace(
+        pending_actors={0: pending}, restart_training_at=None,
+    )
+
+    def loader():
+        # the tail of elastic's background _load closure on the slow path
+        pending.mark_ready()
+
+    def driver():
+        outs = []
+        for _ in range(3):
+            outs.append(
+                _update_scheduled_actor_states(state, raise_on_ready=False)
+            )
+        ctx.outs = outs
+
+    t1 = threading.Thread(target=loader, name="elastic-load-rank-0")
+    t2 = threading.Thread(target=driver, name="driver")
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+
+
+def _elastic_invariant(ctx):
+    pending = ctx.pending
+    assert pending.ready, "load completed but driver-visible ready is False"
+    assert pending.error is None
+    assert not (pending.ready and pending.error is not None), "torn state"
+    grows = [o for o in ctx.outs if o]
+    assert len(grows) <= 1, f"double reintegration signal: {ctx.outs}"
+
+
+# ---------------------------------------------------------------------------
+# the suite
+# ---------------------------------------------------------------------------
+
+
+SCENARIOS: Tuple[Scenario, ...] = (
+    Scenario(
+        name="registry_hot_swap",
+        description="ModelRegistry.load (drain-then-flip) vs concurrent "
+                    "lease: no lease ever observes a half-swapped entry",
+        body=_registry_body, invariant=_registry_invariant,
+        setup=_registry_setup,
+    ),
+    Scenario(
+        name="batcher_flush_shutdown_shed",
+        description="MicroBatcher deadline flush vs shutdown vs queue-cap "
+                    "shed: every request resolves exactly once, accounting "
+                    "returns to zero",
+        body=_batcher_body, invariant=_batcher_invariant,
+        max_steps=6000,
+    ),
+    Scenario(
+        name="ckpt_writer_commit_vs_restart",
+        description="AsyncCheckpointWriter background commits vs a "
+                    "simulated elastic restart unwinding the driver: "
+                    "commits stay in round order, none dropped",
+        body=_ckpt_body, invariant=_ckpt_invariant, setup=_ckpt_setup,
+    ),
+    Scenario(
+        name="tracer_emit_vs_snapshot",
+        description="Tracer ring-buffer emit vs snapshot/records: drop "
+                    "accounting exact, seq unique, snapshots are "
+                    "consistent cuts",
+        body=_tracer_body, invariant=_tracer_invariant,
+    ),
+    Scenario(
+        name="faultplan_fire_vs_reset",
+        description="FaultPlan.fire counter advance vs reset rewind: "
+                    "counters never tear against the rule list",
+        body=_faults_body, invariant=_faults_invariant,
+    ),
+    Scenario(
+        name="metrics_record_vs_render",
+        description="ServeMetrics observe vs snapshot + Prometheus render: "
+                    "multi-counter cuts are atomic",
+        body=_metrics_body, invariant=_metrics_invariant,
+    ),
+    Scenario(
+        name="elastic_pending_load_vs_poll",
+        description="elastic PendingActor background load vs driver "
+                    "reintegration poll (the slow-load path): ready/error "
+                    "never tear (regression pin for the PendingActor lock)",
+        body=_elastic_body, invariant=_elastic_invariant,
+        setup=_elastic_setup, teardown=_elastic_teardown,
+    ),
+)
+
+
+def by_name(name: str) -> Scenario:
+    for scn in SCENARIOS:
+        if scn.name == name:
+            return scn
+    raise KeyError(
+        f"unknown scenario {name!r}; one of {[s.name for s in SCENARIOS]}"
+    )

@@ -1,0 +1,484 @@
+"""Cooperative deterministic scheduler for scenario exploration.
+
+Exactly one scenario thread runs at any moment. Scenario threads park on a
+per-thread gate at every instrumented *blocking-capable* sync point
+(lock/condition acquire, waits, joins, sleeps); fast operations (release,
+notify, event set, attribute access) execute inline while the thread holds
+the turn, so a context switch can occur exactly at the instrumented sync
+points — the classic schedule-at-synchronization granularity.
+
+Time is logical: ``time.monotonic()/time()/perf_counter()`` (patched by
+the instrumentation layer) read the scheduler clock, which advances a
+microtick per transition and by the full timeout when the scheduler
+*chooses* to fire a timed wait. A timed wait is therefore a scheduling
+CHOICE with two transitions — "woken by its signal" and "timed out" —
+which is what lets the explorer drive deadline-flush-vs-shutdown style
+interleavings deterministically.
+
+Determinism contract: a scenario run under the same forced choice list
+produces the identical event log (labels, seqs, sites) — scenario code
+must not consult real time, real randomness, or OS identifiers; the
+instrumented clock and seeded RNGs keep the shipped scenarios inside
+that contract.
+"""
+
+from typing import List, Optional, Tuple
+
+from tools.rxgbrace import instrument as ins
+from tools.rxgbrace.events import ChoicePoint, Recorder, RunResult, call_site
+
+_EPS = 1e-6  # clock microtick per transition
+
+
+class Managed:
+    """Scheduler-side state of one scenario thread."""
+
+    __slots__ = (
+        "label", "thread", "gate", "state", "pending", "op_result",
+        "killed", "error", "scheduler", "idx",
+    )
+
+    def __init__(self, scheduler, thread, label: str, idx: int):
+        self.scheduler = scheduler
+        self.thread = thread
+        self.label = label
+        self.idx = idx
+        self.gate = ins.RawGate()
+        self.state = "new"  # new | waiting | running | done
+        self.pending = None  # dict describing the parked operation
+        self.op_result = None
+        self.killed = False
+        self.error: Optional[BaseException] = None
+
+
+class Scheduler:
+    """Controller driving managed threads one transition at a time."""
+
+    def __init__(self, recorder: Recorder, forced=(), max_steps: int = 4000):
+        self.recorder = recorder
+        self.forced: List[int] = list(forced)
+        self.max_steps = max_steps
+        self.threads: List[Managed] = []
+        self.clock = 0.0
+        self.steps = 0
+        self.choices: List[ChoicePoint] = []
+        self.footprints = {}
+        self.status = "complete"
+        self.deadlocked: List[Tuple[str, str]] = []
+        self.aborting = False
+        self._returned = ins.RawGate()
+        self._running: Optional[Managed] = None
+        self._labels = set()
+
+    # -- registration / lifecycle -------------------------------------------
+
+    def _register(self, thread) -> Managed:
+        base = thread.name or "thread"
+        label = base
+        n = 1
+        while label in self._labels:
+            n += 1
+            label = f"{base}#{n}"
+        self._labels.add(label)
+        m = Managed(self, thread, label, len(self.threads))
+        # park-state is set HERE, before the OS thread exists: the scheduler
+        # loop may inspect it before the child ever runs
+        m.pending = {"op": "begin"}
+        m.state = "waiting"
+        thread._rxgb_managed = m
+        self.threads.append(m)
+        return m
+
+    def thread_spawn(self, thread) -> None:
+        """Called from a RUNNING managed thread creating a child."""
+        parent = ins._tls.managed
+        m = self._register(thread)
+        self.recorder.record(
+            parent.label, "fork", target=m.label,
+            locks=ins._lockset(), site=call_site(),
+        )
+        ins._REAL_THREAD.start(thread)
+        # child's OS thread parks in thread_begin; no turn handoff happens
+
+    def thread_begin(self, m: Managed) -> None:
+        """First action of a managed OS thread: park until granted. The
+        park state was already published by ``_register`` (before the OS
+        thread started), so this only waits — and does NOT signal
+        ``_returned``: the spawning parent still holds the turn."""
+        m.gate.wait()
+        m.gate.clear()
+        if m.killed:
+            raise ins._Killed()
+
+    def thread_end(self, m: Managed) -> None:
+        m.state = "done"
+        if not self.aborting:
+            self.recorder.record(m.label, "end")
+        if self._running is m:
+            self._returned.set()
+
+    def thread_join(self, thread, timeout: Optional[float]):
+        target = getattr(thread, "_rxgb_managed", None)
+        if target is None:
+            return None  # joining an unmanaged thread: nothing to wait for
+        res = self._call({"op": "join", "target": target, "timeout": timeout})
+        rec = self.recorder
+        me = ins._tls.managed
+        if res:
+            rec.record(
+                me.label, "join", target=target.label,
+                locks=ins._lockset(), site=call_site(),
+            )
+        else:
+            rec.record(
+                me.label, "join_timeout", target=target.label,
+                locks=ins._lockset(), site=call_site(),
+            )
+        return None
+
+    # -- thread-side yield protocol -----------------------------------------
+
+    def _call(self, op):
+        m = ins._tls.managed
+        if m.killed or self.aborting:
+            raise ins._Killed()
+        m.pending = op
+        m.state = "waiting"
+        self._returned.set()
+        m.gate.wait()
+        m.gate.clear()
+        if m.killed:
+            raise ins._Killed()
+        return m.op_result
+
+    # -- controller API used by the wrappers --------------------------------
+
+    def now(self) -> float:
+        return self.clock
+
+    def sleep(self, secs: float) -> None:
+        self._call({"op": "sleep", "dur": max(0.0, float(secs or 0.0))})
+
+    def lock_acquire(self, lock, blocking=True, reentrant=False) -> bool:
+        res = self._call({
+            "op": "acquire", "lock": lock, "blocking": blocking,
+            "reentrant": reentrant,
+        })
+        if res:
+            me = ins._tls.managed
+            self.recorder.record(
+                me.label, "acquire", obj=self.recorder.label_for(lock, lock._kind),
+                locks=ins._lockset(), site=call_site(),
+            )
+            ins._held_add(self.recorder.label_for(lock, lock._kind))
+        return res
+
+    def lock_release(self, lock, reentrant=False) -> None:
+        me = ins._tls.managed
+        label = self.recorder.label_for(lock, lock._kind)
+        self.recorder.record(
+            me.label, "release", obj=label,
+            locks=ins._lockset(), site=call_site(),
+        )
+        ins._held_remove(label)
+        if reentrant and lock._v_count > 1:
+            lock._v_count -= 1
+        else:
+            lock._v_owner = None
+            if reentrant:
+                lock._v_count = 0
+
+    def cond_wait(self, cond, timeout: Optional[float]) -> bool:
+        me = ins._tls.managed
+        lock = cond._lock
+        cond_label = self.recorder.label_for(cond, cond._kind)
+        lock_label = self.recorder.label_for(lock, lock._kind)
+        self.recorder.record(
+            me.label, "wait", obj=cond_label,
+            locks=ins._lockset(), site=call_site(),
+        )
+        # release the lock and enqueue as a waiter (fast, still our turn).
+        # Like threading's _release_save, an RLock is released FULLY and
+        # its recursion count restored on reacquire.
+        saved_count = getattr(lock, "_v_count", 0)
+        lock._v_owner = None
+        if hasattr(lock, "_v_count"):
+            lock._v_count = 0
+        ins._held_remove(lock_label)
+        cond._v_waiters.append(me)
+        res = self._call({
+            "op": "cond_wait", "cond": cond, "lock": lock,
+            "timeout": timeout, "phase": "waiting", "result": None,
+            "saved_count": saved_count,
+        })
+        ins._held_add(lock_label)
+        self.recorder.record(
+            me.label, "wake", obj=cond_label,
+            variant="notified" if res else "timeout",
+            locks=ins._lockset(), site=call_site(),
+        )
+        self.recorder.record(
+            me.label, "acquire", obj=lock_label,
+            locks=ins._lockset(), site=call_site(),
+        )
+        return res
+
+    def cond_notify(self, cond, n: int) -> None:
+        me = ins._tls.managed
+        self.recorder.record(
+            me.label, "notify", obj=self.recorder.label_for(cond, cond._kind),
+            locks=ins._lockset(), site=call_site(),
+        )
+        woken = 0
+        remaining = []
+        for w in cond._v_waiters:
+            if woken < n and w.pending and w.pending.get("phase") == "waiting":
+                w.pending["phase"] = "reacquire"
+                w.pending["result"] = True
+                woken += 1
+            else:
+                remaining.append(w)
+        cond._v_waiters[:] = remaining
+
+    def ev_set(self, event) -> None:
+        me = ins._tls.managed
+        event._v_set = True
+        self.recorder.record(
+            me.label, "ev_set", obj=self.recorder.label_for(event, event._kind),
+            locks=ins._lockset(), site=call_site(),
+        )
+        for m in self.threads:
+            if (
+                m.pending
+                and m.pending.get("op") == "ev_wait"
+                and m.pending.get("event") is event
+            ):
+                m.pending["ready"] = True
+
+    def ev_wait(self, event, timeout: Optional[float]) -> bool:
+        me = ins._tls.managed
+        label = self.recorder.label_for(event, event._kind)
+        self.recorder.record(
+            me.label, "ev_wait", obj=label,
+            locks=ins._lockset(), site=call_site(),
+        )
+        res = self._call({
+            "op": "ev_wait", "event": event, "timeout": timeout,
+            "ready": event._v_set,
+        })
+        self.recorder.record(
+            me.label, "ev_wake", obj=label,
+            variant="notified" if res else "timeout",
+            locks=ins._lockset(), site=call_site(),
+        )
+        return bool(res)
+
+    # -- the exploration loop -----------------------------------------------
+
+    def _enabled(self):
+        """Enabled transitions, deterministically ordered by registration.
+        Each is ``(managed, variant, sig)``; sig = (thread label, op,
+        object label, variant)."""
+        out = []
+        for m in self.threads:
+            if m.state != "waiting" or m.pending is None:
+                continue
+            op = m.pending
+            kind = op["op"]
+            if kind == "begin":
+                out.append((m, "run", (m.label, "begin", "", "")))
+            elif kind == "sleep":
+                out.append((m, "go", (m.label, "sleep", "", "")))
+            elif kind == "acquire":
+                lock = op["lock"]
+                label = self.recorder.label_for(lock, lock._kind)
+                free = lock._v_owner is None
+                mine = op["reentrant"] and lock._v_owner is m
+                if free or mine:
+                    out.append((m, "take", (m.label, "acquire", label, "")))
+                elif not op["blocking"]:
+                    out.append((m, "fail", (m.label, "acquire", label, "fail")))
+            elif kind == "cond_wait":
+                cond = op["cond"]
+                clabel = self.recorder.label_for(cond, cond._kind)
+                if op["phase"] == "waiting":
+                    if op["timeout"] is not None:
+                        out.append(
+                            (m, "timeout", (m.label, "cond_wait", clabel, "timeout"))
+                        )
+                else:  # reacquire
+                    lock = op["lock"]
+                    if lock._v_owner is None:
+                        out.append(
+                            (m, "take", (m.label, "cond_wait", clabel, "reacquire"))
+                        )
+            elif kind == "ev_wait":
+                ev = op["event"]
+                elabel = self.recorder.label_for(ev, ev._kind)
+                if op.get("ready"):
+                    out.append((m, "go", (m.label, "ev_wait", elabel, "")))
+                elif op["timeout"] is not None:
+                    out.append(
+                        (m, "timeout", (m.label, "ev_wait", elabel, "timeout"))
+                    )
+            elif kind == "join":
+                target = op["target"]
+                if target.state == "done":
+                    out.append((m, "go", (m.label, "join", target.label, "")))
+                elif op["timeout"] is not None:
+                    out.append(
+                        (m, "timeout", (m.label, "join", target.label, "timeout"))
+                    )
+        return out
+
+    def _grant(self, m: Managed, result) -> None:
+        m.op_result = result
+        m.pending = None
+        m.state = "running"
+        self._running = m
+        self._returned.clear()
+        m.gate.set()
+        self._returned.wait()
+        self._running = None
+
+    def _apply(self, m: Managed, variant: str, sig) -> None:
+        op = m.pending
+        kind = op["op"]
+        self.clock += _EPS
+        start_idx = len(self.recorder)
+        granted = True
+        if kind == "begin":
+            self.recorder.record(m.label, "begin")
+            self._grant(m, None)
+        elif kind == "sleep":
+            self.clock += op["dur"]
+            self._grant(m, None)
+        elif kind == "acquire":
+            if variant == "fail":
+                self._grant(m, False)
+            else:
+                lock = op["lock"]
+                if op["reentrant"] and lock._v_owner is m:
+                    lock._v_count += 1
+                else:
+                    lock._v_owner = m
+                    if op["reentrant"]:
+                        lock._v_count = 1
+                self._grant(m, True)
+        elif kind == "cond_wait":
+            if variant == "timeout":
+                # fire the timeout: thread moves to the reacquire phase
+                # without running user code (threading semantics: a timed
+                # wait reacquires the lock before returning False)
+                self.clock += op["timeout"] or 0.0
+                op["phase"] = "reacquire"
+                op["result"] = False
+                cond = op["cond"]
+                cond._v_waiters[:] = [w for w in cond._v_waiters if w is not m]
+                granted = False
+            else:  # take (reacquire the lock, return result)
+                lock = op["lock"]
+                lock._v_owner = m
+                if hasattr(lock, "_v_count"):
+                    # _acquire_restore: the recursion count from before wait
+                    lock._v_count = op.get("saved_count") or 1
+                self._grant(m, op["result"])
+        elif kind == "ev_wait":
+            if variant == "timeout":
+                self.clock += op["timeout"] or 0.0
+                self._grant(m, False)
+            else:
+                self._grant(m, True)
+        elif kind == "join":
+            if variant == "timeout":
+                self.clock += op["timeout"] or 0.0
+                self._grant(m, False)
+            else:
+                self._grant(m, True)
+        # footprint of the macro step: everything recorded while the thread
+        # held the turn (single-threaded execution makes this exact). The
+        # transition's own object is ALWAYS included (a failed try-acquire
+        # or fired timeout still conflicts on its lock), and a signature
+        # seen with several different footprints accumulates their UNION —
+        # last-wins would let a recurring acquire with a different critical
+        # section body masquerade as independent and unsoundly prune.
+        foot = set()
+        for ev in self.recorder.events[start_idx:]:
+            if ev.obj:
+                foot.add(f"{ev.obj}.{ev.attr}" if ev.attr else ev.obj)
+            if ev.target:
+                foot.add(f"thread:{ev.target}")
+        if sig[2]:
+            foot.add(sig[2])
+        self.footprints[sig] = self.footprints.get(sig, frozenset()) | foot
+
+    def run(self, main_fn, main_name: str = "main") -> RunResult:
+        """Drive ``main_fn`` (and every thread it spawns) to a terminal
+        state; returns the RunResult with choices + events."""
+        thread = ins.TThread(target=main_fn, name=main_name, daemon=False)
+        m = self._register(thread)
+        ins._REAL_THREAD.start(thread)
+        while True:
+            if self.steps >= self.max_steps:
+                self.status = "overflow"
+                break
+            if all(
+                t.state == "done" for t in self.threads if not t.thread.daemon
+            ):
+                # every non-daemon thread finished: scheduling leftover
+                # daemons (a parked batcher flusher, an abandoned writer) is
+                # exactly what real interpreter exit skips
+                self.status = "complete"
+                break
+            trans = self._enabled()
+            if not trans:
+                blocked = [
+                    t for t in self.threads
+                    if t.state not in ("done",) and not t.thread.daemon
+                ]
+                if blocked:
+                    self.status = "deadlock"
+                    self.deadlocked = [
+                        (t.label, str((t.pending or {}).get("op")))
+                        for t in blocked
+                    ]
+                else:
+                    self.status = "complete"
+                break
+            idx = 0
+            if len(trans) > 1:
+                if self.forced:
+                    idx = self.forced.pop(0)
+                    if idx >= len(trans):
+                        idx = 0  # schedule no longer matches; degrade gracefully
+                self.choices.append(ChoicePoint(
+                    sigs=tuple(t[2] for t in trans), chosen=idx,
+                    event_index=len(self.recorder),
+                ))
+            chosen = trans[idx]
+            self.steps += 1
+            self._apply(chosen[0], chosen[1], chosen[2])
+        events = self.recorder.snapshot()
+        errors = [
+            (t.label, repr(t.error)) for t in self.threads if t.error is not None
+        ]
+        self._cleanup()
+        return RunResult(
+            status=self.status, events=events, choices=self.choices,
+            errors=errors, deadlocked=self.deadlocked,
+            footprints=dict(self.footprints), steps=self.steps,
+        )
+
+    def _cleanup(self) -> None:
+        """Abandon every unfinished thread: the next instrumented operation
+        each performs raises ``_Killed``, unwinding it."""
+        self.aborting = True
+        for m in self.threads:
+            if m.state != "done":
+                m.killed = True
+                m.gate.set()
+        for m in self.threads:
+            ins._REAL_THREAD.join(m.thread, 2.0)
+
+    def is_managed_current(self) -> bool:
+        return getattr(ins._tls, "managed", None) is not None

@@ -33,18 +33,47 @@ _LOAD_FAST_PATH_S = 1.0
 
 
 class PendingActor:
-    """A rescheduled rank staged through (possibly background) data loading."""
+    """A rescheduled rank staged through (possibly background) data loading.
+
+    ``ready_at``/``error`` are written by the background ``elastic-load-*``
+    thread and polled by the driver's round loop whenever the 1 s fast-path
+    join times out (the documented slow-load path) — a cross-thread
+    check-then-act with no happens-before edge, surfaced as RACE001 by
+    ``tools/rxgbrace``'s elastic scenario. Both fields now live behind a
+    lock with one-shot ``mark_ready``/``mark_error`` writers, so the driver
+    can never observe a torn (ready AND errored) worker."""
 
     def __init__(self, actor, created_at: float):
         self.actor = actor
         self.created_at = created_at
-        self.ready_at: Optional[float] = None
-        self.error: Optional[BaseException] = None
         self.thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._ready_at: Optional[float] = None
+        self._error: Optional[BaseException] = None
+
+    def mark_ready(self) -> None:
+        with self._lock:
+            if self._error is None:
+                self._ready_at = time.time()
+
+    def mark_error(self, exc: BaseException) -> None:
+        with self._lock:
+            self._error = exc
+
+    @property
+    def ready_at(self) -> Optional[float]:
+        with self._lock:
+            return self._ready_at
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        with self._lock:
+            return self._error
 
     @property
     def ready(self) -> bool:
-        return self.ready_at is not None
+        with self._lock:
+            return self._ready_at is not None
 
 
 def _maybe_schedule_new_actors(
@@ -89,9 +118,9 @@ def _maybe_schedule_new_actors(
             try:
                 for matrix in load_data:
                     actor.load_data(matrix)
-                pending.ready_at = time.time()
+                pending.mark_ready()
             except BaseException as exc:  # noqa: BLE001 - surfaced by updater
-                pending.error = exc
+                pending.mark_error(exc)
 
         pending.thread = threading.Thread(
             target=_load, name=f"elastic-load-rank-{rank}", daemon=True
@@ -105,10 +134,11 @@ def _maybe_schedule_new_actors(
     deadline = time.time() + _LOAD_FAST_PATH_S
     for rank, pending in started:
         pending.thread.join(max(0.0, deadline - time.time()))
-        if pending.error is not None:
+        err = pending.error  # one locked read; the load thread may still run
+        if err is not None:
             logger.warning(
                 f"[RayXGBoost] Could not load data for rescheduled rank "
-                f"{rank}: {pending.error}"
+                f"{rank}: {err}"
             )
             continue
         training_state.pending_actors[rank] = pending
@@ -150,10 +180,11 @@ def _update_scheduled_actor_states(training_state, raise_on_ready: bool = True):
         training_state.restart_training_at = None
         return False
     for rank, pending in list(training_state.pending_actors.items()):
-        if pending.error is not None:
+        err = pending.error  # one locked read vs the background load thread
+        if err is not None:
             logger.warning(
                 f"[RayXGBoost] Background data load failed for rescheduled "
-                f"rank {rank}: {pending.error}"
+                f"rank {rank}: {err}"
             )
             del training_state.pending_actors[rank]
     if not any(p.ready for p in training_state.pending_actors.values()):

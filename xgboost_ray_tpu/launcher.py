@@ -290,25 +290,57 @@ class AsyncCheckpointWriter:
         )
         self._thread.start()
 
-    def wait(self) -> None:
-        """Join the in-flight write (if any); re-raise its failure."""
-        thread, self._thread = self._thread, None
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Join the in-flight write (if any); re-raise its failure. Returns
+        True when nothing is left in flight.
+
+        With ``timeout`` the join is BOUNDED: if the write is still running
+        after that many seconds (a hung disk, an injected ``checkpoint.save``
+        hang), the writer thread is left behind (it is a daemon, so it can
+        never wedge interpreter exit), a loud error is logged, and False is
+        returned — the caller knows the newest checkpoint is unconfirmed.
+        The thread handle is kept, so a later unbounded ``wait()`` can still
+        collect a slow-but-alive write."""
+        thread = self._thread
         if thread is not None:
-            thread.join()
+            thread.join(timeout)
+            if thread.is_alive():
+                logger.error(
+                    "[RayXGBoost] background checkpoint write still running "
+                    "after %.1fs; abandoning the join (daemon thread '%s') — "
+                    "the most recent checkpoint is NOT confirmed on disk.",
+                    timeout if timeout is not None else -1.0, thread.name,
+                )
+                return False
+            self._thread = None
+        # read the outcome only once the thread is provably finished — a
+        # timed-out join must not race the writer's error store
         exc, self._exc = self._exc, None
         if exc is not None:
             raise exc
+        return True
+
+    @staticmethod
+    def _exit_join_timeout() -> Optional[float]:
+        """Bounded-join budget for context-manager exit (driver shutdown):
+        ``RXGB_CKPT_EXIT_JOIN_S`` seconds, default 60; <= 0 restores the
+        unbounded pre-hardening join."""
+        t = float(os.environ.get("RXGB_CKPT_EXIT_JOIN_S", "60"))
+        return t if t > 0 else None
 
     def __enter__(self) -> "AsyncCheckpointWriter":
         return self
 
     def __exit__(self, exc_type, exc, tb) -> bool:
+        # bounded on BOTH paths: a commit hung on dead storage must not
+        # wedge driver exit (the write thread is a daemon; wait() already
+        # logged loudly if it had to abandon the join)
         if exc_type is None:
-            self.wait()
+            self.wait(timeout=self._exit_join_timeout())
         else:
             # don't mask the in-flight exception with a checkpoint error
             try:
-                self.wait()
+                self.wait(timeout=self._exit_join_timeout())
             except BaseException as ckpt_exc:  # noqa: BLE001
                 logger.warning(
                     "[RayXGBoost] background checkpoint write failed during "
