@@ -87,6 +87,14 @@ SAMPLING_TRIPWIRE_RATIO = 1.2
 # still lands in every BENCH_*.json so history stays queryable.
 OBS_OVERHEAD_RATIO = 1.02
 
+# wide-feature 2D mesh: flag >20% regressions of the (4,2) row x feature
+# arm's per-round time across snapshots — the guard that keeps "feature
+# sharding is actually cheaper on wide data" from silently rotting. The
+# byte cut itself is trace-deterministic and carries its own >=1.5x floor
+# inside the section (byte_cut_ok).
+WIDE_FEATURE_TRIPWIRE_RATIO = 1.2
+WIDE_FEATURE_BYTE_CUT_MIN = 1.5
+
 
 def _load_latest_bench_record(bench_dir):
     """Newest BENCH_*.json result dict (by round number, then mtime).
@@ -589,6 +597,184 @@ def run_sampling_ablation(x, y, base_params, actors):
         "goss_other_rate": arms["goss"]["other_rate"],
     }
     print(f"[bench] sampling ablation: {out}", file=sys.stderr)
+    return out
+
+
+def wide_feature_round_time_tripwire(current_wide, prev_rec, prev_name=None,
+                                     backend=None,
+                                     threshold=WIDE_FEATURE_TRIPWIRE_RATIO):
+    """Compare this run's (4,2) 2D-mesh arm steady per-round time against
+    the newest recorded bench's ``wide_feature`` section.
+
+    The feature-parallel analog of ``sampling_round_time_tripwire``:
+    returns ``{prev_per_round_s, prev_record, ratio, fired}`` or None when
+    no comparable record exists. Like-for-like only (config key)."""
+    if not isinstance(current_wide, dict):
+        return None
+    cur = (current_wide.get("2d") or {}).get("per_round_s")
+    if not cur or not isinstance(prev_rec, dict):
+        return None
+    if backend and prev_rec.get("backend") and prev_rec["backend"] != backend:
+        return None
+    prev_wide = prev_rec.get("wide_feature")
+    if not isinstance(prev_wide, dict):
+        return None
+    prev = (prev_wide.get("2d") or {}).get("per_round_s")
+    if not prev:
+        return None
+    ratio = float(cur) / float(prev)
+    out = {
+        "prev_per_round_s": round(float(prev), 4),
+        "prev_record": prev_name,
+        "ratio": round(ratio, 3),
+        "fired": False,
+    }
+    if prev_wide.get("config") != current_wide.get("config"):
+        out["config_mismatch"] = True
+        return out
+    if ratio > threshold:
+        out["fired"] = True
+        print(
+            f"[bench] WIDE-FEATURE TRIPWIRE: 2D-mesh per-round time "
+            f"{cur:.4f}s is {ratio:.2f}x the newest recorded run "
+            f"({prev:.4f}s in {prev_name or 'BENCH_*.json'}) — "
+            f">{(threshold - 1) * 100:.0f}% regression. The feature-"
+            f"parallel win is eroding; investigate before trusting this "
+            f"build on wide data.",
+            file=sys.stderr,
+        )
+    return out
+
+
+def run_wide_feature_ablation(actors=8):
+    """Synthetic wide-feature (ads/CTR-shaped) 1D-vs-2D mesh ablation.
+
+    Requires an even ``actors >= 4`` (returns None otherwise): the 2D arm
+    runs on ``(actors // 2, 2)``, and with fewer/odd actors the comparison
+    degenerates — a (1, 2) mesh has NO actors-axis histogram traffic (ring
+    terms are zero on one actor) so the byte-cut gate would pass
+    vacuously, and odd counts would compare meshes of different total
+    device counts.
+
+    F=2048 sparse-ish columns, the regime ROADMAP item 2 targets: on the
+    8-device mesh the same data/params train as (8, 1) pure row sharding
+    and as the (4, 2) row x feature mesh (``feature_parallel=2``). Each arm
+    records true per-chunk wall times, the steady per-round figure, the
+    measured per-chip AllreduceBytes (ring model, from the compiled
+    program), and the final train logloss. The section asserts the two
+    contracts the 2D mesh ships under: per-round collective bytes cut
+    >= WIDE_FEATURE_BYTE_CUT_MIN (the F/C histogram payload win must beat
+    the election/broadcast overhead it buys), and logloss parity <= 1e-5
+    (feature sharding must not change the model beyond reduction-order
+    noise)."""
+    from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+    if actors < 4 or actors % 2:
+        print(
+            f"[bench] wide-feature ablation skipped: needs an even "
+            f"actors >= 4 for a like-for-like (R,1)-vs-(R/2,2) pairing "
+            f"(got {actors}).",
+            file=sys.stderr,
+        )
+        return None
+    chunk = max(1, int(os.environ.get("RXGB_SCAN_MAX_CHUNK", "10")))
+    abl_rounds = int(os.environ.get("BENCH_WIDE_ROUNDS", 2 * chunk))
+    n_rows = int(os.environ.get("BENCH_WIDE_ROWS", 4096))
+    n_feat = int(os.environ.get("BENCH_WIDE_FEATURES", 2048))
+    depth = int(os.environ.get("BENCH_WIDE_DEPTH", 4))
+    max_bin = int(os.environ.get("BENCH_WIDE_MAX_BIN", 32))
+
+    rng = np.random.RandomState(11)
+    # CTR-shaped: mostly-zero wide columns, a sparse true weight vector
+    x = (rng.rand(n_rows, n_feat) < 0.1).astype(np.float32)
+    x *= rng.rand(n_rows, n_feat).astype(np.float32)
+    w_true = rng.randn(n_feat).astype(np.float32) * (rng.rand(n_feat) < 0.05)
+    y = ((x @ w_true + 0.2 * rng.randn(n_rows)) > 0).astype(np.float32)
+
+    base = {
+        "objective": "binary:logistic",
+        "max_depth": depth,
+        "max_bin": max_bin,
+        "eta": 0.1,
+        "tree_method": "tpu_hist",
+    }
+    arms = {
+        "1d": (dict(base), actors),                          # (8, 1)
+        "2d": ({**base, "feature_parallel": 2}, actors // 2),  # (4, 2)
+    }
+
+    def binary_logloss(margin):
+        p = 1.0 / (1.0 + np.exp(-np.asarray(margin, np.float64).ravel()))
+        p = np.clip(p, 1e-15, 1 - 1e-15)
+        return float(-np.mean(y * np.log(p) + (1 - y) * np.log1p(-p)))
+
+    out = {"rounds": abl_rounds}
+    ll_exact = {}  # unrounded per-arm loglosses: the parity gate's inputs
+    for name, (p, arm_actors) in arms.items():
+        res = {}
+        t0 = time.time()
+        bst = train(
+            p,
+            RayDMatrix(x, y),
+            num_boost_round=abl_rounds,
+            additional_results=res,
+            ray_params=RayParams(
+                num_actors=arm_actors, checkpoint_frequency=0
+            ),
+        )
+        arm_time = time.time() - t0
+        per_round = _steady_per_round(
+            res.get("round_times_s"), chunk, arm_time, abl_rounds
+        )
+        ll_exact[name] = binary_logloss(bst.predict(x, output_margin=True))
+        arm = {
+            "mesh": [arm_actors, p.get("feature_parallel", 1)],
+            "per_round_s": round(per_round, 4),
+            "train_time_s": round(arm_time, 2),
+            # true per-dispatch wall times, NOT the replicated chunk mean
+            "chunk_times_s": res.get("chunk_times_s"),
+            "final_logloss": round(ll_exact[name], 6),
+        }
+        ar_bytes = res.get("hist_allreduce_bytes_per_round")
+        if ar_bytes is not None:
+            arm["allreduce_bytes_per_round"] = int(ar_bytes)
+        out[name] = arm
+    b1 = out["1d"].get("allreduce_bytes_per_round")
+    b2 = out["2d"].get("allreduce_bytes_per_round")
+    if b1 and b2:
+        # the gate reads the UNROUNDED ratio; the stored value is display
+        out["allreduce_bytes_cut"] = round(b1 / b2, 2)
+        out["byte_cut_ok"] = (b1 / b2) >= WIDE_FEATURE_BYTE_CUT_MIN
+        if not out["byte_cut_ok"]:
+            print(
+                f"[bench] WIDE-FEATURE BYTE CUT below floor: (4,2) moves "
+                f"only {out['allreduce_bytes_cut']}x fewer bytes than "
+                f"(8,1) (floor {WIDE_FEATURE_BYTE_CUT_MIN}x).",
+                file=sys.stderr,
+            )
+    if out["1d"]["per_round_s"]:
+        out["2d_per_round_vs_1d"] = round(
+            out["2d"]["per_round_s"] / out["1d"]["per_round_s"], 3
+        )
+    # parity judged on the UNROUNDED per-arm loglosses (rounding the arms
+    # first would let a ~1.05e-5 miss slip under the 1e-5 gate); the stored
+    # delta is rounded for display only
+    ll_delta = ll_exact["2d"] - ll_exact["1d"]
+    out["logloss_delta"] = round(ll_delta, 6)
+    out["logloss_parity_ok"] = abs(ll_delta) <= 1e-5
+    if not out["logloss_parity_ok"]:
+        print(
+            f"[bench] WIDE-FEATURE LOGLOSS PARITY broken: (4,2) final "
+            f"logloss differs from (8,1) by {out['logloss_delta']} "
+            f"(> 1e-5).",
+            file=sys.stderr,
+        )
+    out["config"] = {
+        "rows": n_rows, "features": n_feat, "rounds": abl_rounds,
+        "max_depth": depth, "max_bin": max_bin, "actors": actors,
+        "mesh_1d": out["1d"]["mesh"], "mesh_2d": out["2d"]["mesh"],
+    }
+    print(f"[bench] wide-feature ablation: {out}", file=sys.stderr)
     return out
 
 
@@ -1287,6 +1473,13 @@ def run_measurement():
             "round_times_s": [round(v, 4) for v in rt],
             "first_chunk_mean_s": round(float(np.mean(rt[:chunk])), 4),
         }
+        # true per-dispatch wall times: round_times_s above replicates each
+        # fused chunk's MEAN across its rounds (per-round variance inside a
+        # chunk is invisible by construction), so the real distribution is
+        # recorded separately as [{rounds, seconds}] per compiled dispatch
+        chunk_times = additional_results.get("chunk_times_s")
+        if chunk_times:
+            detail["chunk_times_s"] = chunk_times
         if len(rt) > chunk:
             # steady-state excludes the compile-carrying first chunk; with
             # fewer rounds than one chunk there IS no steady sample — omit
@@ -1393,6 +1586,22 @@ def run_measurement():
         recheck = r4_paired_recheck(detail)
         if recheck is not None:
             detail["r4_regression_recheck"] = recheck
+
+    # wide-feature (F=2048, CTR-shaped) 1D-vs-2D mesh ablation: (8,1) row
+    # sharding vs the (4,2) row x feature mesh, recording per-round time,
+    # AllreduceBytes, and logloss parity. Default on for the 8-dev CPU
+    # mesh; opt-in on TPU via BENCH_WIDE_FEATURE=1.
+    wide_env = os.environ.get("BENCH_WIDE_FEATURE")
+    if (wide_env == "1" or (wide_env is None and not on_tpu)) and \
+            actors >= 4 and actors % 2 == 0:
+        wide_section = run_wide_feature_ablation(actors=actors)
+        if wide_section is not None:
+            wtrip = wide_feature_round_time_tripwire(
+                wide_section, prev_rec, prev_name, backend=backend
+            )
+            if wtrip is not None:
+                wide_section["regression_tripwire"] = wtrip
+            detail["wide_feature"] = wide_section
 
     # per-phase round-cost breakdown (sample/hist/split/partition/margin),
     # consumed from the runtime trace — shows WHERE sampling saves. Default

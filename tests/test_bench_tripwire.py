@@ -402,3 +402,74 @@ def test_obs_overhead_tripwire_none_without_current_ratio():
     assert bench.obs_overhead_tripwire(None) is None
     assert bench.obs_overhead_tripwire({}) is None
     assert bench.obs_overhead_tripwire({"rounds": 20}) is None
+
+
+# ---------------------------------------------------------------------------
+# wide-feature 2D-mesh tripwire
+# ---------------------------------------------------------------------------
+
+_WIDE_CFG = {
+    "rows": 4096, "features": 2048, "rounds": 20, "max_depth": 4,
+    "max_bin": 32, "actors": 8, "mesh_1d": [8, 1], "mesh_2d": [4, 2],
+}
+
+
+def _wide_section(per_round_2d, cfg=None):
+    return {
+        "rounds": 20,
+        "1d": {"mesh": [8, 1], "per_round_s": 2.5,
+               "allreduce_bytes_per_round": 7569730},
+        "2d": {"mesh": [4, 2], "per_round_s": per_round_2d,
+               "allreduce_bytes_per_round": 3260992},
+        "allreduce_bytes_cut": 2.32,
+        "byte_cut_ok": True,
+        "config": dict(cfg if cfg is not None else _WIDE_CFG),
+    }
+
+
+def test_wide_feature_tripwire_fires_on_2d_round_regression(capsys):
+    rec = {"metric": "m", "backend": "cpu",
+           "wide_feature": _wide_section(2.0)}
+    out = bench.wide_feature_round_time_tripwire(
+        _wide_section(4.0), rec, "BENCH_r06.json", backend="cpu"
+    )
+    assert out is not None and out["fired"]
+    assert out["ratio"] == 2.0
+    assert out["prev_per_round_s"] == 2.0
+    assert "WIDE-FEATURE TRIPWIRE" in capsys.readouterr().err
+
+
+def test_wide_feature_tripwire_quiet_within_20pct(capsys):
+    rec = {"metric": "m", "backend": "cpu",
+           "wide_feature": _wide_section(2.0)}
+    out = bench.wide_feature_round_time_tripwire(
+        _wide_section(2.3), rec, "x", backend="cpu"
+    )
+    assert out is not None and not out["fired"]
+    assert "WIDE-FEATURE TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_wide_feature_tripwire_reports_but_never_fires_on_config_mismatch(
+        capsys):
+    other = dict(_WIDE_CFG, features=1024)
+    rec = {"metric": "m", "backend": "cpu",
+           "wide_feature": _wide_section(2.0, other)}
+    out = bench.wide_feature_round_time_tripwire(
+        _wide_section(9.0), rec, "x", backend="cpu"
+    )
+    assert out is not None and not out["fired"]
+    assert out["config_mismatch"] is True
+    assert "WIDE-FEATURE TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_wide_feature_tripwire_skips_incomparable_records():
+    cur = _wide_section(4.0)
+    rec_tpu = {"metric": "m", "backend": "tpu",
+               "wide_feature": _wide_section(2.0)}
+    assert bench.wide_feature_round_time_tripwire(
+        cur, rec_tpu, "x", backend="cpu") is None
+    rec_none = {"metric": "m", "backend": "cpu"}  # pre-2D-era record
+    assert bench.wide_feature_round_time_tripwire(
+        cur, rec_none, "x", backend="cpu") is None
+    assert bench.wide_feature_round_time_tripwire(None, rec_tpu, "x") is None
+    assert bench.wide_feature_round_time_tripwire({}, rec_tpu, "x") is None

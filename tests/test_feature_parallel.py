@@ -1,0 +1,387 @@
+"""2D row x feature mesh sharding (``feature_parallel``) + the histogram
+provider protocol.
+
+The contracts pinned here:
+
+* (R, 1) and (R, C) meshes train the SAME model on the same data/params —
+  bitwise for the elected splits (tree structure arrays) and leaf values,
+  logloss parity — including the hist_quant=int8 composition, lossguide,
+  colsample/missing-values/feature-padding, and the fused-scan GOSS path.
+* The default config (C=1) traces the exact pre-PR program: collective
+  schedules equal the pre-refactor golden
+  (``tests/goldens/schedules_1d_quick.json``), and an explicit
+  ``feature_parallel=1`` dedupes onto the default config's registry record
+  with the IDENTICAL jaxpr fingerprint (the PR 4 subsample=1.0 discipline).
+* The 2D collective schedule is pinned (``schedules_2d_pin.json``): psums
+  of the rank-4 histogram payload ride the actors axis ONLY, the features
+  axis carries nothing but tiny (rank <= 2) election/broadcast collectives.
+* The 2D matrix rows verify clean under rxgbverify (VER001-VER006).
+* 2D engines refuse the zero-replay reshard path (legacy restart fallback).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from xgboost_ray_tpu import progreg
+from xgboost_ray_tpu.engine import TpuEngine
+from xgboost_ray_tpu.params import parse_params
+
+from tools.rxgblint import catalog
+from tools.rxgbverify import checks, walker
+from tools.rxgbverify.matrix import FULL_MATRIX, trace_matrix
+
+MESH_AXES = catalog.mesh_axes()
+_GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "goldens")
+
+_BASE = {"objective": "binary:logistic", "max_depth": 4,
+         "eval_metric": ["logloss"]}
+
+
+def _shards(rows=256, feats=9, missing=True, seed=7):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(rows, feats).astype(np.float32)
+    if missing:
+        x[rng.rand(rows, feats) < 0.05] = np.nan
+    y = (np.nansum(x[:, :2], axis=1) + 0.3 * rng.randn(rows) > 1.0).astype(
+        np.float32
+    )
+    return [{"data": x, "label": y}]
+
+
+def _train_pair(overrides, rows=256, feats=9, actors=2, c=2, rounds=3,
+                use_scan=False, evals=True, **shard_kw):
+    """Train (actors, 1) and (actors, c) engines on identical data; return
+    (booster_1d, booster_2d, logloss_1d, logloss_2d, engines)."""
+    shards = _shards(rows=rows, feats=feats, **shard_kw)
+    ev = [(shards, "train")] if evals else []
+    e1 = TpuEngine(shards, parse_params({**_BASE, **overrides}),
+                   num_actors=actors, evals=ev)
+    e2 = TpuEngine(
+        shards,
+        parse_params({**_BASE, **overrides, "feature_parallel": c}),
+        num_actors=actors, evals=ev,
+    )
+    ll1, ll2 = [], []
+    if use_scan:
+        for res in e1.step_many(0, rounds):
+            ll1.append(res.get("train", {}).get("logloss"))
+        for res in e2.step_many(0, rounds):
+            ll2.append(res.get("train", {}).get("logloss"))
+    else:
+        for i in range(rounds):
+            ll1.append(e1.step(i).get("train", {}).get("logloss"))
+            ll2.append(e2.step(i).get("train", {}).get("logloss"))
+    return e1.get_booster(), e2.get_booster(), ll1, ll2, (e1, e2)
+
+
+def _assert_forests_bitwise(b1, b2):
+    f1, f2 = b1.forest, b2.forest
+    for name in ("feature", "split_bin", "default_left", "is_leaf"):
+        assert np.array_equal(
+            np.asarray(getattr(f1, name)), np.asarray(getattr(f2, name))
+        ), f"forest field {name} differs between (R,1) and (R,C)"
+    for name in ("value", "threshold", "gain", "cover", "base_weight"):
+        assert np.array_equal(
+            np.asarray(getattr(f1, name)), np.asarray(getattr(f2, name))
+        ), f"forest field {name} differs between (R,1) and (R,C)"
+
+
+# ---------------------------------------------------------------------------
+# params validation
+# ---------------------------------------------------------------------------
+
+def test_feature_parallel_param_validation():
+    assert parse_params({}).feature_parallel == 1
+    assert parse_params({"feature_parallel": None}).feature_parallel == 1
+    assert parse_params({"feature_parallel": "2"}).feature_parallel == 2
+    with pytest.raises(ValueError, match="feature_parallel"):
+        parse_params({"feature_parallel": 0})
+    for bad in (
+        {"booster": "dart"},
+        {"booster": "gblinear"},
+        {"colsample_bylevel": 0.5},
+        {"colsample_bynode": 0.5},
+        {"monotone_constraints": "(1,0,0)"},
+        {"interaction_constraints": [[0, 1]]},
+    ):
+        with pytest.raises(NotImplementedError):
+            parse_params({"feature_parallel": 2, **bad})
+
+
+def test_engine_rejects_insufficient_devices():
+    shards = _shards(rows=64, feats=4)
+    with pytest.raises(ValueError, match="devices"):
+        TpuEngine(shards, parse_params({**_BASE, "feature_parallel": 8}),
+                  num_actors=4)
+
+
+# ---------------------------------------------------------------------------
+# 1D <-> 2D model parity (bitwise elected splits, logloss parity)
+# ---------------------------------------------------------------------------
+
+def test_parity_depthwise_bitwise():
+    b1, b2, ll1, ll2, _ = _train_pair({})
+    _assert_forests_bitwise(b1, b2)
+    for a, b in zip(ll1, ll2):
+        assert abs(a - b) <= 1e-5
+
+
+def test_parity_int8_composition():
+    """hist_quant=int8 x feature_parallel: the quantized actors-axis wire
+    composes with the feature-axis sharding (the multiplicative byte cut
+    the tentpole is for)."""
+    b1, b2, ll1, ll2, (e1, e2) = _train_pair(
+        {"hist_quant": "int8", "hist_quant_min_bytes": 0}
+    )
+    _assert_forests_bitwise(b1, b2)
+    for a, b in zip(ll1, ll2):
+        assert abs(a - b) <= 1e-5
+    # measured wire bytes: the (R, C) program moves strictly fewer bytes
+    # per chip than (R, 1) — F/C histogram payloads vs full-F
+    assert e2.hist_allreduce_bytes_per_round() < (
+        e1.hist_allreduce_bytes_per_round()
+    )
+
+
+def test_parity_int8_min_bytes_window():
+    """Regression (review finding): the hist_quant_min_bytes quantize-vs-
+    exact-f32 fallback must be decided on the GLOBAL payload. At F=24,
+    max_bin=256 and the DEFAULT 32 KiB threshold, the full-F level payload
+    (24 x 257 x 2 x 4 = 49,344 B) quantizes on (R, 1) while the F/2 local
+    tile (24,672 B) sits UNDER the threshold — without the engine's
+    threshold rescaling the 2D mesh would silently fall back to exact f32
+    and train a different model."""
+    b1, b2, ll1, ll2, _ = _train_pair(
+        {"hist_quant": "int8", "max_bin": 256}, feats=24, missing=False,
+    )
+    _assert_forests_bitwise(b1, b2)
+    for a, b in zip(ll1, ll2):
+        assert abs(a - b) <= 1e-5
+
+
+def test_parity_lossguide():
+    b1, b2, ll1, ll2, _ = _train_pair(
+        {"grow_policy": "lossguide", "max_leaves": 8}
+    )
+    _assert_forests_bitwise(b1, b2)
+    for a, b in zip(ll1, ll2):
+        assert abs(a - b) <= 1e-5
+
+
+def test_parity_colsample_missing_and_padding():
+    """Odd feature count (feature-axis padding), NaNs (missing routing) and
+    colsample_bytree (global-F mask sliced per shard) together."""
+    b1, b2, ll1, ll2, _ = _train_pair(
+        {"colsample_bytree": 0.6, "seed": 11}, feats=11,
+    )
+    _assert_forests_bitwise(b1, b2)
+    for a, b in zip(ll1, ll2):
+        assert abs(a - b) <= 1e-5
+
+
+def test_parity_pad_column_mcw_zero():
+    """Regression (review finding): with min_child_weight=0 (and no L2),
+    an all-missing PAD column's empty-child candidate passes the hessian
+    gate and its gain is f32 noise around 0 rather than -inf — without the
+    explicit pad mask in the local split search the 2D mesh could elect a
+    nonexistent feature index >= F and diverge from (R, 1)."""
+    shards = _shards(rows=256, feats=7, seed=13)
+    shards[0]["data"][
+        np.random.RandomState(13).rand(256, 7) < 0.3
+    ] = np.nan
+    y = (np.random.RandomState(14).rand(256) > 0.5).astype(np.float32)
+    shards[0]["label"] = y  # noise labels: every gain hovers near 0
+    p = {**_BASE, "max_depth": 6, "min_child_weight": 0.0, "gamma": 0.0,
+         "reg_lambda": 0.0}
+    e1 = TpuEngine(shards, parse_params(p), num_actors=2)
+    e2 = TpuEngine(shards, parse_params({**p, "feature_parallel": 2}),
+                   num_actors=2)
+    for i in range(4):
+        e1.step(i)
+        e2.step(i)
+    b1, b2 = e1.get_booster(), e2.get_booster()
+    assert int(np.asarray(b2.forest.feature).max()) < 7  # never a pad split
+    _assert_forests_bitwise(b1, b2)
+
+
+def test_parity_goss_fused_scan():
+    """The batched lax.scan path (step_many) with GOSS row compaction: the
+    sampled build's full-row margin walk goes through the feature-sharded
+    tree walk."""
+    b1, b2, _, _, _ = _train_pair(
+        {"subsample": 0.5, "sampling_method": "gradient_based"},
+        use_scan=True, evals=False,
+    )
+    _assert_forests_bitwise(b1, b2)
+
+
+def test_parity_eval_set_margins():
+    """Non-train eval sets ride feature-sharded binned matrices; their
+    device metrics must match the 1D mesh."""
+    shards = _shards()
+    eshards = _shards(rows=128, seed=23)
+    evals = [(shards, "train"), (eshards, "val")]
+    e1 = TpuEngine(shards, parse_params(_BASE), num_actors=2, evals=evals)
+    e2 = TpuEngine(shards, parse_params({**_BASE, "feature_parallel": 2}),
+                   num_actors=2, evals=evals)
+    for i in range(3):
+        r1, r2 = e1.step(i), e2.step(i)
+        assert abs(r1["val"]["logloss"] - r2["val"]["logloss"]) <= 1e-5
+
+
+# ---------------------------------------------------------------------------
+# C=1 traces the exact pre-PR program
+# ---------------------------------------------------------------------------
+
+def test_default_schedules_match_pre_refactor_golden():
+    """The pre-PR collective schedules of the quick matrix, captured at the
+    commit BEFORE the provider refactor / 2D mesh landed: the default (C=1)
+    configs must still trace them verbatim. Regenerate the golden only for
+    an intentional program change (tests/goldens/schedules_1d_quick.json)."""
+    traced = trace_matrix(quick=True)
+    out = {}
+    for t in traced:
+        key = "%s@world=%s@hq=%s" % (
+            t.record.name, t.record.meta.get("world"),
+            t.record.meta.get("hist_quant"),
+        )
+        assert t.ok, (key, t.error)
+        out[key] = [list(s) for s in t.analysis.schedule()]
+    out = json.loads(json.dumps(out))  # tuples -> lists, like the golden
+    with open(os.path.join(_GOLDEN_DIR, "schedules_1d_quick.json")) as fh:
+        golden = json.load(fh)
+    assert set(out) == set(golden)
+    for key in sorted(golden):
+        assert out[key] == golden[key], (
+            f"{key}: C=1 collective schedule drifted from the pre-PR golden"
+        )
+
+
+def test_explicit_c1_is_the_default_program():
+    """``feature_parallel=1`` written out explicitly registers onto the SAME
+    registry record as the default config (registrations bump, no new key)
+    with the IDENTICAL jaxpr fingerprint — the rxgbverify analog of PR 4's
+    subsample=1.0 bitwise pin."""
+    shards = _shards(rows=64, feats=4, missing=False)
+    with progreg.capture():
+        progreg.clear()
+        eng = TpuEngine(shards, parse_params(_BASE), num_actors=2)
+        eng.build_programs()
+        recs = [r for r in progreg.records() if r.name == "engine.step"]
+        assert len(recs) == 1
+        fp_default = walker.trace_record(recs[0]).fingerprint
+        assert fp_default and not fp_default.startswith("trace-error")
+
+        eng2 = TpuEngine(
+            shards, parse_params({**_BASE, "feature_parallel": 1}),
+            num_actors=2,
+        )
+        eng2.build_programs()
+        recs2 = [r for r in progreg.records() if r.name == "engine.step"]
+        assert len(recs2) == 1 and recs2[0].registrations >= 2
+        assert walker.trace_record(recs2[0]).fingerprint == fp_default
+    progreg.clear()
+
+
+# ---------------------------------------------------------------------------
+# the 2D collective schedule pin + rxgbverify clean gate
+# ---------------------------------------------------------------------------
+
+def _matrix_2d_entries():
+    return [e for e in FULL_MATRIX if "2d" in e.label]
+
+
+_TRACED_2D = []  # lazy module cache: one trace serves both 2D gate tests
+
+
+def _traced_2d():
+    if not _TRACED_2D:
+        _TRACED_2D.extend(trace_matrix(entries=_matrix_2d_entries()))
+    return _TRACED_2D
+
+
+def test_2d_matrix_ships_clean():
+    """VER001-VER006 over the 2D matrix rows (the tier-1 2D gate): the
+    (2,2)/(4,2) engines' programs re-trace clean, the cross-world identity
+    group actually sees both row worlds at feature_parallel=2, and the
+    features axis resolves against the shared mesh catalog."""
+    traced = _traced_2d()
+    assert traced and all(t.ok for t in traced), [
+        t.error for t in traced if not t.ok
+    ]
+    findings = checks.run_checks(traced, MESH_AXES, root=catalog.REPO_ROOT)
+    assert findings == [], [f.render() for f in findings]
+    worlds = {
+        t.record.meta["world"] for t in traced
+        if t.record.name == "engine.step"
+        and t.record.meta.get("feature_parallel") == 2
+    }
+    assert {2, 4} <= worlds  # VER001 really compared 2D row worlds
+    assert "features" in MESH_AXES  # the catalog extracted the new axis
+    int8_2d = [
+        t for t in traced
+        if t.record.name == "engine.step"
+        and t.record.meta.get("feature_parallel") == 2
+        and t.record.meta.get("hist_quant") == "int8"
+    ]
+    assert int8_2d  # the composition row is present, not vacuous
+    for t in int8_2d:
+        assert any(c.prim == "all_to_all" and c.dtype == "int8"
+                   for c in t.analysis.collectives)
+
+
+def test_2d_schedule_pin():
+    """Pin the 2D round step's collective schedule (the 1D quantized-golden
+    discipline): the byte-exact sequence lives in
+    tests/goldens/schedules_2d_pin.json, and structurally — every rank-4
+    histogram payload psums over the ACTORS axis only, while the FEATURES
+    axis carries nothing but tiny (rank <= 2) election gathers / broadcast
+    psums, so feature sharding can never silently re-replicate the
+    histogram."""
+    traced = _traced_2d()
+    steps = {
+        "%s@world=%s@hq=%s" % (
+            t.record.name, t.record.meta["world"],
+            t.record.meta.get("hist_quant"),
+        ): t
+        for t in traced if t.record.name == "engine.step"
+    }
+    out = {
+        k: [list(s) for s in t.analysis.schedule()]
+        for k, t in steps.items()
+    }
+    out = json.loads(json.dumps(out))
+    with open(os.path.join(_GOLDEN_DIR, "schedules_2d_pin.json")) as fh:
+        golden = json.load(fh)
+    assert set(golden) <= set(out)
+    for key in sorted(golden):
+        assert out[key] == golden[key], (
+            f"{key}: 2D collective schedule drifted from the pin"
+        )
+    for key, t in steps.items():
+        for c in t.analysis.collectives:
+            axes = set(c.axes)
+            assert axes <= {"actors", "features"}, (key, c.describe())
+            if len(c.shape) >= 3:
+                # histogram-sized payloads never cross the feature axis
+                assert axes == {"actors"}, (key, c.describe())
+            if axes == {"features"}:
+                assert len(c.shape) <= 2, (key, c.describe())
+
+
+# ---------------------------------------------------------------------------
+# elastic: 2D falls back to the legacy restart path
+# ---------------------------------------------------------------------------
+
+def test_2d_engine_refuses_reshard():
+    shards = _shards(rows=64, feats=4, missing=False)
+    eng = TpuEngine(shards, parse_params({**_BASE, "feature_parallel": 2}),
+                    num_actors=2)
+    assert not eng.can_reshard()
+    with pytest.raises(ValueError, match="feature_parallel"):
+        eng.reset_from_booster(shards, [], None)
+    eng1 = TpuEngine(shards, parse_params(_BASE), num_actors=2)
+    assert eng1.can_reshard()

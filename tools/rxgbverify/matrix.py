@@ -68,6 +68,25 @@ FULL_MATRIX: Tuple[MatrixEntry, ...] = (
          "hist_quant": "int8", "hist_quant_min_bytes": 0},
         (4,),
     ),
+    # 2D row x feature mesh: worlds here are the ROW extent R; each engine
+    # takes R x 2 of the 8 virtual devices ((2,2) and (4,2)). The two-world
+    # row feeds VER001 with feature_parallel=2 meta, pinning the 2D
+    # collective schedule (histogram psums on the actors axis, the tiny
+    # election all_gather + bin-column psums on the features axis) across
+    # coexisting row worlds the same way the 1D quantized schedule is
+    # pinned.
+    MatrixEntry("depthwise-2d", {"feature_parallel": 2}, (2, 4)),
+    MatrixEntry(
+        "depthwise-2d-int8",
+        {"feature_parallel": 2, "hist_quant": "int8",
+         "hist_quant_min_bytes": 0},
+        (4,),
+    ),
+    MatrixEntry(
+        "lossguide-2d",
+        {"feature_parallel": 2, "grow_policy": "lossguide", "max_leaves": 8},
+        (2,),
+    ),
 )
 
 #: tier-1 test subset: the two keystone rows (plain + quantized) at two

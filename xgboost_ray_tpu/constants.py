@@ -10,9 +10,17 @@ why the module must stay stdlib-only with plain string assignments at module
 scope — no computed values, no imports that drag in jax.
 """
 
-#: the 1D data-parallel mesh axis: one slot per logical actor rank (the
+#: the data-parallel mesh axis: one slot per logical actor rank (the
 #: TPU-native replacement for the reference's one-OS-process-per-actor
 #: topology; see engine.py module docstring)
 AXIS_ACTORS = "actors"
 
-__all__ = ["AXIS_ACTORS"]
+#: the feature-parallel mesh axis (``feature_parallel`` > 1): histogram
+#: feature columns are partitioned over this axis so each chip builds and
+#: allreduces only its [N/R, F/C] tile. Histograms psum over
+#: :data:`AXIS_ACTORS` only; this axis carries the tiny per-node best-split
+#: election gather and the winning feature's bin-column broadcast (see
+#: ops/provider.py FeatureShard).
+AXIS_FEATURES = "features"
+
+__all__ = ["AXIS_ACTORS", "AXIS_FEATURES"]

@@ -39,7 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from xgboost_ray_tpu import obs
 from xgboost_ray_tpu import progreg
 from xgboost_ray_tpu.compat import shard_map_compat
-from xgboost_ray_tpu.constants import AXIS_ACTORS
+from xgboost_ray_tpu.constants import AXIS_ACTORS, AXIS_FEATURES
 from xgboost_ray_tpu.models.booster import RayXGBoostBooster, stack_trees
 from xgboost_ray_tpu.ops import binning
 from xgboost_ray_tpu.ops.histogram import (
@@ -55,8 +55,10 @@ from xgboost_ray_tpu.ops.grow import (
     Tree,
     build_tree,
     predict_tree_binned,
+    predict_tree_binned_fsharded,
     sample_feature_mask,
 )
+from xgboost_ray_tpu.ops.provider import FeatureShard, default_hist_impl
 from xgboost_ray_tpu.ops import sampling
 from xgboost_ray_tpu.ops.metrics import (
     compute_metric,
@@ -76,14 +78,12 @@ shard_map = shard_map_compat  # version-portable, replication check off
 
 
 def resolve_hist_impl(impl: str) -> str:
+    """Resolve 'auto' via the histogram-provider registry's backend policy
+    (ops/provider.py — the one string -> strategy point); explicit names
+    pass through and are validated at provider resolution."""
     if impl != "auto":
         return impl
-    backend = jax.default_backend()
-    if backend == "cpu":
-        return "scatter"
-    # accelerators: one-hot MXU matmuls while the node fan-out is small,
-    # node-contiguous row partitioning beyond (FLOPs independent of fan-out)
-    return "mixed"
+    return default_hist_impl()
 
 
 def resolve_hist_precision(precision: str) -> str:
@@ -183,14 +183,41 @@ class TpuEngine:
         # contiguous device slices (tuner.py), and get_tune_resources()
         # exports the strategy hint for schedulers above.
         devices = list(devices if devices is not None else jax.devices())
-        self.n_devices = max(1, min(num_actors, len(devices)))
-        if self.n_devices < num_actors:
-            logger.info(
-                "num_actors=%d > %d available devices; folding shards onto the mesh.",
-                num_actors,
-                len(devices),
+        self.feature_parallel = int(getattr(params, "feature_parallel", 1))
+        if self.feature_parallel > 1:
+            # 2D row x feature mesh: rows shard over AXIS_ACTORS (R =
+            # num_actors slots, the "world"), histogram feature columns over
+            # AXIS_FEATURES (C = feature_parallel). C=1 keeps the 1D branch
+            # below and traces the exact legacy program.
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "feature_parallel > 1 is single-process only for now "
+                    "(the multi-host global row layout assumes the 1D row "
+                    "mesh)."
+                )
+            need = num_actors * self.feature_parallel
+            if len(devices) < need:
+                raise ValueError(
+                    f"feature_parallel={self.feature_parallel} needs "
+                    f"num_actors x C = {need} devices; only {len(devices)} "
+                    f"available."
+                )
+            self.n_devices = max(1, num_actors)
+            self.mesh = Mesh(
+                np.array(devices[:need]).reshape(
+                    self.n_devices, self.feature_parallel
+                ),
+                (AXIS_ACTORS, AXIS_FEATURES),
             )
-        self.mesh = Mesh(np.array(devices[: self.n_devices]), (AXIS_ACTORS,))
+        else:
+            self.n_devices = max(1, min(num_actors, len(devices)))
+            if self.n_devices < num_actors:
+                logger.info(
+                    "num_actors=%d > %d available devices; folding shards onto the mesh.",
+                    num_actors,
+                    len(devices),
+                )
+            self.mesh = Mesh(np.array(devices[: self.n_devices]), (AXIS_ACTORS,))
         self.num_actors = num_actors
 
         self.objective = (
@@ -410,6 +437,60 @@ class TpuEngine:
             x_dev, self.valid, self.weight_dev
         )
 
+        # ---- feature-axis sharding (feature_parallel > 1) ----------------
+        # Sketch/binning ran at full F (one-off, row-parallel); the binned
+        # matrix is then feature-padded to a C-multiple and laid out as
+        # [N/R, F_pad/C] tiles. Pad columns bin entirely to the missing
+        # bucket, so their split candidates score -inf and can never be
+        # elected. cuts / feat_has_missing keep GLOBAL padded copies for the
+        # growers (threshold recovery and routing use global feature ids).
+        self._f_padded = self.n_features
+        self._cuts_grow = self.cuts
+        self._fhm_grow = self._feat_has_missing
+        if self.feature_parallel > 1:
+            c_shards = self.feature_parallel
+            self._f_padded = -(-self.n_features // c_shards) * c_shards
+            if self._f_padded * (self.params.max_bin - 1) >= (1 << 24):
+                # the best-split election ships its flat candidate index as
+                # f32 (exact integers below 2^24 only)
+                raise NotImplementedError(
+                    f"feature_parallel: padded F x (max_bin - 1) = "
+                    f"{self._f_padded * (self.params.max_bin - 1)} exceeds "
+                    f"the election record's exact-int f32 range (2^24); "
+                    f"reduce max_bin or the feature count."
+                )
+            f_extra = self._f_padded - self.n_features
+            if f_extra:
+                self._cuts_grow = jnp.pad(self.cuts, ((0, f_extra), (0, 0)))
+                # pad columns DO bin to the missing bucket; keeping the
+                # flag True leaves their (all-missing) histogram honest
+                self._fhm_grow = jnp.pad(
+                    self._feat_has_missing, (0, f_extra),
+                    constant_values=True,
+                )
+            if self.cfg.hist_quant != "none":
+                # the quantize-vs-exact-f32 fallback (hist_quant_min_bytes)
+                # must be decided on the GLOBAL payload, not the F/C local
+                # tile — otherwise payloads in the window between the tile
+                # size and the full-F size would quantize on (R, 1) but
+                # fall back to exact f32 on (R, C), silently training a
+                # different model per mesh shape. Scaling the threshold by
+                # local/global keeps every decision site (the allreduce
+                # fallback AND the growers' exact-node-totals mirrors,
+                # which all compare LOCAL payload bytes against this cfg
+                # field) exactly equivalent to the 1D decision.
+                import dataclasses as _dc
+
+                f_local = self._f_padded // c_shards
+                self.cfg = _dc.replace(
+                    self.cfg,
+                    hist_quant_min_bytes=(
+                        self.params.hist_quant_min_bytes
+                        * f_local / max(self.n_features, 1)
+                    ),
+                )
+            self.bins = self._feature_shard_bins(self.bins)
+
         # ---- ranking group structure (per device block) ------------------
         # built whenever qid exists (ranking gradients AND device ndcg/map
         # metrics use the same padded per-shard group layout)
@@ -520,6 +601,10 @@ class TpuEngine:
             "world": int(self.n_devices),
             "rows": int(self.n_rows),
         }
+        if self.feature_parallel > 1:
+            self._obs_round_attrs["feature_parallel"] = int(
+                self.feature_parallel
+            )
         if samp_spec is not None:
             self._obs_round_attrs["sample_rows_per_shard"] = int(
                 sampling.row_budget(self.pad_to // self.n_devices, samp_spec)
@@ -634,6 +719,28 @@ class TpuEngine:
         )
         return jit_fn(x_dev, self.cuts)
 
+    def _feature_shard_bins(self, bins):
+        """Feature-pad a [N, F] binned matrix to ``_f_padded`` columns
+        (missing bucket) and lay it out over the 2D mesh as
+        [N/R, F_pad/C] tiles."""
+        f_extra = self._f_padded - bins.shape[1]
+        if f_extra:
+            bins = jnp.pad(
+                bins, ((0, 0), (0, f_extra)),
+                constant_values=np.asarray(
+                    self.params.max_bin, bins.dtype
+                ),
+            )
+        return jax.device_put(
+            bins, NamedSharding(self.mesh, P(AXIS_ACTORS, AXIS_FEATURES))
+        )
+
+    def _bins_spec(self):
+        """PartitionSpec of every binned matrix (train + eval sets)."""
+        if self.feature_parallel > 1:
+            return P(AXIS_ACTORS, AXIS_FEATURES)
+        return P(AXIS_ACTORS)
+
     def _build_sharded_groups(self, qid, n_rows=None, pad_to=None):
         """Per-device-block padded group gather maps, stacked + sharded.
 
@@ -718,6 +825,8 @@ class TpuEngine:
 
         x_dev = put_rows(x, np.float32, fill=np.nan)
         es.bins = self._bin_with_cuts(x_dev)
+        if self.feature_parallel > 1:
+            es.bins = self._feature_shard_bins(es.bins)
         if qid is not None:
             es.group_rows_dev = self._build_sharded_groups(
                 qid, n_rows=x.shape[0], pad_to=pad_to
@@ -777,6 +886,14 @@ class TpuEngine:
 
         is_survival = self.is_survival
 
+        # feature-parallel context (trace-time constants; fp_c == 1 takes
+        # every legacy branch below, tracing the exact 1D program)
+        fp_c = self.feature_parallel
+        n_feat_real = self.n_features
+        f_padded = self._f_padded
+        cuts_grow = self._cuts_grow
+        fhm_grow = self._fhm_grow
+
         # row sampling (ops/sampling.py): None when off — the None path
         # traces the exact pre-sampling program, so default params stay
         # bit-identical to builds that predate the compaction machinery
@@ -791,6 +908,30 @@ class TpuEngine:
             # tree-path allreduce (histograms + small exact reductions)
             counter = AllreduceBytes(n_actors)
             tree_psum = counting_psum(AXIS_ACTORS, counter)
+            fshard = None
+            counter_f = None
+            if fp_c > 1:
+                # the feature axis carries only the tiny election gather,
+                # the node-total broadcast and the [N] bin-column psums —
+                # counted with its own ring extent C
+                counter_f = AllreduceBytes(fp_c)
+                fshard = FeatureShard(
+                    AXIS_FEATURES, fp_c, f_padded, n_feat_real,
+                    counter=counter_f,
+                )
+
+            def walk(tree_, bins_):
+                """Once-per-tree margin walk over a (possibly
+                feature-sharded) binned matrix."""
+                if fshard is None:
+                    return predict_tree_binned(
+                        tree_, bins_, cfg.max_depth, missing_bin,
+                        cat_features=cfg.cat_features,
+                    )
+                return predict_tree_binned_fsharded(
+                    tree_, bins_, cfg.max_depth, missing_bin, fshard,
+                    cat_features=cfg.cat_features,
+                )
 
             def hist_ar(h):
                 return quantized_hist_allreduce(
@@ -840,10 +981,17 @@ class TpuEngine:
                     fmask = None
                     if params.colsample_bytree < 1.0:
                         fkey = jax.random.fold_in(key, SALT_BYTREE)
+                        # drawn over the REAL global feature count (same
+                        # stream/semantics on every mesh shape), padded out
+                        # to the sharded layout's width when 2D
                         fmask = sample_feature_mask(
-                            fkey, bins.shape[1], params.colsample_bytree,
+                            fkey, n_feat_real, params.colsample_bytree,
                             self._log_fw,
                         )
+                        if fshard is not None and f_padded != n_feat_real:
+                            fmask = jnp.pad(
+                                fmask, (0, f_padded - n_feat_real)
+                            )
                     need_level_rng = (
                         params.colsample_bylevel < 1.0
                         or params.colsample_bynode < 1.0
@@ -851,7 +999,7 @@ class TpuEngine:
                     tree, row_value = build_tree(
                         bins_t,
                         ghk,
-                        self.cuts,
+                        cuts_grow,
                         cfg,
                         feature_mask=fmask,
                         level_rng=key if need_level_rng else None,
@@ -859,9 +1007,10 @@ class TpuEngine:
                         colsample_bynode=params.colsample_bynode,
                         allreduce=tree_psum,
                         feature_log_weights=self._log_fw,
-                        feat_has_missing=self._feat_has_missing,
+                        feat_has_missing=fhm_grow,
                         hist_allreduce=hist_ar,
                         ar_counter=counter,
+                        fshard=fshard,
                     )
                     trees.append(tree)
                     if samp_spec is not None:
@@ -870,20 +1019,18 @@ class TpuEngine:
                         # next round's gradients cover every row), so walk
                         # the finished tree over the full binned matrix —
                         # the same once-per-tree device walk eval sets use.
-                        row_value = predict_tree_binned(
-                            tree, bins, cfg.max_depth, missing_bin,
-                            cat_features=cfg.cat_features,
-                        )
+                        row_value = walk(tree, bins)
                     new_margins = new_margins.at[:, k].add(row_value / t_par)
                     for e in range(n_evals_dev):
-                        upd = predict_tree_binned(
-                            tree, eval_bins[e], cfg.max_depth, missing_bin,
-                            cat_features=cfg.cat_features,
-                        )
+                        upd = walk(tree, eval_bins[e])
                         new_eval_margins[e] = (
                             new_eval_margins[e].at[:, k].add(upd / t_par)
                         )
             forest = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+            # total per-chip wire bytes of the round: actors-axis traffic
+            # (histogram merges + exact reductions) plus, on a 2D mesh, the
+            # feature-axis election/broadcast traffic
+            counter.absorb(counter_f)
             return (new_margins, tuple(new_eval_margins), forest,
                     counter.as_scalar())
 
@@ -953,7 +1100,7 @@ class TpuEngine:
             if es.is_train:
                 continue
             specs.append(_EvalArrs(
-                P(AXIS_ACTORS), P(AXIS_ACTORS), P(AXIS_ACTORS), P(AXIS_ACTORS), P(AXIS_ACTORS),
+                self._bins_spec(), P(AXIS_ACTORS), P(AXIS_ACTORS), P(AXIS_ACTORS), P(AXIS_ACTORS),
                 P(AXIS_ACTORS) if es.group_rows_dev is not None else P(),
                 P(AXIS_ACTORS) if es.margins_static is not None else P(),
                 (P(AXIS_ACTORS), P(AXIS_ACTORS)) if es.bounds_dev is not None else P(),
@@ -977,6 +1124,10 @@ class TpuEngine:
             "grower": "dart" if is_dart else self.params.grow_policy,
             "hist_quant": self.cfg.hist_quant,
             "sampling": samp.policy if samp is not None else "none",
+            # feature-axis mesh extent: (R, C) programs are legitimately
+            # different from (R, 1) ones and must not share a cross-world
+            # identity group; 2D programs group with each other across R
+            "feature_parallel": int(self.feature_parallel),
             "n_outputs": int(self.n_outputs),
             # program-shape coordinates: two engines differing here trace
             # legitimately different programs and must not share a
@@ -1077,7 +1228,7 @@ class TpuEngine:
             step,
             mesh=self.mesh,
             in_specs=(
-                P(AXIS_ACTORS),  # bins
+                self._bins_spec(),  # bins
                 P(AXIS_ACTORS),  # valid
                 P(AXIS_ACTORS),  # label
                 P(AXIS_ACTORS),  # weight
@@ -1147,7 +1298,7 @@ class TpuEngine:
             run,
             mesh=self.mesh,
             in_specs=(
-                P(AXIS_ACTORS),
+                self._bins_spec(),
                 P(AXIS_ACTORS),
                 P(AXIS_ACTORS),
                 P(AXIS_ACTORS),
@@ -1574,8 +1725,12 @@ class TpuEngine:
         dart keeps a capacity-padded device forest sized to the ORIGINAL
         total_rounds and recomputes margins from tree weights each round;
         resetting that mid-flight is not supported — the driver falls back
-        to the restart-from-checkpoint path instead."""
-        return not self.dart
+        to the restart-from-checkpoint path instead. A 2D row x feature
+        mesh (feature_parallel > 1) likewise falls back to the legacy
+        restart path: the elastic shrink/grow machinery reshapes the ROW
+        axis only, and re-laying feature tiles over a changed world is not
+        supported until 2D reshard lands (README "2D mesh sharding")."""
+        return not self.dart and self.feature_parallel == 1
 
     def reset_from_booster(self, shards, evals, init_booster) -> None:
         """Re-shard entry point: reuse this engine (compiled step programs,
@@ -1591,6 +1746,12 @@ class TpuEngine:
         """
         if self.dart:
             raise ValueError("reset_from_booster is not supported with dart")
+        if self.feature_parallel > 1:
+            raise ValueError(
+                "reset_from_booster is not supported with "
+                "feature_parallel > 1 (2D meshes use the legacy restart "
+                "path; see can_reshard)."
+            )
         x, _label, _weight, base_margin, _qid, _lo, _hi = _concat_shards(shards)
         if x.shape[0] != self._local_rows or x.shape[1] != self.n_features:
             raise ValueError(
@@ -1932,16 +2093,21 @@ class TpuEngine:
         import functools
 
         from xgboost_ray_tpu.ops.grow import empty_tree, route_right_binned
-        from xgboost_ray_tpu.ops.histogram import build_histogram
         from xgboost_ray_tpu.ops.split import find_splits
 
         tracer = tracer if tracer is not None else obs.get_tracer()
         n_local = self.pad_to // self.n_devices  # one shard's row block
-        n_feat = self.n_features
+        # per-chip feature tile width (== F on the 1D mesh)
+        n_feat = (
+            self._f_padded // self.feature_parallel
+            if self.feature_parallel > 1
+            else self.n_features
+        )
         depth = self.cfg.max_depth
         max_bin = self.params.max_bin
         nbt = max_bin + 1
-        impl = self.cfg.hist_impl
+        provider = self.cfg.hist_provider()
+        impl = provider.name
         spec = sampling.spec_from_params(self.params)
         m = n_local if spec is None else sampling.row_budget(n_local, spec)
 
@@ -2015,11 +2181,9 @@ class TpuEngine:
             )
             hist_fn = jax.jit(
                 functools.partial(
-                    build_histogram,
+                    provider.build,
                     n_nodes=build_nodes,
                     n_bins_total=nbt,
-                    impl=impl,
-                    chunk=self.cfg.hist_chunk,
                 )
             )
             c, e = fenced(hist_fn, bins_m, gh_m, pos)

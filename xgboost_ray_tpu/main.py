@@ -746,7 +746,10 @@ def _train(
                         for s in eshards
                     ]
                 evals_in.append((eshards, name))
-        trial_devices = _resolve_mesh_devices(len(world_actors), ray_params)
+        # 2D row x feature mesh: the engine needs R x C device slots (C=1
+        # keeps the legacy R-slot request byte for byte)
+        mesh_slots = len(world_actors) * max(1, parsed.feature_parallel)
+        trial_devices = _resolve_mesh_devices(mesh_slots, ray_params)
         if parsed.booster == "gblinear":
             from xgboost_ray_tpu.linear import LinearEngine
 
@@ -1092,6 +1095,12 @@ def _train(
         _stop_profile_if_running()  # clear any trace leaked by a prior abort
         jax.profiler.start_trace(profile_dir)
     round_times = state.additional_results.setdefault("round_times_s", [])
+    # true per-dispatch wall times: one entry per compiled dispatch — a
+    # fused scan chunk OR a single per-round step. round_times_s keeps its
+    # historical shape (a fused chunk contributes its MEAN replicated per
+    # round, which hides per-chunk variance); consumers that want the real
+    # distribution read chunk_times_s (bench.py records both).
+    chunk_times = state.additional_results.setdefault("chunk_times_s", [])
     stop_requested = False
     last_status = time.time()
 
@@ -1137,7 +1146,9 @@ def _train(
                     raise
                 completed = engine_base + engine.num_round_trees
                 continue
-            round_times.extend([(time.time() - chunk_started) / n] * n)
+            chunk_wall = time.time() - chunk_started
+            chunk_times.append({"rounds": n, "seconds": round(chunk_wall, 6)})
+            round_times.extend([chunk_wall / n] * n)
             state.rounds_this_attempt += n
             _mark_recovered(state)
             for ri, round_metrics in enumerate(chunk_results):
@@ -1239,7 +1250,9 @@ def _train(
             completed += 1
             state.rounds_this_attempt += 1
             _mark_recovered(state)
-            round_times.append(time.time() - round_started)
+            round_wall = time.time() - round_started
+            round_times.append(round_wall)
+            chunk_times.append({"rounds": 1, "seconds": round(round_wall, 6)})
 
             # custom metric (feval) computed per process on its local rows,
             # then combined as a weighted mean across processes (the

@@ -37,9 +37,6 @@ import jax
 import jax.numpy as jnp
 
 from xgboost_ray_tpu.ops.histogram import (
-    build_histogram,
-    hist_onehot,
-    hist_partition_presorted,
     select_small_child_rows,
     node_sums,
     update_partition_order,
@@ -48,6 +45,7 @@ from xgboost_ray_tpu.ops.histogram import (
 from xgboost_ray_tpu.ops.split import (
     SplitParams,
     bounded_weight,
+    elect_across_feature_shards,
     find_splits,
     leaf_weight,
 )
@@ -86,6 +84,48 @@ def cat_mask_const(cat_features: tuple, num_features: int):
         .at[jnp.asarray(cat_features, jnp.int32)]
         .set(True)
     )
+
+
+def fshard_local_views(fshard, cat_features, num_features, feat_has_missing,
+                       feature_mask):
+    """Global-vs-local per-feature state for one feature shard — the ONE
+    derivation both growers share.
+
+    Returns ``(cat_mask_global, cat_mask_local, fhm_local, fmask_local,
+    f_global_max)``: the GLOBAL (padded) categorical mask for row routing,
+    its local slice plus the local feat-has-missing / feature-mask slices
+    for the split search, and the max valid global feature index.
+
+    Padded columns (global index >= ``fshard.f_real``) are masked OUT of
+    the local split search explicitly: they bin entirely to the missing
+    bucket, which scores -inf for any ``min_child_weight > 0``, but at
+    ``min_child_weight=0`` an empty child passes the hessian gate and the
+    pad column's gain is f32 rounding noise around 0 — electable, which
+    would break (R,1)<->(R,C) parity and emit a split on a nonexistent
+    feature. The mask closes that hole for every SplitParams setting.
+    """
+    cat_mask = cat_mask_const(cat_features, fshard.f_padded)
+    cat_mask_local = (
+        None if cat_mask is None
+        else fshard.slice_cols(cat_mask, num_features)
+    )
+    fhm_local = (
+        None if feat_has_missing is None
+        else fshard.slice_cols(feat_has_missing, num_features)
+    )
+    fmask_local = (
+        None if feature_mask is None
+        else fshard.slice_cols(feature_mask, num_features)
+    )
+    if fshard.f_padded != fshard.f_real:
+        real_cols = (
+            fshard.offset(num_features)
+            + jnp.arange(num_features, dtype=jnp.int32)
+        ) < fshard.f_real
+        fmask_local = (
+            real_cols if fmask_local is None else (fmask_local & real_cols)
+        )
+    return cat_mask, cat_mask_local, fhm_local, fmask_local, fshard.f_padded - 1
 
 
 def sample_feature_mask(
@@ -169,6 +209,18 @@ class GrowConfig:
     def heap_size(self) -> int:
         return (1 << (self.max_depth + 1)) - 1
 
+    def hist_provider(self):
+        """Resolve (hist_impl, hist_precision, hist_chunk) into the one
+        :class:`~xgboost_ray_tpu.ops.provider.HistogramProvider` object
+        every build in this tree dispatches through — the protocol that
+        replaced the per-site string branching."""
+        from xgboost_ray_tpu.ops.provider import resolve_hist_provider
+
+        return resolve_hist_provider(
+            self.hist_impl, precision=self.hist_precision,
+            chunk=self.hist_chunk,
+        )
+
 
 class Tree(NamedTuple):
     """One decision tree in padded-heap layout; all arrays [heap_size]."""
@@ -218,6 +270,7 @@ def build_tree(
     feat_has_missing: Optional[jnp.ndarray] = None,  # [F] bool, global
     hist_allreduce: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = None,
     ar_counter=None,  # AllreduceBytes: scan-scoped byte accounting
+    fshard=None,  # ops.provider.FeatureShard on a 2D row x feature mesh
 ):
     """Grow one tree. Returns (Tree, row_value[N]) — row_value is the leaf
     value each row receives (learning-rate scaled), used to update margins
@@ -228,7 +281,15 @@ def build_tree(
     ``cfg.hist_quant``). The small exact reductions — per-child row counts
     and final-level node sums — always go through ``allreduce``, so leaf
     weights and the sibling-subtraction child choice never carry
-    quantization error. Defaults to ``allreduce`` when not given."""
+    quantization error. Defaults to ``allreduce`` when not given.
+
+    With ``fshard`` (``feature_parallel`` > 1), ``bins`` is this chip's
+    [N_shard, F_pad/C] feature tile and ``cuts``/``feat_has_missing``/
+    ``feature_mask`` are GLOBAL (feature-padded) arrays: histograms and the
+    split search run over the local tile (the psums above still ride the
+    actors axis only), the per-node winner is elected across the feature
+    axis (``elect_across_feature_shards``), and the winning feature's bin
+    column is owner-broadcast so row routing stays O(rows)."""
     hist_ar = hist_allreduce if hist_allreduce is not None else allreduce
     if cfg.grow_policy == "lossguide":
         from xgboost_ray_tpu.ops.grow_lossguide import build_tree_lossguide
@@ -242,13 +303,37 @@ def build_tree(
             feat_has_missing=feat_has_missing,
             hist_allreduce=hist_ar,
             ar_counter=ar_counter,
+            fshard=fshard,
         )
     n, num_features = bins.shape
     nbt = cfg.max_bin + 1
     lr = cfg.split.learning_rate
     missing_bin = cfg.max_bin
+    provider = cfg.hist_provider()
 
-    cat_mask = cat_mask_const(cfg.cat_features, num_features)
+    if fshard is None:
+        cat_mask = cat_mask_const(cfg.cat_features, num_features)
+        cat_mask_local = cat_mask
+        fhm_local = feat_has_missing
+        fmask_tree = feature_mask
+        f_global_max = num_features - 1
+    else:
+        # params.py gates the combinations whose per-level state is
+        # global-F; enforce here too for direct build_tree callers
+        if (colsample_bylevel < 1.0 or colsample_bynode < 1.0
+                or any(cfg.monotone_constraints)
+                or cfg.interaction_constraints):
+            raise NotImplementedError(
+                "per-level/per-node column sampling and constraints are "
+                "not supported with feature_parallel > 1"
+            )
+        # global routing view vs local split-search view of per-feature
+        # state (shared derivation incl. the pad-column mask)
+        (cat_mask, cat_mask_local, fhm_local, fmask_tree,
+         f_global_max) = fshard_local_views(
+            fshard, cfg.cat_features, num_features, feat_has_missing,
+            feature_mask,
+        )
 
     tree = empty_tree(cfg.heap_size)
     pos = jnp.zeros((n,), jnp.int32)
@@ -292,9 +377,9 @@ def build_tree(
         ic_used = jnp.zeros((1, num_features), bool)
         ic_has_used = jnp.zeros((1,), bool)
 
-    # partition-based impls keep rows sorted by node across levels with an
-    # O(N) stable segment split (no per-level argsort)
-    track_order = cfg.hist_impl in ("partition", "mixed")
+    # partition-based providers keep rows sorted by node across levels with
+    # an O(N) stable segment split (no per-level argsort)
+    track_order = provider.wants_order
     order = counts = None
     if track_order:
         order = jnp.arange(n, dtype=jnp.int32)
@@ -341,12 +426,13 @@ def build_tree(
             counts_live = packed[:, 2]
 
         def _build(gh_b, pos_b, order_b, counts_b, nn, rows_sel=None):
-            """One histogram build over nn node slots with the configured impl.
+            """One histogram build over nn node slots via the provider.
 
             ``rows_sel`` is a compacted row-id view into the FULL bins/gh
-            (sentinel n for unused slots). Presorted paths consume it directly
-            as the row order — the padded-block gather is then the only copy;
-            gather-based paths materialize the selection first.
+            (sentinel n for unused slots). Presorted providers consume it
+            directly as the row order — the padded-block gather is then the
+            only copy; gather-based providers materialize the selection
+            first (``ops.provider._gather_rows``).
 
             The missing bucket is reconstructed by subtraction (node_total -
             sum of regular bins), so with hist_precision="fast" the bf16
@@ -356,42 +442,11 @@ def build_tree(
             missing mass from steering the learned default direction.
             """
             return zero_phantom_missing(
-                _build_raw(gh_b, pos_b, order_b, counts_b, nn, rows_sel),
-                feat_has_missing,
-            )
-
-        def _build_raw(gh_b, pos_b, order_b, counts_b, nn, rows_sel=None):
-            def gathered():
-                if rows_sel is None:
-                    return bins, gh_b
-                rows_c = jnp.minimum(rows_sel, n - 1)
-                ok = (rows_sel < n)[:, None].astype(gh_b.dtype)
-                return bins[rows_c], gh_b[rows_c] * ok
-
-            order_in = order_b if rows_sel is None else rows_sel
-
-            def presorted():
-                return hist_partition_presorted(
-                    bins, gh_b, order_in, counts_b, nn, nbt,
-                    precision=cfg.hist_precision,
-                )
-
-            if cfg.hist_impl == "mixed":
-                # measured on v5e (1M x 28 x 256): one-hot wins at tiny node
-                # fan-out (cost scales with nn), the fused block kernel is
-                # flat beyond; einsum fallback off-TPU
-                if nn <= 2:
-                    bins_g, gh_g = gathered()
-                    return hist_onehot(bins_g, gh_g, pos_b, nn, nbt,
-                                       chunk=cfg.hist_chunk,
-                                       precision=cfg.hist_precision)
-                return presorted()
-            if track_order and cfg.hist_impl == "partition":
-                return presorted()
-            bins_g, gh_g = gathered()
-            return build_histogram(
-                bins_g, gh_g, pos_b, nn, nbt, impl=cfg.hist_impl,
-                chunk=cfg.hist_chunk, precision=cfg.hist_precision,
+                provider.build(
+                    bins, gh_b, pos_b, nn, nbt,
+                    order=order_b, counts=counts_b, rows_sel=rows_sel,
+                ),
+                fhm_local,
             )
 
         if cfg.sibling_subtract and d > 0 and prev_hist is not None:
@@ -476,11 +531,18 @@ def build_tree(
         # weights -g/(h+lambda); the packed exact psum above keeps node
         # totals full-precision while only the split *search* sees
         # quantized bin sums
-        node_gh = (
-            node_gh_exact if exact_totals else hist[:, 0, :, :].sum(axis=1)
-        )
+        if exact_totals:
+            node_gh = node_gh_exact
+        else:
+            node_gh = hist[:, 0, :, :].sum(axis=1)
+            if fshard is not None:
+                # each shard's column-0 readout sums a DIFFERENT feature's
+                # buckets (same value up to f32 rounding); leaf weights must
+                # be identical on every chip, so global feature 0's owner —
+                # the column the (R, 1) program reads — wins
+                node_gh = fshard.bcast_from_shard0(node_gh)
 
-        fmask = feature_mask
+        fmask = fmask_tree
         if colsample_bylevel < 1.0 and level_rng is not None:
             k = jax.random.fold_in(jax.random.fold_in(level_rng, SALT_BYLEVEL), d)
             lmask = sample_feature_mask(
@@ -510,8 +572,17 @@ def build_tree(
                 fmask = (fmask[None, :] if fmask.ndim == 1 else fmask) & allowed
 
         sp = find_splits(hist, node_gh, cfg.split, feature_mask=fmask,
-                         cat_mask=cat_mask, monotone=mono_arr,
+                         cat_mask=cat_mask_local, monotone=mono_arr,
                          node_lower=lower, node_upper=upper)
+        if fshard is not None:
+            # the per-shard winner covers only this chip's feature slice;
+            # one tiny per-node record gather over the feature axis elects
+            # the global split (first-max tie-break — bitwise the (R, 1)
+            # argmax)
+            sp = elect_across_feature_shards(
+                sp, fshard.offset(num_features), cfg.max_bin, cfg.split,
+                fshard.axis, counter=fshard.counter,
+            )
         valid_split = sp.valid & active
         if mono_on:
             node_value = lr * bounded_weight(
@@ -523,7 +594,7 @@ def build_tree(
             )
         is_new_leaf = active & ~valid_split
 
-        fsafe = jnp.clip(sp.feature, 0, num_features - 1)
+        fsafe = jnp.clip(sp.feature, 0, f_global_max)
         thr = cuts[fsafe, jnp.clip(sp.split_bin, 0, cfg.max_bin - 2)]
         sl = slice(base, base + n_nodes)
         tree = tree._replace(
@@ -545,7 +616,14 @@ def build_tree(
         done = done | newly_leafed
 
         f_of_row = fsafe[pos]
-        b = jnp.take_along_axis(bins.astype(jnp.int32), f_of_row[:, None], axis=1)[:, 0]
+        if fshard is None:
+            b = jnp.take_along_axis(
+                bins.astype(jnp.int32), f_of_row[:, None], axis=1
+            )[:, 0]
+        else:
+            # winning feature's bin column, owner-broadcast over the
+            # feature axis: one [N] collective — O(rows), not O(rows x F)
+            b = fshard.bin_column(bins, f_of_row)
         go_right = route_right_binned(
             b, sp.split_bin[pos], sp.default_left[pos],
             None if cat_mask is None else cat_mask[f_of_row], missing_bin,
@@ -647,6 +725,33 @@ def predict_tree_binned(
     for _ in range(max_depth):
         f = jnp.clip(tree.feature[idx], 0, num_features - 1)
         bv = jnp.take_along_axis(b32, f[:, None], axis=1)[:, 0]
+        go_right = route_right_binned(
+            bv, tree.split_bin[idx], tree.default_left[idx],
+            None if cat_mask is None else cat_mask[f], missing_bin,
+        )
+        nxt = 2 * idx + 1 + go_right.astype(jnp.int32)
+        idx = jnp.where(tree.is_leaf[idx], idx, nxt)
+    return tree.value[idx]
+
+
+def predict_tree_binned_fsharded(
+    tree: Tree, bins: jnp.ndarray, max_depth: int, missing_bin: int,
+    fshard, cat_features: tuple = (),
+) -> jnp.ndarray:
+    """``predict_tree_binned`` over a feature-sharded [N, F_pad/C] tile.
+
+    The tree's split features are global indices, so each depth step
+    owner-broadcasts the needed bin column across the feature axis (one
+    [N] collective per step — the O(rows x depth) cost the 2D mesh pays
+    for eval-set / sampled-build margin walks instead of replicating F).
+    Routing state (idx) stays identical on every feature shard.
+    """
+    n = bins.shape[0]
+    idx = jnp.zeros((n,), jnp.int32)
+    cat_mask = cat_mask_const(cat_features, fshard.f_padded)
+    for _ in range(max_depth):
+        f = jnp.clip(tree.feature[idx], 0, fshard.f_padded - 1)
+        bv = fshard.bin_column(bins, f)
         go_right = route_right_binned(
             bv, tree.split_bin[idx], tree.default_left[idx],
             None if cat_mask is None else cat_mask[f], missing_bin,

@@ -33,6 +33,7 @@ from xgboost_ray_tpu.ops.grow import (
     Tree,
     cat_mask_const,
     empty_tree,
+    fshard_local_views,
     route_right_binned,
 )
 from xgboost_ray_tpu.ops.histogram import (
@@ -40,7 +41,11 @@ from xgboost_ray_tpu.ops.histogram import (
     node_sums,
     zero_phantom_missing,
 )
-from xgboost_ray_tpu.ops.split import find_splits, leaf_weight
+from xgboost_ray_tpu.ops.split import (
+    elect_across_feature_shards,
+    find_splits,
+    leaf_weight,
+)
 
 
 def build_tree_lossguide(
@@ -57,13 +62,18 @@ def build_tree_lossguide(
     hist_allreduce: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = None,
     ar_counter=None,  # AllreduceBytes: the scan body traces once, runs
     #   leaves-1 times — the repeated() scope keeps byte accounting exact
+    fshard=None,  # ops.provider.FeatureShard on a 2D row x feature mesh
 ):
     """Grow one leaf-wise tree. Returns (Tree, row_value[N]) — the same
     contract as ``build_tree`` so the engine's round step is policy-blind.
 
     ``hist_allreduce`` merges the per-step 2-node histogram (may be
     quantized per ``cfg.hist_quant``); exact node totals ride ``allreduce``
-    when quantization is on, mirroring the depthwise grower."""
+    when quantization is on, mirroring the depthwise grower. With
+    ``fshard`` the per-step histogram/split search covers this chip's
+    feature tile and the step's winner is elected over the feature axis,
+    mirroring ``build_tree``'s 2D contract (bins local, cuts/
+    feat_has_missing/feature_mask global feature-padded)."""
     hist_ar = hist_allreduce if hist_allreduce is not None else allreduce
     n, num_features = bins.shape
     nbt = cfg.max_bin + 1
@@ -72,18 +82,33 @@ def build_tree_lossguide(
     heap = cfg.heap_size
     leaves = max(1, int(cfg.max_leaves))
     n_ent = 2 * leaves - 1
-    cat_mask = cat_mask_const(cfg.cat_features, num_features)
+    if fshard is None:
+        cat_mask = cat_mask_const(cfg.cat_features, num_features)
+        cat_mask_local = cat_mask
+        fhm_local = feat_has_missing
+        fmask_local = feature_mask
+        f_global_max = num_features - 1
+    else:
+        # shared global-vs-local derivation (incl. the pad-column mask)
+        (cat_mask, cat_mask_local, fhm_local, fmask_local,
+         f_global_max) = fshard_local_views(
+            fshard, cfg.cat_features, num_features, feat_has_missing,
+            feature_mask,
+        )
 
     def _hist(gh_b, pos_b, nn):
         # node totals downstream are read from the zeroed histogram's
         # feature-0 row, so under hist_precision="fast" they carry the
         # regular bins' bf16 rounding — the SAME accepted contract as the
-        # depthwise grower's node_gh (see ops/grow.py's node_gh comment)
+        # depthwise grower's node_gh (see ops/grow.py's node_gh comment).
+        # Always the one-hot MXU pass: the per-step 2-node fan-out is the
+        # regime where every provider would pick it anyway (params.py pins
+        # hist_impl to auto|onehot for lossguide).
         h = hist_onehot(
             bins, gh_b, pos_b, nn, nbt,
             chunk=cfg.hist_chunk, precision=cfg.hist_precision,
         )
-        return zero_phantom_missing(hist_ar(h), feat_has_missing)
+        return zero_phantom_missing(hist_ar(h), fhm_local)
 
     def _node_gh(hist, gh_b, pos_b, nn):
         # [nn, 2] totals: exact psum when the histogram wire is quantized
@@ -97,16 +122,26 @@ def build_tree_lossguide(
         )
         if quantized:
             return allreduce(node_sums(gh_b, pos_b, nn))
-        return hist[:, 0, :, :].sum(axis=1)
+        totals = hist[:, 0, :, :].sum(axis=1)
+        if fshard is not None:
+            # column-0 readout differs per feature shard in f32 rounding;
+            # global feature 0's owner wins (see build_tree's node_gh)
+            totals = fshard.bcast_from_shard0(totals)
+        return totals
 
     tree = empty_tree(heap)
     pos = jnp.zeros((n,), jnp.int32)
 
     # --- root: evaluate its best split, seed the frontier -------------------
-    root_hist = _hist(gh, pos, 1)  # [1, F, nbt, 2]
+    root_hist = _hist(gh, pos, 1)  # [1, F_local, nbt, 2]
     root_gh = _node_gh(root_hist, gh, pos, 1)  # [1, 2]
     sp0 = find_splits(root_hist, root_gh, cfg.split,
-                      feature_mask=feature_mask, cat_mask=cat_mask)
+                      feature_mask=fmask_local, cat_mask=cat_mask_local)
+    if fshard is not None:
+        sp0 = elect_across_feature_shards(
+            sp0, fshard.offset(num_features), cfg.max_bin, cfg.split,
+            fshard.axis, counter=fshard.counter,
+        )
     root_value = lr * leaf_weight(root_gh[:, 0], root_gh[:, 1], cfg.split)[0]
     tree = tree._replace(
         is_leaf=tree.is_leaf.at[0].set(True),
@@ -136,7 +171,7 @@ def build_tree_lossguide(
         do_split = jnp.isfinite(scores[i])
 
         slot = ent_pos[i]
-        feat = jnp.clip(ent_feat[i], 0, num_features - 1)
+        feat = jnp.clip(ent_feat[i], 0, f_global_max)
         sbin = ent_bin[i]
         dl = ent_dl[i]
         thr = cuts[feat, jnp.clip(sbin, 0, cfg.max_bin - 2)]
@@ -158,7 +193,11 @@ def build_tree_lossguide(
 
         # route ONLY this leaf's rows
         sel = (pos == slot) & do_split
-        bv = jnp.take_along_axis(b32, jnp.full((n, 1), feat), axis=1)[:, 0]
+        if fshard is None:
+            bv = jnp.take_along_axis(b32, jnp.full((n, 1), feat), axis=1)[:, 0]
+        else:
+            # split feature is a global index; owner-broadcast its column
+            bv = fshard.bin_column(bins, jnp.full((n,), feat))
         go_right = route_right_binned(
             bv, sbin, dl,
             None if cat_mask is None else cat_mask[feat], missing_bin,
@@ -169,10 +208,15 @@ def build_tree_lossguide(
         # the two children's histograms + best splits
         gh_sel = gh * sel[:, None].astype(gh.dtype)
         pos2 = go_right.astype(jnp.int32)
-        hist2 = _hist(gh_sel, pos2, 2)  # [2, F, nbt, 2]
+        hist2 = _hist(gh_sel, pos2, 2)  # [2, F_local, nbt, 2]
         child_gh = _node_gh(hist2, gh_sel, pos2, 2)  # [2, 2]
         sp2 = find_splits(hist2, child_gh, cfg.split,
-                          feature_mask=feature_mask, cat_mask=cat_mask)
+                          feature_mask=fmask_local, cat_mask=cat_mask_local)
+        if fshard is not None:
+            sp2 = elect_across_feature_shards(
+                sp2, fshard.offset(num_features), cfg.max_bin, cfg.split,
+                fshard.axis, counter=fshard.counter,
+            )
         child_slots = jnp.stack([l_slot, r_slot])
         # children may split further only while their own children fit the
         # depth-bounded heap
@@ -225,7 +269,14 @@ def build_tree_lossguide(
             if ar_counter is not None
             else contextlib.nullcontext()
         )
-        with scope:
+        # the feature-axis counter (election gather + bin-column psum in
+        # the scan body) multiplies by the step count too
+        fscope = (
+            fshard.counter.repeated(leaves - 1)
+            if fshard is not None and fshard.counter is not None
+            else contextlib.nullcontext()
+        )
+        with scope, fscope:
             carry, _ = jax.lax.scan(body, carry, jnp.arange(leaves - 1))
         tree, pos = carry[0], carry[1]
 

@@ -127,6 +127,13 @@ class AllreduceBytes:
 
         return scope()
 
+    def absorb(self, other: Optional["AllreduceBytes"]) -> None:
+        """Fold another counter's total into this one (e.g. the feature
+        axis's own-ring-extent counter on a 2D mesh) so ``as_scalar`` stays
+        the single emission point. ``None`` is a no-op."""
+        if other is not None:
+            self.total += int(other.total)
+
     def as_scalar(self) -> jnp.ndarray:
         """The total as a device int32 (clamped; ~2 GB/round is beyond any
         real per-round payload)."""
@@ -641,22 +648,13 @@ def build_histogram(
     chunk: int = 8192,
     precision: str = "highest",
 ) -> jnp.ndarray:
-    if impl == "onehot":
-        return hist_onehot(bins, gh, pos, n_nodes, n_bins_total, chunk=chunk,
-                           precision=precision)
-    if impl == "partition":
-        return hist_partition(bins, gh, pos, n_nodes, n_bins_total,
-                              precision=precision)
-    if impl == "mixed":
-        # shallow levels: node axis is cheap in the one-hot width; deep
-        # levels: row partitioning keeps FLOPs independent of node count
-        if n_nodes <= 4:
-            return hist_onehot(bins, gh, pos, n_nodes, n_bins_total,
-                               chunk=chunk, precision=precision)
-        return hist_partition(bins, gh, pos, n_nodes, n_bins_total,
-                              precision=precision)
-    if impl != "scatter":
-        # defense-in-depth behind parse_params: a typo'd or removed impl
-        # (e.g. the deleted 'pallas') must not silently become scatter
-        raise ValueError(f"unknown histogram impl {impl!r}")
-    return hist_scatter(bins, gh, pos, n_nodes, n_bins_total)
+    """Back-compat shim over the histogram-provider registry: resolves
+    ``impl`` through ``ops.provider`` (the ONE string -> strategy point)
+    and builds with no maintained row layout. The growers dispatch through
+    a resolved :class:`~xgboost_ray_tpu.ops.provider.HistogramProvider`
+    directly; this entry point serves standalone callers (profiling,
+    micro-benchmarks, tests)."""
+    from xgboost_ray_tpu.ops.provider import resolve_hist_provider
+
+    provider = resolve_hist_provider(impl, precision=precision, chunk=chunk)
+    return provider.build(bins, gh, pos, n_nodes, n_bins_total)

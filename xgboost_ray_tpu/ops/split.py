@@ -164,3 +164,53 @@ def find_splits(
     )[:, 0]
     valid = jnp.isfinite(best_gain) & (best_gain > p.gamma)
     return LevelSplits(gain=best_gain, feature=feat, split_bin=sbin, default_left=dl, valid=valid)
+
+
+def elect_across_feature_shards(
+    sp: LevelSplits,  # per-shard best splits, feature indices LOCAL
+    f_offset,  # this shard's first global feature index (traced)
+    n_bins: int,  # present bins (== max_bin; candidates are n_bins - 1)
+    p: SplitParams,
+    axis_name: str,  # the feature mesh axis
+    counter=None,  # AllreduceBytes with the feature-axis ring extent
+) -> LevelSplits:
+    """Elect the global best split per node from each feature shard's local
+    winner (the 2D row x feature mesh's split step).
+
+    One tiny ``[n_nodes, 3]`` all_gather over the feature axis carries
+    (gain, flat candidate index, default_left) per node; the winner is the
+    max gain with ties broken by the LOWEST global flat index — exactly the
+    first-max rule the single-shard ``find_splits`` argmax applies over the
+    full flattened (feature, bin) axis, so a (R, C) mesh elects the
+    bitwise-identical split a (R, 1) mesh does. The flat index rides as
+    f32 (exact below 2^24; the engine rejects feature_parallel configs
+    whose padded F x (max_bin - 1) exceeds that), so the record is a single
+    dtype-uniform payload and the gather is ONE collective.
+    """
+    n_cand = n_bins - 1
+    feat_g = f_offset + sp.feature
+    flat = (feat_g * n_cand + sp.split_bin).astype(jnp.float32)
+    payload = jnp.stack(
+        [sp.gain, flat, sp.default_left.astype(jnp.float32)], axis=1
+    )  # [n_nodes, 3]
+    if counter is not None:
+        counter.add_all_gather(payload)
+    allp = jax.lax.all_gather(payload, axis_name)  # [C, n_nodes, 3]
+    gains, flats, dls = allp[..., 0], allp[..., 1], allp[..., 2]
+    best_gain = jnp.max(gains, axis=0)  # [n_nodes]
+    # among shards achieving the max, the lowest flat index wins (an
+    # all--inf node keeps shard 0's placeholder record, matching the 1D
+    # argmax-over--inf result; `valid` is False there either way)
+    tie_key = jnp.where(gains == best_gain[None, :], flats, jnp.inf)
+    win = jnp.argmin(tie_key, axis=0)  # [n_nodes]
+    flat_w = jnp.take_along_axis(flats, win[None, :], axis=0)[0]
+    flat_w = flat_w.astype(jnp.int32)
+    dl_w = jnp.take_along_axis(dls, win[None, :], axis=0)[0] > 0.5
+    valid = jnp.isfinite(best_gain) & (best_gain > p.gamma)
+    return LevelSplits(
+        gain=best_gain,
+        feature=(flat_w // n_cand).astype(jnp.int32),
+        split_bin=(flat_w % n_cand).astype(jnp.int32),
+        default_left=dl_w,
+        valid=valid,
+    )

@@ -119,6 +119,12 @@ class TrainParams:
     # only split on features sharing a constraint set with EVERY feature
     # already used on its root path (xgboost semantics)
     interaction_constraints: tuple = ()
+    # feature-parallel mesh extent C: the engine's device mesh becomes the 2D
+    # (num_actors, C) row x feature grid and each chip builds/allreduces only
+    # its [N/R, F/C] histogram tile (psum over the actors axis only; a tiny
+    # per-node best-split election rides the features axis). C=1 (default)
+    # keeps the 1D row mesh and traces the exact legacy program.
+    feature_parallel: int = 1
 
 
 def cat_feature_indices(feature_types: Optional[Sequence[Any]]) -> tuple:
@@ -265,8 +271,14 @@ def parse_params(params: Optional[Dict[str, Any]]) -> TrainParams:
                 pass
         setattr(out, name, value)
 
-    if out.hist_impl not in ("auto", "scatter", "onehot", "partition",
-                             "mixed"):
+    # hist_impl names resolve through the pluggable histogram-provider
+    # registry (ops/provider.py): built-ins plus anything registered via
+    # register_histogram_provider (the bench A/B hook). The import is
+    # function-level so this module stays importable pre-jax.
+    from xgboost_ray_tpu.ops.provider import available_hist_impls
+
+    known_impls = available_hist_impls()
+    if out.hist_impl not in known_impls:
         extra = ""
         if out.hist_impl == "pallas":
             # removed in r5: on-chip measurement showed the hand-written
@@ -277,8 +289,8 @@ def parse_params(params: Optional[Dict[str, Any]]) -> TrainParams:
                 "formulation on-chip; 'mixed' covers its niche."
             )
         raise ValueError(
-            f"Unknown hist_impl {out.hist_impl!r}; use auto | scatter | "
-            f"onehot | partition | mixed.{extra}"
+            f"Unknown hist_impl {out.hist_impl!r}; use one of "
+            f"{' | '.join(known_impls)}.{extra}"
         )
 
     if out.hist_quant not in ("none", "int16", "int8"):
@@ -286,6 +298,39 @@ def parse_params(params: Optional[Dict[str, Any]]) -> TrainParams:
             f"Unknown hist_quant {out.hist_quant!r}; use none | int16 | "
             f"int8 (quantized histogram allreduce wire format)."
         )
+
+    if out.feature_parallel is None:
+        out.feature_parallel = 1
+    out.feature_parallel = int(out.feature_parallel)
+    if out.feature_parallel < 1:
+        raise ValueError(
+            f"feature_parallel must be >= 1; got {out.feature_parallel}"
+        )
+    if out.feature_parallel > 1:
+        # the 2D row x feature mesh supports the tree boosters' depthwise and
+        # lossguide growers; combinations whose semantics would need global-F
+        # state per node are gated loudly rather than silently degraded
+        # (the repo's no-silent-fallback invariant)
+        if out.booster in ("dart", "gblinear"):
+            raise NotImplementedError(
+                f"feature_parallel > 1 is not supported with "
+                f"booster={out.booster!r} (dart recomputes margins from the "
+                f"whole forest each round; gblinear has no histogram to "
+                f"shard). Use booster='gbtree'."
+            )
+        for bad, name in (
+            (out.colsample_bylevel < 1.0, "colsample_bylevel"),
+            (out.colsample_bynode < 1.0, "colsample_bynode"),
+            (bool(out.monotone_constraints)
+             and any(out.monotone_constraints), "monotone_constraints"),
+            (bool(out.interaction_constraints), "interaction_constraints"),
+        ):
+            if bad:
+                raise NotImplementedError(
+                    f"{name} is not supported with feature_parallel > 1 yet "
+                    f"(per-level/per-node feature state is global-F); "
+                    f"silently ignoring it would change model semantics."
+                )
 
     # None means "unset" in every xgboost-adjacent API (the sklearn layer
     # filters None for exactly this reason) — normalize explicit Nones back
