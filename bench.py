@@ -95,6 +95,20 @@ OBS_OVERHEAD_RATIO = 1.02
 WIDE_FEATURE_TRIPWIRE_RATIO = 1.2
 WIDE_FEATURE_BYTE_CUT_MIN = 1.5
 
+# low-precision gh plane: flag >20% regressions of the gh_precision='int8'
+# ablation arm's steady per-round time across snapshots — the guard that
+# keeps "int8 gradients are at worst round-time-neutral" from silently
+# rotting into a slow path. The gh-plane byte cut itself is static layout
+# arithmetic certified by rxgbverify (the traced programs really carry the
+# narrow dtype), and carries its own >=3.5x floor inside the section.
+LOW_PRECISION_TRIPWIRE_RATIO = 1.2
+LOW_PRECISION_GH_CUT_MIN = 3.5
+# accuracy gate: quantized-gradient arms must land within this of the f32
+# arm's final logloss (the PR 4 sampling discipline, applied to precision)
+LOW_PRECISION_LOGLOSS_TOL = 5e-4
+# steady-round budget: int8 gh may cost at most this factor of f32 per round
+LOW_PRECISION_ROUND_TIME_MAX = 1.05
+
 
 def _load_latest_bench_record(bench_dir):
     """Newest BENCH_*.json result dict (by round number, then mtime).
@@ -597,6 +611,216 @@ def run_sampling_ablation(x, y, base_params, actors):
         "goss_other_rate": arms["goss"]["other_rate"],
     }
     print(f"[bench] sampling ablation: {out}", file=sys.stderr)
+    return out
+
+
+def low_precision_tripwire(current_lp, prev_rec, prev_name=None,
+                           backend=None,
+                           threshold=LOW_PRECISION_TRIPWIRE_RATIO):
+    """Compare this run's gh_precision='int8' arm steady per-round time
+    against the newest recorded bench's ``low_precision`` section.
+
+    The quantized-gradient analog of ``sampling_round_time_tripwire``:
+    returns ``{prev_per_round_s, prev_record, ratio, fired}`` or None when
+    no comparable record exists. Like-for-like only (config key)."""
+    if not isinstance(current_lp, dict):
+        return None
+    cur = (current_lp.get("int8") or {}).get("per_round_s")
+    if not cur or not isinstance(prev_rec, dict):
+        return None
+    if backend and prev_rec.get("backend") and prev_rec["backend"] != backend:
+        return None
+    prev_lp = prev_rec.get("low_precision")
+    if not isinstance(prev_lp, dict):
+        return None
+    prev = (prev_lp.get("int8") or {}).get("per_round_s")
+    if not prev:
+        return None
+    ratio = float(cur) / float(prev)
+    out = {
+        "prev_per_round_s": round(float(prev), 4),
+        "prev_record": prev_name,
+        "ratio": round(ratio, 3),
+        "fired": False,
+    }
+    if prev_lp.get("config") != current_lp.get("config"):
+        out["config_mismatch"] = True
+        return out
+    if ratio > threshold:
+        out["fired"] = True
+        print(
+            f"[bench] LOW-PRECISION TRIPWIRE: int8-gh per-round time "
+            f"{cur:.4f}s is {ratio:.2f}x the newest recorded run "
+            f"({prev:.4f}s in {prev_name or 'BENCH_*.json'}) — "
+            f">{(threshold - 1) * 100:.0f}% regression. The quantized-"
+            f"gradient mode is rotting into a slow path; investigate "
+            f"before trusting this build's low-precision numbers.",
+            file=sys.stderr,
+        )
+    return out
+
+
+def run_low_precision_ablation(x, y, base_params, actors):
+    """Paired gh-precision ablation on the ambient mesh: f32 vs int16 vs
+    int8 quantized gradients (ROADMAP item 3's measured contract).
+
+    Four arms, fresh and back-to-back (identical environment), each
+    config-identical to the protocol run except ``gh_precision`` — and the
+    f32 reference runs TWICE, bracketing the quantized arms
+    (f32, int16, int8, f32_recheck): same-process round time drifts a few
+    percent over a multi-minute capture (the r4_paired_recheck lesson), so
+    comparing the last arm against the first conflates that drift with the
+    mode under test. Ratios are judged against the bracket MEAN, and the
+    recheck/first ratio is recorded as ``f32_drift_ratio`` so every capture
+    carries its own noise bound. Per arm: steady per-round time (min over
+    the post-compile chunks' true wall times), the static per-shard
+    gh-plane bytes (the
+    memory metric the mode is bought for — int8 must cut
+    >= LOW_PRECISION_GH_CUT_MIN x; rxgbverify certifies the traced
+    programs really carry the narrow dtype), and the final train logloss.
+    The section asserts the shipping contract: both quantized arms within
+    LOW_PRECISION_LOGLOSS_TOL of f32 (judged on UNROUNDED loglosses), and
+    int8 steady-round time <= LOW_PRECISION_ROUND_TIME_MAX x the f32
+    bracket mean, with the budget widened by the capture's own measured
+    f32-vs-f32 drift (a gate tighter than the reference's same-config
+    noise would fire on machine weather, not on the mode)."""
+    from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+    chunk = max(1, int(os.environ.get("RXGB_SCAN_MAX_CHUNK", "10")))
+    # three chunks per arm (one compile-carrying + two steady) so the
+    # steady figure can be the MIN over steady chunks: shared-box
+    # contention only ever inflates a chunk, so the minimum is the
+    # statistic least polluted by co-scheduling hiccups (the timeit
+    # discipline) — medians over a single steady chunk inherit whichever
+    # weather that chunk ran under
+    abl_rounds = int(
+        os.environ.get("BENCH_LOW_PRECISION_ROUNDS", 3 * chunk)
+    )
+    arms = {
+        "f32": {},
+        "int16": {"gh_precision": "int16"},
+        "int8": {"gh_precision": "int8"},
+        "f32_recheck": {},
+    }
+
+    def steady(res, arm_time):
+        """Min steady per-round over the post-compile chunks from the TRUE
+        per-dispatch chunk wall times; falls back to the shared estimator
+        when chunk times are absent (per-round stepping paths)."""
+        chunks = [
+            c["seconds"] / max(1, c["rounds"])
+            for c in (res.get("chunk_times_s") or [])[1:]
+            if isinstance(c, dict) and c.get("rounds")
+        ]
+        if chunks:
+            return min(chunks)
+        return _steady_per_round(
+            res.get("round_times_s"), chunk, arm_time, abl_rounds
+        )
+
+    def binary_logloss(margin):
+        p = 1.0 / (1.0 + np.exp(-np.asarray(margin, np.float64).ravel()))
+        p = np.clip(p, 1e-15, 1 - 1e-15)
+        return float(-np.mean(y * np.log(p) + (1 - y) * np.log1p(-p)))
+
+    out = {"rounds": abl_rounds}
+    ll_exact = {}  # unrounded per-arm loglosses: the tolerance gate's inputs
+    pr_exact = {}  # unrounded per-arm steady times: the round-time gate's
+    #   inputs (stored per_round_s is display — the same discipline as the
+    #   gh-bytes and logloss gates)
+    for name, extra in arms.items():
+        p = dict(base_params)
+        p.update(extra)
+        res = {}
+        t0 = time.time()
+        bst = train(
+            p,
+            RayDMatrix(x, y),
+            num_boost_round=abl_rounds,
+            additional_results=res,
+            ray_params=RayParams(num_actors=actors, checkpoint_frequency=0),
+        )
+        arm_time = time.time() - t0
+        pr_exact[name] = steady(res, arm_time)
+        ll_exact[name] = binary_logloss(bst.predict(x, output_margin=True))
+        arm = {
+            "per_round_s": round(pr_exact[name], 4),
+            "train_time_s": round(arm_time, 2),
+            "final_logloss": round(ll_exact[name], 6),
+        }
+        gh_bytes = res.get("gh_plane_bytes_per_shard")
+        if gh_bytes is not None:
+            arm["gh_plane_bytes_per_shard"] = int(gh_bytes)
+        out[name] = arm
+    # drift-resistant f32 reference: the mean of the two bracket arms (the
+    # int arms ran between them), plus the recheck/first drift bound
+    f32_s = 0.5 * (pr_exact["f32"] + pr_exact["f32_recheck"])
+    drift = 1.0
+    if pr_exact["f32"]:
+        drift = pr_exact["f32_recheck"] / pr_exact["f32"]
+        out["f32_drift_ratio"] = round(drift, 3)
+    if f32_s:
+        out["int16_per_round_vs_f32"] = round(pr_exact["int16"] / f32_s, 3)
+        out["int8_per_round_vs_f32"] = round(pr_exact["int8"] / f32_s, 3)
+        # the budget is widened by the capture's OWN measured same-config
+        # noise (the two f32 arms trained the identical program): a gate
+        # tighter than the drift the reference itself exhibits would fire
+        # on machine weather, not on the mode under test — the
+        # r4_paired_recheck "pair ratio bounds same-env variance" logic
+        budget = LOW_PRECISION_ROUND_TIME_MAX * max(1.0, drift)
+        out["round_time_budget"] = round(budget, 3)
+        out["round_time_ok"] = pr_exact["int8"] / f32_s <= budget
+        if not out["round_time_ok"]:
+            print(
+                f"[bench] LOW-PRECISION ROUND TIME over budget: int8-gh "
+                f"steady round is {out['int8_per_round_vs_f32']}x the f32 "
+                f"bracket mean (budget {LOW_PRECISION_ROUND_TIME_MAX}x "
+                f"widened to {out['round_time_budget']}x by the capture's "
+                f"own f32 drift).",
+                file=sys.stderr,
+            )
+    b_f32 = out["f32"].get("gh_plane_bytes_per_shard")
+    b_int8 = out["int8"].get("gh_plane_bytes_per_shard")
+    if b_f32 and b_int8:
+        # the gate reads the unrounded ratio; the stored value is display
+        out["gh_bytes_cut"] = round(b_f32 / b_int8, 2)
+        out["gh_bytes_cut_ok"] = (b_f32 / b_int8) >= LOW_PRECISION_GH_CUT_MIN
+        if not out["gh_bytes_cut_ok"]:
+            print(
+                f"[bench] LOW-PRECISION GH-PLANE CUT below floor: int8 "
+                f"stores only {out['gh_bytes_cut']}x fewer gh bytes/shard "
+                f"than f32 (floor {LOW_PRECISION_GH_CUT_MIN}x).",
+                file=sys.stderr,
+            )
+    # parity judged on the UNROUNDED per-arm loglosses (the wide_feature
+    # discipline: rounding first can slip a near-miss under the gate)
+    for name in ("int16", "int8"):
+        delta = ll_exact[name] - ll_exact["f32"]
+        out[f"{name}_logloss_delta"] = round(delta, 6)
+        out[f"{name}_logloss_ok"] = abs(delta) <= LOW_PRECISION_LOGLOSS_TOL
+        if not out[f"{name}_logloss_ok"]:
+            print(
+                f"[bench] LOW-PRECISION LOGLOSS drift: {name}-gh final "
+                f"logloss differs from f32 by {out[f'{name}_logloss_delta']} "
+                f"(> {LOW_PRECISION_LOGLOSS_TOL}). Quantized-gradient "
+                f"accuracy is drifting; fall back to gh_precision='float32' "
+                f"until understood.",
+                file=sys.stderr,
+            )
+    out["config"] = {
+        "rows": int(x.shape[0]), "features": int(x.shape[1]),
+        "rounds": abl_rounds, "actors": actors,
+        "max_depth": int(base_params.get("max_depth", 6)),
+        # derived from the arms dict so the recorded config (the tripwire's
+        # like-for-like key) cannot drift from what actually ran; the
+        # bracket design (two f32 arms) is part of the protocol identity
+        # lists, not tuples: the prev record round-trips through JSON and
+        # the tripwire's like-for-like comparison is plain ==
+        "arm_modes": [
+            [k, v.get("gh_precision", "float32")] for k, v in arms.items()
+        ],
+    }
+    print(f"[bench] low-precision ablation: {out}", file=sys.stderr)
     return out
 
 
@@ -1586,6 +1810,20 @@ def run_measurement():
         recheck = r4_paired_recheck(detail)
         if recheck is not None:
             detail["r4_regression_recheck"] = recheck
+
+    # low-precision (gh_precision) ablation: f32 vs int16 vs int8 quantized
+    # gradients on the protocol data — per-round time, the static gh-plane
+    # bytes/shard, and final-logloss deltas with their gates. Default on
+    # for the CPU mesh; opt-in on TPU via BENCH_LOW_PRECISION=1.
+    lp_env = os.environ.get("BENCH_LOW_PRECISION")
+    if lp_env == "1" or (lp_env is None and not on_tpu):
+        lp_section = run_low_precision_ablation(x, y, params, actors)
+        ltrip = low_precision_tripwire(
+            lp_section, prev_rec, prev_name, backend=backend
+        )
+        if ltrip is not None:
+            lp_section["regression_tripwire"] = ltrip
+        detail["low_precision"] = lp_section
 
     # wide-feature (F=2048, CTR-shaped) 1D-vs-2D mesh ablation: (8,1) row
     # sharding vs the (4,2) row x feature mesh, recording per-round time,

@@ -473,3 +473,79 @@ def test_wide_feature_tripwire_skips_incomparable_records():
         cur, rec_none, "x", backend="cpu") is None
     assert bench.wide_feature_round_time_tripwire(None, rec_tpu, "x") is None
     assert bench.wide_feature_round_time_tripwire({}, rec_tpu, "x") is None
+
+
+# ---------------------------------------------------------------------------
+# low-precision (gh_precision) tripwire
+# ---------------------------------------------------------------------------
+
+_LP_CFG = {"rows": 25000, "features": 28, "rounds": 20, "actors": 8,
+           "max_depth": 6,
+           "arm_modes": [["f32", "float32"], ["int16", "int16"],
+                         ["int8", "int8"], ["f32_recheck", "float32"]]}
+
+
+def _lp_section(per_round_int8, cfg=None):
+    return {
+        "rounds": 20,
+        "f32": {"per_round_s": 2.0, "final_logloss": 0.31,
+                "gh_plane_bytes_per_shard": 25000 * 8},
+        "int16": {"per_round_s": 2.0, "final_logloss": 0.31,
+                  "gh_plane_bytes_per_shard": 25000 * 4},
+        "int8": {"per_round_s": per_round_int8, "final_logloss": 0.3102,
+                 "gh_plane_bytes_per_shard": 25000 * 2},
+        "f32_recheck": {"per_round_s": 2.1, "final_logloss": 0.31,
+                        "gh_plane_bytes_per_shard": 25000 * 8},
+        "f32_drift_ratio": 1.05,
+        "gh_bytes_cut": 4.0,
+        "gh_bytes_cut_ok": True,
+        "config": dict(cfg if cfg is not None else _LP_CFG),
+    }
+
+
+def test_low_precision_tripwire_fires_on_int8_round_regression(capsys):
+    rec = {"metric": "m", "backend": "cpu",
+           "low_precision": _lp_section(2.0)}
+    out = bench.low_precision_tripwire(
+        _lp_section(4.0), rec, "BENCH_r06.json", backend="cpu"
+    )
+    assert out is not None and out["fired"]
+    assert out["ratio"] == 2.0
+    assert out["prev_per_round_s"] == 2.0
+    assert "LOW-PRECISION TRIPWIRE" in capsys.readouterr().err
+
+
+def test_low_precision_tripwire_quiet_within_20pct(capsys):
+    rec = {"metric": "m", "backend": "cpu",
+           "low_precision": _lp_section(2.0)}
+    out = bench.low_precision_tripwire(
+        _lp_section(2.3), rec, "x", backend="cpu"
+    )
+    assert out is not None and not out["fired"]
+    assert "LOW-PRECISION TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_low_precision_tripwire_reports_but_never_fires_on_config_mismatch(
+        capsys):
+    other = dict(_LP_CFG, rows=1000)
+    rec = {"metric": "m", "backend": "cpu",
+           "low_precision": _lp_section(2.0, other)}
+    out = bench.low_precision_tripwire(
+        _lp_section(9.0), rec, "x", backend="cpu"
+    )
+    assert out is not None and not out["fired"]
+    assert out["config_mismatch"] is True
+    assert "LOW-PRECISION TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_low_precision_tripwire_skips_incomparable_records():
+    cur = _lp_section(4.0)
+    rec_tpu = {"metric": "m", "backend": "tpu",
+               "low_precision": _lp_section(2.0)}
+    assert bench.low_precision_tripwire(
+        cur, rec_tpu, "x", backend="cpu") is None
+    rec_none = {"metric": "m", "backend": "cpu"}  # pre-gh_precision record
+    assert bench.low_precision_tripwire(
+        cur, rec_none, "x", backend="cpu") is None
+    assert bench.low_precision_tripwire(None, rec_tpu, "x") is None
+    assert bench.low_precision_tripwire({}, rec_tpu, "x") is None

@@ -244,6 +244,11 @@ def test_default_schedules_match_pre_refactor_golden():
     traced = trace_matrix(quick=True)
     out = {}
     for t in traced:
+        if t.record.meta.get("gh_precision", "float32") != "float32":
+            # quantized-gradient rows trace a legitimately different
+            # (integer-wire) program; the pre-PR golden pins the DEFAULT
+            # float32 path only — which must stay byte-equal
+            continue
         key = "%s@world=%s@hq=%s" % (
             t.record.name, t.record.meta.get("world"),
             t.record.meta.get("hist_quant"),
@@ -342,16 +347,17 @@ def test_2d_schedule_pin():
     psums, so feature sharding can never silently re-replicate the
     histogram."""
     traced = _traced_2d()
-    steps = {
+    steps = [t for t in traced if t.record.name == "engine.step"]
+    # the byte-exact golden pins the float32 rows under the historical
+    # name@world@hq keys; the int8-gh 2D row traces the integer wire and is
+    # pinned structurally by the axis loop below instead
+    out = {
         "%s@world=%s@hq=%s" % (
             t.record.name, t.record.meta["world"],
             t.record.meta.get("hist_quant"),
-        ): t
-        for t in traced if t.record.name == "engine.step"
-    }
-    out = {
-        k: [list(s) for s in t.analysis.schedule()]
-        for k, t in steps.items()
+        ): [list(s) for s in t.analysis.schedule()]
+        for t in steps
+        if t.record.meta.get("gh_precision", "float32") == "float32"
     }
     out = json.loads(json.dumps(out))
     with open(os.path.join(_GOLDEN_DIR, "schedules_2d_pin.json")) as fh:
@@ -361,7 +367,8 @@ def test_2d_schedule_pin():
         assert out[key] == golden[key], (
             f"{key}: 2D collective schedule drifted from the pin"
         )
-    for key, t in steps.items():
+    for t in steps:
+        key = t.key()
         for c in t.analysis.collectives:
             axes = set(c.axes)
             assert axes <= {"actors", "features"}, (key, c.describe())

@@ -189,6 +189,29 @@ def test_det001_clean_seeded_and_salted():
     assert codes(findings) == []
 
 
+def test_det001_sr_salt_catalogued_and_neighbors_still_flag():
+    """SALT_SR (stochastic gh rounding, gh_precision) is auto-extracted
+    into the DET001 salt domain — its literal value folds clean without a
+    pragma — while an uncatalogued neighbor value still flags: the domain
+    grew by exactly the declared constant, not by becoming vacuous."""
+    from tools.rxgblint import catalog
+
+    assert 0x51D6 in catalog.salt_values()  # SALT_SR (ops/grow.py)
+    clean = lint("""
+        import jax
+        def f(key):
+            return jax.random.fold_in(key, 0x51D6)
+    """)
+    assert codes(clean) == []
+    flagged = lint("""
+        import jax
+        def f(key):
+            return jax.random.fold_in(key, 0x51D7)
+    """)
+    assert codes(flagged) == ["DET001"]
+    assert "SALT_" in flagged[0].message
+
+
 # ---------------------------------------------------------------------------
 # SYNC001 — host syncs in traced code
 # ---------------------------------------------------------------------------

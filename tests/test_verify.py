@@ -387,6 +387,21 @@ def test_quick_matrix_ships_clean():
     for t in int8_steps:
         assert any(c.prim == "all_to_all" and c.dtype == "int8"
                    for c in t.analysis.collectives)
+    # the gh_precision rows really carry the quantized gradient plane the
+    # VER004 gh sub-checks certify: int8 avals present, and the histogram
+    # merge is the exact int32 psum (not a silent f32 upcast)
+    int8gh_steps = [t for t in traced
+                    if t.record.name == "engine.step"
+                    and t.record.meta.get("gh_precision") == "int8"]
+    assert int8gh_steps
+    for t in int8gh_steps:
+        assert "int8" in t.analysis.dtypes
+        assert any(c.prim == "psum" and c.dtype == "int32"
+                   and len(c.shape) >= 4
+                   for c in t.analysis.collectives)
+        assert not any(c.prim == "psum" and c.dtype == "float32"
+                       and len(c.shape) >= 4
+                       for c in t.analysis.collectives)
 
 
 # ---------------------------------------------------------------------------

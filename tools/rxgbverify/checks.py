@@ -29,9 +29,12 @@ VERIFY_RULES: Dict[str, str] = {
         "(shared with rxgblint SPMD002)"
     ),
     "VER004": (
-        "quantized histogram contract broken: the int8/int16 payload is "
-        "upcast before the wire collective, or the f32 fallback psum of "
-        "the full histogram survives in a quantized program"
+        "quantized precision-flow contract broken: a hist_quant int8/int16 "
+        "payload is upcast before the wire collective (or the f32 fallback "
+        "psum of the full histogram survives), or a gh_precision program's "
+        "gradient plane is upcast to f32 before histogram accumulation "
+        "(narrow gh aval missing / f32 histogram psum instead of the exact "
+        "int32 wire)"
     ),
     "VER005": (
         "float64 aval in a compiled program: TPU-hostile dtype, doubles "
@@ -205,19 +208,32 @@ def check_axis_names(traced: Sequence[TracedProgram],
 
 def check_precision_flow(traced: Sequence[TracedProgram],
                          root: Optional[str] = None) -> List[Finding]:
-    """VER004: in a hist_quant=int8/int16 round program the histogram wire
-    must stay narrow end to end — a single ``convert_element_type -> f32``
-    before the ``all_to_all`` silently re-inflates every byte the mode was
-    bought to save, and the f32 fallback psum of the full [nodes, F, bins, 2]
-    payload must be gone entirely."""
+    """VER004: the two quantized-precision flows, end to end.
+
+    * ``hist_quant`` (the WIRE): in an int8/int16 round program the
+      histogram wire must stay narrow — a single
+      ``convert_element_type -> f32`` before the ``all_to_all`` silently
+      re-inflates every byte the mode was bought to save, and the f32
+      fallback psum of the full [nodes, F, bins, 2] payload must be gone.
+    * ``gh_precision`` (the PLANE): the gh buffer entering histogram build
+      must BE int8/int16 (the narrow aval must appear in the program) and
+      accumulation must stay integer — any histogram-rank psum in f32 means
+      the plane was upcast before accumulation; with an unquantized wire
+      the histogram psum must be the exact int32 reduction. GOSS programs
+      (meta sampling == gradient_based) are exempt from the accumulation
+      checks: their amplified compaction dequantizes the small sampled
+      buffer by design (the narrow-aval requirement still applies — the
+      full-N plane stays quantized).
+    """
     findings: List[Finding] = []
     for t in traced:
         if not t.ok or t.record.name not in _HIST_QUANT_PROGRAMS:
             continue
+        colls = t.analysis.collectives
+        findings.extend(_gh_precision_findings(t, colls, root))
         narrow = _NARROW.get(str(t.record.meta.get("hist_quant", "none")))
         if narrow is None:
             continue
-        colls = t.analysis.collectives
         a2a = [c for c in colls if c.prim == "all_to_all"]
         ag = [c for c in colls if c.prim == "all_gather"]
         if not a2a:
@@ -250,6 +266,53 @@ def check_precision_flow(traced: Sequence[TracedProgram],
                     f"program ({c.describe()})",
                     root,
                 ))
+    return findings
+
+
+def _gh_precision_findings(t: TracedProgram, colls,
+                           root: Optional[str]) -> List[Finding]:
+    """The gh_precision half of VER004 (see check_precision_flow)."""
+    narrow = _NARROW.get(str(t.record.meta.get("gh_precision", "float32")))
+    if narrow is None:
+        return []
+    findings: List[Finding] = []
+    if narrow not in t.analysis.dtypes:
+        findings.append(_finding(
+            t, "VER004",
+            f"no {narrow} aval anywhere in a gh_precision={narrow} program: "
+            "the quantized gh plane traced away (upcast at the source?)",
+            root,
+        ))
+    if str(t.record.meta.get("sampling")) == "gradient_based":
+        # GOSS dequantizes its amplified compacted buffer by design; the
+        # accumulation-dtype checks below do not apply
+        return findings
+    hist_psums = [c for c in colls if c.prim == "psum" and len(c.shape) >= 4]
+    wire_narrow = _NARROW.get(str(t.record.meta.get("hist_quant", "none")))
+    if wire_narrow is None:
+        # with a narrow hist_quant wire the check_precision_flow loop
+        # already flags any surviving f32 histogram psum — reporting it
+        # here too would count one defect twice
+        for c in hist_psums:
+            if c.dtype == "float32":
+                findings.append(_finding(
+                    t, "VER004",
+                    f"f32 histogram psum in a gh_precision={narrow} "
+                    f"program: the gh plane was upcast before accumulation "
+                    f"({c.describe()})",
+                    root,
+                ))
+    if (
+        str(t.record.meta.get("hist_quant", "none")) == "none"
+        and not any(c.dtype == "int32" for c in hist_psums)
+    ):
+        findings.append(_finding(
+            t, "VER004",
+            f"no int32 histogram psum in a gh_precision={narrow} program "
+            "with an unquantized wire: the exact integer reduction is "
+            "missing (accumulation not integer?)",
+            root,
+        ))
     return findings
 
 

@@ -68,6 +68,39 @@ FULL_MATRIX: Tuple[MatrixEntry, ...] = (
          "hist_quant": "int8", "hist_quant_min_bytes": 0},
         (4,),
     ),
+    # end-to-end quantized gradients (gh_precision): the on-chip half of
+    # the low-precision story. These rows feed the VER004 gh-precision
+    # sub-checks (narrow gh aval present, exact int32 histogram wire, no
+    # f32 upcast before accumulation) and VER001 across worlds.
+    MatrixEntry("depthwise-int8gh", {"gh_precision": "int8"}, (2, 4, 8)),
+    MatrixEntry("depthwise-int16gh", {"gh_precision": "int16"}, (4,)),
+    MatrixEntry(
+        # int8 gh x int8 wire: the composition case — integer accumulation
+        # feeding the quantized collective without a f32 round-trip
+        "depthwise-int8gh-int8wire",
+        {"gh_precision": "int8", "hist_quant": "int8",
+         "hist_quant_min_bytes": 0},
+        (2, 4),
+    ),
+    MatrixEntry(
+        "lossguide-int8gh",
+        {"grow_policy": "lossguide", "max_leaves": 8,
+         "gh_precision": "int8"},
+        (2,),
+    ),
+    MatrixEntry(
+        # GOSS's amplified compaction dequantizes its small buffer (the
+        # documented exception VER004's gh checks carve out)
+        "goss-int8gh",
+        {"subsample": 0.5, "sampling_method": "gradient_based",
+         "gh_precision": "int8"},
+        (4,),
+    ),
+    MatrixEntry(
+        "uniform-int8gh",
+        {"subsample": 0.5, "gh_precision": "int8"},
+        (4,),
+    ),
     # 2D row x feature mesh: worlds here are the ROW extent R; each engine
     # takes R x 2 of the 8 virtual devices ((2,2) and (4,2)). The two-world
     # row feeds VER001 with feature_parallel=2 meta, pinning the 2D
@@ -87,6 +120,14 @@ FULL_MATRIX: Tuple[MatrixEntry, ...] = (
         {"feature_parallel": 2, "grow_policy": "lossguide", "max_leaves": 8},
         (2,),
     ),
+    MatrixEntry(
+        # 2D row x feature mesh under quantized gh: histogram psums stay
+        # int32 on the actors axis; the feature axis still carries only the
+        # tiny election/broadcast traffic
+        "depthwise-2d-int8gh",
+        {"feature_parallel": 2, "gh_precision": "int8"},
+        (2, 4),
+    ),
 )
 
 #: tier-1 test subset: the two keystone rows (plain + quantized) at two
@@ -99,6 +140,9 @@ QUICK_MATRIX: Tuple[MatrixEntry, ...] = (
         {"hist_quant": "int8", "hist_quant_min_bytes": 0},
         (2, 4),
     ),
+    # quantized gradients: the gh-plane analog of the quantized wire —
+    # exercises the VER004 gh sub-checks in the fast tier
+    MatrixEntry("depthwise-int8gh", {"gh_precision": "int8"}, (2, 4)),
 )
 
 _GBLINEAR_WORLDS = (2, 4)

@@ -50,6 +50,7 @@ from xgboost_ray_tpu.ops.histogram import (
 from xgboost_ray_tpu.ops.grow import (
     SALT_BYTREE,
     SALT_GOSS,
+    SALT_SR,
     SALT_SUBSAMPLE,
     GrowConfig,
     Tree,
@@ -66,7 +67,12 @@ from xgboost_ray_tpu.ops.metrics import (
     is_device_metric,
     parse_metric_name,
 )
-from xgboost_ray_tpu.ops.objectives import CustomObjective, get_objective
+from xgboost_ray_tpu.ops.objectives import (
+    CustomObjective,
+    get_objective,
+    gh_plane_itemsize,
+    quantize_gh,
+)
 from xgboost_ray_tpu.ops.ranking import RankingObjective, build_group_rows
 from xgboost_ray_tpu.ops import predict as predict_ops
 from xgboost_ray_tpu.ops.split import SplitParams
@@ -238,6 +244,18 @@ class TpuEngine:
         from xgboost_ray_tpu.ops.survival import SurvivalObjective
 
         self.is_survival = isinstance(self.objective, SurvivalObjective)
+        if (
+            params.gh_precision != "float32"
+            and isinstance(self.objective, CustomObjective)
+        ):
+            # the user's obj callback hands over f32 g/h it computed itself;
+            # stochastic-rounding those behind its back would silently train
+            # a different objective than the one supplied
+            raise NotImplementedError(
+                "gh_precision (quantized-gradient training) is not "
+                "supported with a custom objective; set "
+                "gh_precision='float32' or use a built-in objective."
+            )
         self.n_outputs = self.objective.num_outputs
         base_score = (
             params.base_score
@@ -269,6 +287,7 @@ class TpuEngine:
             hist_precision=resolve_hist_precision(params.hist_precision),
             hist_quant=params.hist_quant,
             hist_quant_min_bytes=params.hist_quant_min_bytes,
+            gh_precision=params.gh_precision,
             hist_chunk=params.hist_chunk,
             sibling_subtract=params.sibling_subtract,
             cat_features=self._cat_features,
@@ -605,6 +624,8 @@ class TpuEngine:
             self._obs_round_attrs["feature_parallel"] = int(
                 self.feature_parallel
             )
+        if params.gh_precision != "float32":
+            self._obs_round_attrs["gh_precision"] = params.gh_precision
         if samp_spec is not None:
             self._obs_round_attrs["sample_rows_per_shard"] = int(
                 sampling.row_budget(self.pad_to // self.n_devices, samp_spec)
@@ -899,6 +920,11 @@ class TpuEngine:
         # bit-identical to builds that predate the compaction machinery
         samp_spec = sampling.spec_from_params(params)
 
+        # quantize_gh's int32-overflow bound: the global padded row count
+        # (trace-time constant; padding rows carry exactly-zero gh but the
+        # bound stays safe either way)
+        gh_max_rows = int(self.pad_to)
+
         def tree_round(bins, valid, label, weight, margins, group_rows, gh_in,
                        rng, bounds, eval_bins, eval_margins):
             """One boosting round; gh_in is None unless a custom objective
@@ -955,6 +981,24 @@ class TpuEngine:
                 for t in range(t_par):
                     key = jax.random.fold_in(rng, k * t_par + t)
                     ghk = jnp.stack([g[:, k], h[:, k]], axis=1)
+                    ghk_scale = None
+                    if cfg.gh_precision != "float32":
+                        # quantize g/h AT THE SOURCE (per-tree pmax-shared
+                        # scales, stochastic rounding): the narrow buffer is
+                        # what compaction gathers and the histogram
+                        # accumulates. The SR key folds SALT_SR per (seed,
+                        # iteration, tree, actor) — deterministic reruns,
+                        # and identical on every feature shard of a 2D mesh
+                        # (rows replicate across AXIS_FEATURES).
+                        srkey = jax.random.fold_in(
+                            jax.random.fold_in(key, SALT_SR),
+                            jax.lax.axis_index(AXIS_ACTORS),
+                        )
+                        ghk, ghk_scale = quantize_gh(
+                            ghk, cfg.gh_precision, srkey,
+                            axis_name=AXIS_ACTORS, counter=counter,
+                            max_rows=gh_max_rows,
+                        )
                     bins_t = bins
                     if samp_spec is not None:
                         # compact the round's rows to the fixed M-row budget
@@ -975,7 +1019,7 @@ class TpuEngine:
                             jax.lax.axis_index(AXIS_ACTORS),
                         )
                         rows_sel, ghk = sampling.sample_rows(
-                            ghk, valid, skey, samp_spec
+                            ghk, valid, skey, samp_spec, scale=ghk_scale
                         )
                         bins_t = bins[rows_sel]
                     fmask = None
@@ -1011,6 +1055,15 @@ class TpuEngine:
                         hist_allreduce=hist_ar,
                         ar_counter=counter,
                         fshard=fshard,
+                        # GOSS compaction dequantizes its small [M, 2]
+                        # buffer (amplification is real-valued); the grower
+                        # then takes the f32 path over quantized-grid values
+                        gh_scale=(
+                            ghk_scale
+                            if ghk_scale is not None
+                            and jnp.issubdtype(ghk.dtype, jnp.integer)
+                            else None
+                        ),
                     )
                     trees.append(tree)
                     if samp_spec is not None:
@@ -1123,6 +1176,11 @@ class TpuEngine:
             "world": int(self.n_devices),
             "grower": "dart" if is_dart else self.params.grow_policy,
             "hist_quant": self.cfg.hist_quant,
+            # on-chip gh precision: int8/int16 programs trace integer
+            # accumulation + int32 (or quantized) histogram wires — a
+            # legitimately different schedule from float32, so it is an
+            # identity-group coordinate (and VER004's precision-flow key)
+            "gh_precision": str(self.cfg.gh_precision),
             "sampling": samp.policy if samp is not None else "none",
             # feature-axis mesh extent: (R, C) programs are legitimately
             # different from (R, 1) ones and must not share a cross-world
@@ -1685,6 +1743,17 @@ class TpuEngine:
             return None
         return int(np.asarray(self._ar_bytes_dev))
 
+    def gh_plane_bytes_per_shard(self) -> int:
+        """Static per-shard bytes of one tree's (grad, hess) plane — the
+        buffer the objective kernel emits, compaction gathers from, and the
+        histogram accumulates: ``rows_per_shard * 2 * itemsize`` of the
+        ``gh_precision`` storage dtype. This is the memory metric the
+        quantized-gradient mode is bought for (int8 = 4x smaller shards per
+        chip); rxgbverify's VER004 pass certifies the traced programs really
+        carry this dtype into the accumulation."""
+        n_local = self.pad_to // self.n_devices
+        return n_local * 2 * gh_plane_itemsize(self.cfg.gh_precision)
+
     @property
     def num_round_trees(self) -> int:
         """Rounds recorded so far (host-resident + pending device forests)."""
@@ -2125,6 +2194,15 @@ class TpuEngine:
         )
         valid = jnp.ones((n_local,), bool)
         key = jax.random.PRNGKey(0)
+        gh_scale = None
+        if self.cfg.gh_precision != "float32":
+            # profile the int path the real round runs: quantized gh buffer
+            # feeding the builders (no mesh here, so no pmax — the scales
+            # only affect values, not shapes/dtypes)
+            gh, gh_scale = jax.jit(
+                lambda g, k, _m=self.cfg.gh_precision, _r=int(self.pad_to):
+                quantize_gh(g, _m, k, max_rows=_r)
+            )(gh, key)
 
         def fenced(fn, *args):
             """(compile_s, execute_s): the first call carries compile; the
@@ -2160,7 +2238,9 @@ class TpuEngine:
             bins_m, gh_m = bins, gh
         else:
             sample_fn = jax.jit(
-                lambda g, v, k, _s=spec: sampling.sample_rows(g, v, k, _s)
+                lambda g, v, k, _s=spec, _sc=gh_scale: sampling.sample_rows(
+                    g, v, k, _s, scale=_sc
+                )
             )
             gather_fn = jax.jit(lambda r: bins[r])
             rows_sel, gh_m = sample_fn(gh, valid, key)

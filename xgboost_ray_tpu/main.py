@@ -440,6 +440,17 @@ def _record_allreduce_bytes(state, engine) -> None:
     """Surface the engine's measured per-round collective payload bytes
     (the ``hist_quant`` traffic metric) in additional_results. One host
     read, after training only — never on the per-round path."""
+    gh_getter = getattr(engine, "gh_plane_bytes_per_shard", None)
+    if gh_getter is not None:
+        try:
+            # static layout arithmetic (no device read): the per-shard
+            # gh-plane footprint the gh_precision mode shrinks — the
+            # bench's memory metric, independent of the wire counter below
+            state.additional_results["gh_plane_bytes_per_shard"] = int(
+                gh_getter()
+            )
+        except Exception:  # noqa: BLE001 - diagnostics never fail training
+            pass
     getter = getattr(engine, "hist_allreduce_bytes_per_round", None)
     if getter is None:
         return

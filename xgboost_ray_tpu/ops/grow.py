@@ -59,6 +59,7 @@ SALT_BYTREE = 0x51D2
 SALT_BYLEVEL = 0x51D3
 SALT_BYNODE = 0x51D4
 SALT_GOSS = 0x51D5  # gradient_based row sampling (ops/sampling.py)
+SALT_SR = 0x51D6  # stochastic gh rounding (gh_precision, ops/objectives.py)
 
 
 def route_right_binned(bin_vals, split_bin, default_left, is_cat, missing_bin):
@@ -204,6 +205,13 @@ class GrowConfig:
     hist_quant: str = "none"
     # sub-threshold payloads keep the exact f32 psum (latency-bound regime)
     hist_quant_min_bytes: int = 32768
+    # on-chip gh storage/accumulation precision: "float32" (default, exact
+    # pre-PR program) | "int16" | "int8" — g/h quantized at the objective
+    # kernel (stochastic rounding, per-tree pmax scales; ops/objectives.py)
+    # and accumulated int -> int32 through the histogram build. The growers
+    # key off the traced gh buffer (``gh_scale`` arg); this field names the
+    # contract in the jit-static config and the progreg meta.
+    gh_precision: str = "float32"
 
     @property
     def heap_size(self) -> int:
@@ -271,10 +279,19 @@ def build_tree(
     hist_allreduce: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = None,
     ar_counter=None,  # AllreduceBytes: scan-scoped byte accounting
     fshard=None,  # ops.provider.FeatureShard on a 2D row x feature mesh
+    gh_scale: Optional[jnp.ndarray] = None,  # [2] f32 per-channel scales of a
+    #   quantized integer gh buffer (gh_precision; None = f32 legacy path)
 ):
     """Grow one tree. Returns (Tree, row_value[N]) — row_value is the leaf
     value each row receives (learning-rate scaled), used to update margins
     without re-walking the tree.
+
+    With ``gh_scale`` (``gh_precision`` int8/int16), ``gh`` is the quantized
+    INTEGER buffer from ``ops.objectives.quantize_gh``: histogram bins and
+    node totals accumulate integer-exact (int -> int32), the histogram
+    allreduce rides int32 (exact) or the quantized wire, and the sums are
+    dequantized ONCE per level at the split-search/leaf-weight boundary —
+    node totals and leaf weights are exact f32 of the quantized values.
 
     ``hist_allreduce`` merges the per-level [n_nodes, F, nbt, 2] histogram
     across shards (the hot collective; may be quantized per
@@ -304,12 +321,30 @@ def build_tree(
             hist_allreduce=hist_ar,
             ar_counter=ar_counter,
             fshard=fshard,
+            gh_scale=gh_scale,
         )
     n, num_features = bins.shape
     nbt = cfg.max_bin + 1
     lr = cfg.split.learning_rate
     missing_bin = cfg.max_bin
     provider = cfg.hist_provider()
+
+    # quantized-gh mode: sums stay in the exact integer domain until this
+    # one dequantization point (gh_scale is None on the f32 legacy path,
+    # where deq is the identity and every branch below traces the exact
+    # pre-quantization program)
+    quant = gh_scale is not None
+    if quant:
+        from xgboost_ray_tpu.ops.objectives import dequantize_gh_sums
+
+        deq = lambda s: dequantize_gh_sums(s, gh_scale)  # noqa: E731
+        gh_zero = jnp.zeros((), gh.dtype)
+    else:
+        deq = lambda s: s  # noqa: E731
+        # the bare literal, NOT jnp.zeros((), f32): the float32 path must
+        # keep tracing the exact pre-quantization program (weak-typed
+        # constant and all — the schedule-golden/fingerprint discipline)
+        gh_zero = 0.0
 
     if fshard is None:
         cat_mask = cat_mask_const(cfg.cat_features, num_features)
@@ -409,20 +444,24 @@ def build_tree(
             # (they become leaf weights -g/(h+lambda)), and the sibling-
             # subtraction child choice needs exact live-row counts. ONE
             # packed [n_nodes, 3] psum carries both — a single extra small
-            # collective per level regardless of mode.
-            gh_live = jnp.where(done[:, None], 0.0, gh)
+            # collective per level regardless of mode. Under quantized gh
+            # the whole packed payload rides int32 (sums AND counts), so the
+            # side-psum is an exact integer reduction dequantized once
+            # (deq is the identity on the f32 path).
+            cdt = jnp.int32 if quant else jnp.float32
+            gh_live = jnp.where(done[:, None], gh_zero, gh)
             packed = allreduce(
                 jnp.concatenate(
                     [
                         node_sums(gh_live, pos, n_nodes),
-                        jnp.zeros((n_nodes, 1), jnp.float32)
+                        jnp.zeros((n_nodes, 1), cdt)
                         .at[pos, 0]
-                        .add((~done).astype(jnp.float32)),
+                        .add((~done).astype(cdt)),
                     ],
                     axis=1,
                 )
             )
-            node_gh_exact = packed[:, :2]
+            node_gh_exact = deq(packed[:, :2])
             counts_live = packed[:, 2]
 
         def _build(gh_b, pos_b, order_b, counts_b, nn, rows_sel=None):
@@ -541,6 +580,14 @@ def build_tree(
                 # be identical on every chip, so global feature 0's owner —
                 # the column the (R, 1) program reads — wins
                 node_gh = fshard.bcast_from_shard0(node_gh)
+            # quantized gh + exact int32 wire: the readout sums are exact
+            # integer node totals — dequantize at the same boundary the
+            # packed side-psum uses, so both totals paths agree bitwise
+            node_gh = deq(node_gh)
+        # the split search consumes real-valued bin sums: dequantize the
+        # merged histogram ONCE per level (identity on the f32 path);
+        # prev_hist stays in the quantized domain for sibling subtraction
+        hist_sv = deq(hist)
 
         fmask = fmask_tree
         if colsample_bylevel < 1.0 and level_rng is not None:
@@ -571,7 +618,7 @@ def build_tree(
             else:
                 fmask = (fmask[None, :] if fmask.ndim == 1 else fmask) & allowed
 
-        sp = find_splits(hist, node_gh, cfg.split, feature_mask=fmask,
+        sp = find_splits(hist_sv, node_gh, cfg.split, feature_mask=fmask,
                          cat_mask=cat_mask_local, monotone=mono_arr,
                          node_lower=lower, node_upper=upper)
         if fshard is not None:
@@ -640,7 +687,7 @@ def build_tree(
             # feasible interval at the midpoint — xgboost's monotone bound
             # propagation. O(n_nodes * bins), negligible next to the build.
             hist_f = jnp.take_along_axis(
-                hist, fsafe[:, None, None, None], axis=1
+                hist_sv, fsafe[:, None, None, None], axis=1
             )[:, 0]  # [n_nodes, nbt, 2]
             gf, hf = hist_f[..., 0], hist_f[..., 1]
             sbin_c = jnp.clip(sp.split_bin, 0, cfg.max_bin - 2)[:, None]
@@ -689,7 +736,8 @@ def build_tree(
     # Final level: every still-active node is a leaf.
     n_nodes = 1 << cfg.max_depth
     base = n_nodes - 1
-    node_gh = allreduce(node_sums(jnp.where(done[:, None], 0.0, gh), pos, n_nodes))
+    gh_final = jnp.where(done[:, None], gh_zero, gh)
+    node_gh = deq(allreduce(node_sums(gh_final, pos, n_nodes)))
     if mono_on:
         node_value = lr * bounded_weight(
             node_gh[:, 0], node_gh[:, 1], cfg.split, lower, upper

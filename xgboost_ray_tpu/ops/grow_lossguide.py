@@ -63,9 +63,16 @@ def build_tree_lossguide(
     ar_counter=None,  # AllreduceBytes: the scan body traces once, runs
     #   leaves-1 times — the repeated() scope keeps byte accounting exact
     fshard=None,  # ops.provider.FeatureShard on a 2D row x feature mesh
+    gh_scale: Optional[jnp.ndarray] = None,  # [2] f32 scales of a quantized
+    #   integer gh buffer (gh_precision); None = the f32 legacy path
 ):
     """Grow one leaf-wise tree. Returns (Tree, row_value[N]) — the same
     contract as ``build_tree`` so the engine's round step is policy-blind.
+
+    With ``gh_scale`` the per-step 2-node histogram accumulates the integer
+    gh buffer exactly (int -> int32) and bin sums / node totals are
+    dequantized once at the split-search boundary, mirroring ``build_tree``'s
+    quantized-gh contract.
 
     ``hist_allreduce`` merges the per-step 2-node histogram (may be
     quantized per ``cfg.hist_quant``); exact node totals ride ``allreduce``
@@ -75,6 +82,13 @@ def build_tree_lossguide(
     mirroring ``build_tree``'s 2D contract (bins local, cuts/
     feat_has_missing/feature_mask global feature-padded)."""
     hist_ar = hist_allreduce if hist_allreduce is not None else allreduce
+    quant = gh_scale is not None
+    if quant:
+        from xgboost_ray_tpu.ops.objectives import dequantize_gh_sums
+
+        deq = lambda s: dequantize_gh_sums(s, gh_scale)  # noqa: E731
+    else:
+        deq = lambda s: s  # noqa: E731
     n, num_features = bins.shape
     nbt = cfg.max_bin + 1
     missing_bin = cfg.max_bin
@@ -121,13 +135,15 @@ def build_tree_lossguide(
             and nn * num_features * nbt * 2 * 4 >= cfg.hist_quant_min_bytes
         )
         if quantized:
-            return allreduce(node_sums(gh_b, pos_b, nn))
+            # under quantized gh the side-psum rides int32 (exact) and is
+            # dequantized here — the one boundary both totals paths share
+            return deq(allreduce(node_sums(gh_b, pos_b, nn)))
         totals = hist[:, 0, :, :].sum(axis=1)
         if fshard is not None:
             # column-0 readout differs per feature shard in f32 rounding;
             # global feature 0's owner wins (see build_tree's node_gh)
             totals = fshard.bcast_from_shard0(totals)
-        return totals
+        return deq(totals)
 
     tree = empty_tree(heap)
     pos = jnp.zeros((n,), jnp.int32)
@@ -135,7 +151,7 @@ def build_tree_lossguide(
     # --- root: evaluate its best split, seed the frontier -------------------
     root_hist = _hist(gh, pos, 1)  # [1, F_local, nbt, 2]
     root_gh = _node_gh(root_hist, gh, pos, 1)  # [1, 2]
-    sp0 = find_splits(root_hist, root_gh, cfg.split,
+    sp0 = find_splits(deq(root_hist), root_gh, cfg.split,
                       feature_mask=fmask_local, cat_mask=cat_mask_local)
     if fshard is not None:
         sp0 = elect_across_feature_shards(
@@ -210,7 +226,7 @@ def build_tree_lossguide(
         pos2 = go_right.astype(jnp.int32)
         hist2 = _hist(gh_sel, pos2, 2)  # [2, F_local, nbt, 2]
         child_gh = _node_gh(hist2, gh_sel, pos2, 2)  # [2, 2]
-        sp2 = find_splits(hist2, child_gh, cfg.split,
+        sp2 = find_splits(deq(hist2), child_gh, cfg.split,
                           feature_mask=fmask_local, cat_mask=cat_mask_local)
         if fshard is not None:
             sp2 = elect_across_feature_shards(

@@ -163,7 +163,13 @@ def quantized_hist_allreduce(
     quantized wire format (see module comment). ``mode`` is one of
     ``HIST_QUANT_MODES``; ``"none"`` is the plain f32 psum, and payloads
     under ``min_bytes`` fall back to it (shape-static decision). The result
-    is bit-identical on every shard in all modes."""
+    is bit-identical on every shard in all modes.
+
+    ``h`` may be an INT32 quantized-domain histogram (``gh_precision``
+    int8/int16 gradients accumulate integer-exact): the fallback psum stays
+    in int32 — an exact integer wire at the same 4 bytes/element — and the
+    quantized wire stages read the f32 view of the integer sums (exact below
+    2^24; the wire rounding is far coarser beyond)."""
     if mode == "none" or h.size * 4 < min_bytes:
         if counter is not None:
             counter.add_allreduce(h)
@@ -176,6 +182,8 @@ def quantized_hist_allreduce(
     rows = nn * num_features
     cols = nbt * two
     hr = h.reshape(rows, cols)
+    if hr.dtype != jnp.float32:
+        hr = hr.astype(jnp.float32)
 
     # stage 1: shared per-(node, feature) scales from the global absmax of
     # the LOCAL histograms (pmax bounds every actor's values, so the
@@ -258,12 +266,22 @@ def _append_missing(hist_reg: jnp.ndarray, node_tot: jnp.ndarray) -> jnp.ndarray
     return jnp.concatenate([hist_reg, miss[:, :, None, :]], axis=2)
 
 
+def _acc_dtype(gh) -> jnp.dtype:
+    """Histogram accumulation dtype for a gh buffer: int32 for quantized
+    (``gh_precision``) integer gradients — sums of narrow ints are EXACT in
+    int32 up to ~2^31/qmax rows per (shard, bin) — float32 otherwise."""
+    return (
+        jnp.int32 if jnp.issubdtype(gh.dtype, jnp.integer) else jnp.float32
+    )
+
+
 def _node_totals_from_blocks(
     ghp: jnp.ndarray, node_of_block: jnp.ndarray, n_nodes: int
 ) -> jnp.ndarray:
     """[n_blocks, block, 2] node-uniform blocks -> [n_nodes + 1, 2] totals."""
-    block_sums = ghp.sum(axis=1)
-    return jnp.zeros((n_nodes + 1, 2), jnp.float32).at[node_of_block].add(block_sums)
+    acc = _acc_dtype(ghp)
+    block_sums = ghp.sum(axis=1, dtype=acc) if acc == jnp.int32 else ghp.sum(axis=1)
+    return jnp.zeros((n_nodes + 1, 2), acc).at[node_of_block].add(block_sums)
 
 
 def hist_scatter(
@@ -273,12 +291,16 @@ def hist_scatter(
     n_nodes: int,
     n_bins_total: int,  # n_bins + 1 (missing bucket included)
 ) -> jnp.ndarray:
-    """Returns [n_nodes, F, n_bins_total, 2] float32."""
+    """Returns [n_nodes, F, n_bins_total, 2] float32 (int32 exact sums when
+    ``gh`` is a quantized integer buffer)."""
     n, num_features = bins.shape
     b = bins.astype(jnp.int32)
     # flat bucket id per (row, feature)
     flat = (pos[:, None] * num_features + jnp.arange(num_features, dtype=jnp.int32)[None, :]) * n_bins_total + b
-    out = jnp.zeros((n_nodes * num_features * n_bins_total, 2), jnp.float32)
+    acc = _acc_dtype(gh)
+    if acc == jnp.int32:
+        gh = gh.astype(jnp.int32)  # widen the [N, 2] source, not the fan-out
+    out = jnp.zeros((n_nodes * num_features * n_bins_total, 2), acc)
     ghb = jnp.broadcast_to(gh[:, None, :], (n, num_features, 2))
     out = out.at[flat.reshape(-1)].add(ghb.reshape(-1, 2))
     return out.reshape(n_nodes, num_features, n_bins_total, 2)
@@ -317,9 +339,18 @@ def hist_onehot(
     ghc = gh.reshape(n_chunks, chunk, 2)
     posc = pos.reshape(n_chunks, chunk)
 
+    # quantized gradients (gh_precision): the one-hot and gh ride the matmul
+    # in the narrow integer dtype accumulating int32 — exact, and the
+    # int8 x int8 -> int32 MXU path on modern hardware. The bf16 "fast" knob
+    # is meaningless here (integer accumulation is already the cheap mode).
+    int_gh = jnp.issubdtype(gh.dtype, jnp.integer)
+    acc_dt = jnp.int32 if int_gh else jnp.float32
     # fast mode: materialize the one-hot (the HBM-bound operand) in bf16 —
     # exact for 0/1 values, halves the traffic; gh rounds to bf16 (~0.2%)
-    oh_dtype = jnp.bfloat16 if precision == "fast" else jnp.float32
+    if int_gh:
+        oh_dtype = gh.dtype
+    else:
+        oh_dtype = jnp.bfloat16 if precision == "fast" else jnp.float32
 
     # tile features so each sequential step does one WIDE dot — the scan/fori
     # step count, not FLOPs or HBM, bounds this path on TPU (measured v5e)
@@ -345,8 +376,8 @@ def hist_onehot(
             oh = oh.reshape(oh.shape[0], ftile * nb)
             contrib = jax.lax.dot_general(
                 oh, ghk_c, (((0,), (0,)), ((), ())),
-                precision=prec, preferred_element_type=jnp.float32,
-            )  # [ftile*nb, 2] (MXU, f32 accumulate)
+                precision=prec, preferred_element_type=acc_dt,
+            )  # [ftile*nb, 2] (MXU, f32 — or exact int32 — accumulate)
             return jax.lax.dynamic_update_slice_in_dim(
                 acc,
                 jax.lax.dynamic_slice_in_dim(acc, t * ftile, ftile, axis=0)
@@ -358,13 +389,20 @@ def hist_onehot(
         acc = jax.lax.fori_loop(0, n_ftiles, ftile_step, acc)
         # node totals ride the scan as one extra tiny matmul per chunk (a
         # [N]-row scatter here measured ~20 ms/1M rows on TPU)
-        oh_node = jax.nn.one_hot(pk, n_nodes, dtype=jnp.float32)
-        tot = tot + jnp.matmul(oh_node.T, ghk, precision=jax.lax.Precision.HIGHEST)
+        if int_gh:
+            oh_node = jax.nn.one_hot(pk, n_nodes, dtype=gh.dtype)
+            tot = tot + jax.lax.dot_general(
+                oh_node, ghk, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+        else:
+            oh_node = jax.nn.one_hot(pk, n_nodes, dtype=jnp.float32)
+            tot = tot + jnp.matmul(oh_node.T, ghk, precision=jax.lax.Precision.HIGHEST)
         return (acc, tot), None
 
     acc0 = (
-        jnp.zeros((n_ftiles * ftile, nb, 2), jnp.float32),
-        jnp.zeros((n_nodes, 2), jnp.float32),
+        jnp.zeros((n_ftiles * ftile, nb, 2), acc_dt),
+        jnp.zeros((n_nodes, 2), acc_dt),
     )
     (acc, node_tot), _ = jax.lax.scan(chunk_step, acc0, (b, ghc, posc))
     # [F, n_nodes*nb_reg, 2] -> [n_nodes, F, nb_reg, 2]
@@ -551,7 +589,13 @@ def _blocked_hist(bp, ghp, node_of_block, n_nodes, n_bins_total, num_features,
     ghp = ghp.reshape(n_chunks, block_chunk, -1, 2)
     nodes_c = node_of_block.reshape(n_chunks, block_chunk)
 
-    oh_dtype = jnp.bfloat16 if precision == "fast" else jnp.float32
+    # quantized gradients: narrow-int one-hot x gh, exact int32 accumulation
+    # (see hist_onehot); the bf16 fast mode does not apply
+    acc_dt = _acc_dtype(ghp)
+    if acc_dt == jnp.int32:
+        oh_dtype = ghp.dtype
+    else:
+        oh_dtype = jnp.bfloat16 if precision == "fast" else jnp.float32
     # tile features per sequential step (step count, not FLOPs, bounds this
     # path on TPU — same treatment as hist_onehot)
     ftile = min(4, num_features)
@@ -571,7 +615,7 @@ def _blocked_hist(bp, ghp, node_of_block, n_nodes, n_bins_total, num_features,
             # bins == nb_reg (missing) exceed the one-hot width -> zero rows
             oh = jax.nn.one_hot(cols, nb_reg, dtype=oh_dtype)  # [C, b, T, nb]
             contrib = jnp.einsum("cbtn,cbd->ctnd", oh, gc_c, precision=prec,
-                                 preferred_element_type=jnp.float32)
+                                 preferred_element_type=acc_dt)
             # scatter the [C, T, nb, 2] tile contributions into the node rows
             sl = jax.lax.dynamic_slice_in_dim(hist, t * ftile, ftile, axis=1)
             sl = sl.at[nodes, :, :, :].add(contrib)
@@ -580,7 +624,7 @@ def _blocked_hist(bp, ghp, node_of_block, n_nodes, n_bins_total, num_features,
         hist = jax.lax.fori_loop(0, n_ftiles, ftile_step, hist)
         return hist, None
 
-    hist0 = jnp.zeros((n_nodes + 1, n_ftiles * ftile, nb_reg, 2), jnp.float32)
+    hist0 = jnp.zeros((n_nodes + 1, n_ftiles * ftile, nb_reg, 2), acc_dt)
     hist, _ = jax.lax.scan(chunk_step, hist0, (bp, ghp, nodes_c))
     hist = hist[:, :num_features]
     return _append_missing(hist[:n_nodes], node_tot[:n_nodes])
@@ -620,9 +664,11 @@ def hist_partition(
 
 
 def node_sums(gh: jnp.ndarray, pos: jnp.ndarray, n_nodes: int) -> jnp.ndarray:
-    """Per-node (grad, hess) totals: [n_nodes, 2] via segment-sum."""
-    out = jnp.zeros((n_nodes, 2), jnp.float32)
-    return out.at[pos].add(gh)
+    """Per-node (grad, hess) totals: [n_nodes, 2] via segment-sum (exact
+    int32 sums for quantized integer gh)."""
+    acc = _acc_dtype(gh)
+    out = jnp.zeros((n_nodes, 2), acc)
+    return out.at[pos].add(gh if gh.dtype == acc else gh.astype(acc))
 
 
 def zero_phantom_missing(h: jnp.ndarray, feat_has_missing) -> jnp.ndarray:

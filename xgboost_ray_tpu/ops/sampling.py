@@ -103,10 +103,13 @@ def row_budget(n: int, spec: SamplingSpec) -> int:
 
 
 def sample_rows(
-    gh: jnp.ndarray,  # [N, 2] float32 grad/hess (0 for padding rows)
+    gh: jnp.ndarray,  # [N, 2] grad/hess (0 for padding rows): float32, or a
+    #   quantized int8/int16 buffer (gh_precision) with ``scale`` supplied
     valid: jnp.ndarray,  # [N] bool — real data rows (padding excluded)
     key: jnp.ndarray,  # PRNG key, already folded per (tree, actor)
     spec: SamplingSpec,
+    scale: Optional[jnp.ndarray] = None,  # [2] f32 dequantization scales of
+    #   a quantized gh buffer (required for gradient_based over int gh)
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Select the round's row budget. Returns ``(rows, gh_sel)``:
 
@@ -121,8 +124,25 @@ def sample_rows(
 
     Deterministic in ``key`` — identical (seed, iteration, actor) always
     draws the same rows, so checkpoint-resumed rounds replay bit-identically.
+
+    Quantized gh (``gh_precision``): the uniform policy gathers the narrow
+    INTEGER buffer straight through (the zero-mask is exact in any int
+    dtype), so the compacted build stays on the int -> int32 fast path. The
+    gradient_based policy scores in f32 FROM the quantized values and
+    gathers from the int buffer, but its compacted [M, 2] result is
+    dequantized f32: GOSS's remainder amplification is a real-valued per-row
+    multiplier that cannot ride an int8 grid without either overflowing it
+    or clipping the amplified mass. M is small (top_rate + other_rate of N),
+    so the full-N gh plane keeps the 4x cut and the model still trains on
+    quantized-grid gradients.
     """
     n = gh.shape[0]
+    int_gh = jnp.issubdtype(gh.dtype, jnp.integer)
+    if int_gh and scale is None and spec.policy == "gradient_based":
+        raise ValueError(
+            "gradient_based sampling over a quantized gh buffer needs the "
+            "dequantization scale (quantize_gh's [2] scales)"
+        )
     if spec.policy == "uniform":
         # top-k over UNMASKED uniform keys: every row slot — valid or
         # padding — competes equally, so each valid row is kept with
@@ -141,20 +161,32 @@ def sample_rows(
         raise ValueError(f"unknown sampling policy {spec.policy!r}")
 
     top_n, rand_n = goss_counts(n, spec)
+
+    def take(rows):
+        # gather from the (possibly int) buffer; the compacted result is
+        # f32 quantized-grid values when gh is quantized (see docstring)
+        sel = gh[rows]
+        return sel.astype(jnp.float32) * scale if int_gh else sel
+
+    if int_gh:
+        g_f = gh[:, 0].astype(jnp.float32) * scale[0]
+        h_f = gh[:, 1].astype(jnp.float32) * scale[1]
+    else:
+        g_f, h_f = gh[:, 0], gh[:, 1]
     # |g| * sqrt(h): the gradient magnitude weighted by curvature — rows
     # with large values dominate split gains g^2/(h+lambda), so keeping
     # them deterministically preserves the gain landscape (GOSS keeps
     # top-|g|; the sqrt(h) factor is the MVS-style curvature correction).
-    score = jnp.abs(gh[:, 0]) * jnp.sqrt(jnp.maximum(gh[:, 1], 0.0))
+    score = jnp.abs(g_f) * jnp.sqrt(jnp.maximum(h_f, 0.0))
     score = jnp.where(valid, score, -jnp.inf)
     rows_parts = []
     gh_parts = []
     eligible = valid
     if top_n:
         tvals, rows_top = jax.lax.top_k(score, top_n)
-        ok_top = jnp.isfinite(tvals)[:, None].astype(gh.dtype)
+        ok_top = jnp.isfinite(tvals)[:, None].astype(jnp.float32)
         rows_parts.append(rows_top)
-        gh_parts.append(gh[rows_top] * ok_top)
+        gh_parts.append(take(rows_top) * ok_top)
         eligible = eligible & (
             jnp.ones((n,), bool).at[rows_top].set(False)
         )
@@ -173,9 +205,9 @@ def sample_rows(
         amp = jnp.where(
             pool > 0, pool / jnp.minimum(pool, float(rand_n)), 0.0
         )
-        ok = (rvals >= 0.0)[:, None].astype(gh.dtype)
+        ok = (rvals >= 0.0)[:, None].astype(jnp.float32)
         rows_parts.append(rows_rand)
-        gh_parts.append(gh[rows_rand] * amp * ok)
+        gh_parts.append(take(rows_rand) * amp * ok)
     rows = jnp.concatenate(rows_parts).astype(jnp.int32)
     gh_sel = jnp.concatenate(gh_parts, axis=0)
     return rows, gh_sel
