@@ -104,6 +104,15 @@ class TrainParams:
     # small collectives are latency-bound (no byte win) and staying exact
     # keeps small-problem tree structure invariant to the world size
     hist_quant_min_bytes: int = 32768
+    # on-chip gradient/hessian precision: float32 (default) | int16 | int8 —
+    # g/h quantized AT THE OBJECTIVE KERNEL with per-tree pmax-shared scales
+    # and stochastic rounding (deterministic per seed), then carried
+    # low-precision through compaction and histogram accumulation
+    # (int -> int32, exact); node totals and leaf weights stay exact f32 of
+    # the quantized values. ~4x smaller per-shard gh plane at int8.
+    # Orthogonal to (and composable with) hist_quant, which governs only
+    # the cross-chip histogram WIRE format.
+    gh_precision: str = "float32"
     hist_chunk: int = 8192
     # build only the smaller child's histogram per parent, derive the sibling
     # by subtraction (xgboost hist-core behavior); disable for A/B debugging
@@ -297,6 +306,21 @@ def parse_params(params: Optional[Dict[str, Any]]) -> TrainParams:
         raise ValueError(
             f"Unknown hist_quant {out.hist_quant!r}; use none | int16 | "
             f"int8 (quantized histogram allreduce wire format)."
+        )
+
+    if out.gh_precision is None:
+        out.gh_precision = "float32"
+    if out.gh_precision not in ("float32", "int16", "int8"):
+        raise ValueError(
+            f"Unknown gh_precision {out.gh_precision!r}; use float32 | "
+            f"int16 | int8 (on-chip quantized-gradient training)."
+        )
+    if out.gh_precision != "float32" and out.booster == "gblinear":
+        raise NotImplementedError(
+            "gh_precision quantizes the per-tree gradient/hessian plane; "
+            "booster='gblinear' has no gh histogram plane to quantize. "
+            "Use gh_precision='float32' (silently ignoring the knob would "
+            "misreport the training precision)."
         )
 
     if out.feature_parallel is None:
