@@ -824,6 +824,236 @@ def run_low_precision_ablation(x, y, base_params, actors):
     return out
 
 
+#: streamed-ingest throughput guard: prev/current rows-per-second beyond
+#: this fires (a >20% ingest slowdown — the streaming hot path is host
+#: binning + H2D, both easy to silently regress)
+STREAMING_TRIPWIRE_RATIO = 1.25
+
+#: the streamed-vs-materialized accuracy contract at bench scale (same
+#: bound the acceptance criterion and tests/test_streaming.py pin)
+STREAMING_LOGLOSS_TOL = 5e-4
+
+
+def streaming_ingest_tripwire(current_streaming, prev_rec, prev_name=None,
+                              backend=None,
+                              threshold=STREAMING_TRIPWIRE_RATIO):
+    """Compare this run's streamed ingest throughput (rows/s) against the
+    newest recorded bench's ``streaming`` section.
+
+    Returns ``{prev_rows_per_s, prev_record, ratio, fired}`` or None when
+    no comparable record exists; like-for-like only (config key), cross-
+    backend records skipped. ``ratio`` is prev/current, so >threshold
+    means ingest got >(threshold-1)x slower."""
+    if not isinstance(current_streaming, dict):
+        return None
+    cur = (current_streaming.get("streamed") or {}).get("rows_per_s")
+    if not cur or not isinstance(prev_rec, dict):
+        return None
+    if backend and prev_rec.get("backend") and prev_rec["backend"] != backend:
+        return None
+    prev_sec = prev_rec.get("streaming")
+    if not isinstance(prev_sec, dict):
+        return None
+    prev = (prev_sec.get("streamed") or {}).get("rows_per_s")
+    if not prev:
+        return None
+    ratio = float(prev) / float(cur)
+    out = {
+        "prev_rows_per_s": round(float(prev), 1),
+        "prev_record": prev_name,
+        "ratio": round(ratio, 3),
+        "fired": False,
+    }
+    if prev_sec.get("config") != current_streaming.get("config"):
+        out["config_mismatch"] = True
+        return out
+    if ratio > threshold:
+        out["fired"] = True
+        print(
+            f"[bench] STREAMING TRIPWIRE: streamed ingest throughput "
+            f"{cur:.0f} rows/s is {ratio:.2f}x slower than the newest "
+            f"recorded run ({prev:.0f} rows/s in "
+            f"{prev_name or 'BENCH_*.json'}) — "
+            f">{(threshold - 1) * 100:.0f}% ingest regression. The "
+            f"sketch/bin/H2D pipeline is rotting; investigate before "
+            f"trusting this build's out-of-core numbers.",
+            file=sys.stderr,
+        )
+    return out
+
+
+class _RssPeakSampler:
+    """Peak process RSS over the sampled window (background thread, 5 ms).
+
+    psutil when present, /proc/self/statm otherwise — psutil is not in
+    setup.py's install_requires, and the streaming ablation is default-on
+    for CPU bench runs, so a bare install must still be able to sample.
+    """
+
+    def __init__(self):
+        self._read_rss = self._pick_reader()
+        self.baseline = 0
+        self.peak = 0
+
+    @staticmethod
+    def _pick_reader():
+        try:
+            import psutil
+
+            proc = psutil.Process()
+            return lambda: proc.memory_info().rss
+        except ImportError:
+            pass
+        try:
+            page = os.sysconf("SC_PAGE_SIZE")
+
+            def read_statm():
+                with open("/proc/self/statm") as fh:
+                    return int(fh.read().split()[1]) * page
+
+            read_statm()  # probe: /proc is Linux-only
+            return read_statm
+        except (OSError, ValueError):
+            pass
+        # last resort (macOS/BSD without psutil): lifetime peak RSS via
+        # getrusage — monotone, so window deltas under-count only when an
+        # earlier phase peaked higher
+        import resource
+
+        scale = 1 if sys.platform == "darwin" else 1024  # bytes vs KiB
+        return lambda: resource.getrusage(
+            resource.RUSAGE_SELF
+        ).ru_maxrss * scale
+
+    def __enter__(self):
+        import threading
+
+        self._stop = threading.Event()
+        self.baseline = self._read_rss()
+        self.peak = self.baseline
+
+        def run():
+            while not self._stop.is_set():
+                self.peak = max(self.peak, self._read_rss())
+                time.sleep(0.005)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join()
+        self.peak = max(self.peak, self._read_rss())
+
+    @property
+    def delta_mb(self):
+        return (self.peak - self.baseline) / 2**20
+
+
+def run_streaming_ablation(x, y, base_params, actors):
+    """Materialized-vs-streamed ingestion ablation on the ambient mesh
+    (ROADMAP item 1's measured contract).
+
+    Two arms over the SAME data, fresh and back-to-back: the materialized
+    engine (raw f32 shard device-put + on-device sketch) and the streamed
+    engine (chunked two-pass sketch→bin with double-buffered upload). Per
+    arm: peak host RSS delta while the engine builds + trains (streamed
+    must drop — the raw f32 copies never exist), ingest wall time, and the
+    final train logloss; the streamed arm additionally records ingest
+    throughput (the tripwire metric), the sketch/bin/H2D phase split from
+    the engine's stream stats, and the overlap efficiency — the fraction
+    of the smaller of (bin, H2D) hidden behind the other by the double
+    buffer. The accuracy contract (|streamed - materialized| final logloss
+    <= STREAMING_LOGLOSS_TOL) is recorded as ``logloss_delta_ok`` and a
+    violation prints a LOUD stderr line — tests/test_streaming.py pins the
+    bound itself; the bench records it at scale.
+    """
+    import gc
+
+    from xgboost_ray_tpu.engine import TpuEngine
+    from xgboost_ray_tpu.params import parse_params
+    from xgboost_ray_tpu.stream.reader import array_shard_stream
+
+    rounds = int(os.environ.get("BENCH_STREAM_ROUNDS", "8"))
+    chunk_rows = int(os.environ.get(
+        "BENCH_STREAM_CHUNK", str(max(4096, x.shape[0] // 16))
+    ))
+    parsed = parse_params({
+        k: v for k, v in base_params.items() if k != "tree_method"
+    })
+
+    def binary_logloss(margin):
+        p = 1.0 / (1.0 + np.exp(-np.asarray(margin, np.float64).ravel()))
+        p = np.clip(p, 1e-15, 1 - 1e-15)
+        return float(-np.mean(y * np.log(p) + (1 - y) * np.log1p(-p)))
+
+    out = {"rounds": rounds}
+    logloss = {}
+    for arm in ("materialized", "streamed"):
+        gc.collect()
+        with _RssPeakSampler() as rss:
+            t0 = time.time()
+            if arm == "streamed":
+                shards = [array_shard_stream(x, label=y,
+                                             chunk_rows=chunk_rows)]
+            else:
+                shards = [{"data": x, "label": y}]
+            eng = TpuEngine(shards, parsed, num_actors=actors)
+            ingest_s = time.time() - t0
+            for i in range(rounds):
+                eng.step(i)
+        margin = eng._fetch_rows(eng.margins, eng.valid, x.shape[0])
+        logloss[arm] = binary_logloss(margin)
+        arm_out = {
+            "rss_peak_delta_mb": round(rss.delta_mb, 1),
+            "ingest_s": round(ingest_s, 3),
+            "final_logloss": round(logloss[arm], 6),
+        }
+        if arm == "streamed":
+            stats = eng._stream_stats or {}
+            arm_out["rows_per_s"] = round(x.shape[0] / max(ingest_s, 1e-9), 1)
+            for k in ("chunks", "sketch_s", "bin_s", "transfer_s",
+                      "pass2_wall_s", "rank_error_bound_max"):
+                if k in stats:
+                    arm_out[k] = stats[k]
+            bin_s = float(stats.get("bin_s") or 0.0)
+            h2d_s = float(stats.get("transfer_s") or 0.0)
+            wall2 = float(stats.get("pass2_wall_s") or 0.0)
+            hidden = max(0.0, bin_s + h2d_s - wall2)
+            denom = max(min(bin_s, h2d_s), 1e-9)
+            arm_out["overlap_efficiency"] = round(
+                min(1.0, hidden / denom), 3
+            )
+        out[arm] = arm_out
+        del eng
+    out["logloss_delta"] = round(
+        abs(logloss["streamed"] - logloss["materialized"]), 6
+    )
+    out["logloss_delta_ok"] = out["logloss_delta"] <= STREAMING_LOGLOSS_TOL
+    if not out["logloss_delta_ok"]:
+        print(
+            f"[bench] STREAMING ACCURACY: streamed final logloss drifted "
+            f"{out['logloss_delta']} from materialized "
+            f"(tolerance {STREAMING_LOGLOSS_TOL}) — the sketch path's cuts "
+            f"moved; see the streaming runbook in README.",
+            file=sys.stderr,
+        )
+    out["rss_drop_ok"] = (
+        out["streamed"]["rss_peak_delta_mb"]
+        < out["materialized"]["rss_peak_delta_mb"]
+    )
+    out["config"] = {
+        "rows": int(x.shape[0]),
+        "features": int(x.shape[1]),
+        "rounds": rounds,
+        "chunk_rows": chunk_rows,
+        "actors": actors,
+        "max_depth": int(parsed.max_depth),
+    }
+    return out
+
+
 def wide_feature_round_time_tripwire(current_wide, prev_rec, prev_name=None,
                                      backend=None,
                                      threshold=WIDE_FEATURE_TRIPWIRE_RATIO):
@@ -1824,6 +2054,21 @@ def run_measurement():
         if ltrip is not None:
             lp_section["regression_tripwire"] = ltrip
         detail["low_precision"] = lp_section
+
+    # streamed-vs-materialized ingestion ablation (ROADMAP item 1): peak
+    # host RSS, ingest wall time, overlap efficiency, and the 5e-4 final-
+    # logloss contract, with the >20% ingest-throughput tripwire. Default
+    # on for the CPU mesh; opt-in on TPU via BENCH_STREAMING=1.
+    stream_env = os.environ.get("BENCH_STREAMING")
+    if stream_env == "1" or (stream_env is None and not on_tpu):
+        stream_section = run_streaming_ablation(x, y, params, actors)
+        strip2 = streaming_ingest_tripwire(
+            stream_section, prev_rec, prev_name, backend=backend
+        )
+        if strip2 is not None:
+            stream_section["regression_tripwire"] = strip2
+        detail["streaming"] = stream_section
+        print(f"[bench] streaming ablation: {stream_section}", file=sys.stderr)
 
     # wide-feature (F=2048, CTR-shaped) 1D-vs-2D mesh ablation: (8,1) row
     # sharding vs the (4,2) row x feature mesh, recording per-round time,

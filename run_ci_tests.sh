@@ -30,6 +30,7 @@ python -m pytest tests/test_ops.py -v -x
 python -m pytest tests/test_engine.py -v -x
 python -m pytest tests/test_sampling.py -v -x
 python -m pytest tests/test_gh_precision.py -v -x
+python -m pytest tests/test_streaming.py -v -x
 python -m pytest tests/test_bench_tripwire.py -v -x
 python -m pytest tests/test_obs.py -v -x
 python -m pytest tests/test_end_to_end.py -v -x

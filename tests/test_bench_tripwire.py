@@ -549,3 +549,75 @@ def test_low_precision_tripwire_skips_incomparable_records():
         cur, rec_none, "x", backend="cpu") is None
     assert bench.low_precision_tripwire(None, rec_tpu, "x") is None
     assert bench.low_precision_tripwire({}, rec_tpu, "x") is None
+
+
+# ---------------------------------------------------------------------------
+# streamed-ingest throughput tripwire
+# ---------------------------------------------------------------------------
+
+_STREAM_CFG = {"rows": 200000, "features": 28, "rounds": 8,
+               "chunk_rows": 12500, "actors": 8, "max_depth": 6}
+
+
+def _streaming_section(rows_per_s, cfg=None):
+    return {
+        "rounds": 8,
+        "materialized": {"rss_peak_delta_mb": 400.0, "ingest_s": 1.0,
+                         "final_logloss": 0.513},
+        "streamed": {"rss_peak_delta_mb": 120.0, "ingest_s": 4.0,
+                     "rows_per_s": rows_per_s, "overlap_efficiency": 0.8,
+                     "final_logloss": 0.5131},
+        "logloss_delta": 0.0001,
+        "logloss_delta_ok": True,
+        "rss_drop_ok": True,
+        "config": dict(cfg if cfg is not None else _STREAM_CFG),
+    }
+
+
+def test_streaming_tripwire_fires_on_ingest_slowdown(capsys):
+    """A >25% drop in streamed ingest rows/s vs the newest snapshot fires
+    (the sketch/bin/H2D pipeline is the new hot path)."""
+    rec = {"metric": "m", "backend": "cpu",
+           "streaming": _streaming_section(50000.0)}
+    out = bench.streaming_ingest_tripwire(
+        _streaming_section(25000.0), rec, "BENCH_r06.json", backend="cpu"
+    )
+    assert out is not None and out["fired"]
+    assert out["ratio"] == 2.0
+    assert out["prev_rows_per_s"] == 50000.0
+    assert "STREAMING TRIPWIRE" in capsys.readouterr().err
+
+
+def test_streaming_tripwire_quiet_within_threshold(capsys):
+    rec = {"metric": "m", "backend": "cpu",
+           "streaming": _streaming_section(50000.0)}
+    out = bench.streaming_ingest_tripwire(
+        _streaming_section(42000.0), rec, "x", backend="cpu"
+    )
+    assert out is not None and not out["fired"]
+    assert "STREAMING TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_streaming_tripwire_reports_but_never_fires_on_config_mismatch(capsys):
+    other = dict(_STREAM_CFG, chunk_rows=50000)
+    rec = {"metric": "m", "backend": "cpu",
+           "streaming": _streaming_section(50000.0, other)}
+    out = bench.streaming_ingest_tripwire(
+        _streaming_section(10000.0), rec, "x", backend="cpu"
+    )
+    assert out is not None and not out["fired"]
+    assert out["config_mismatch"] is True
+    assert "STREAMING TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_streaming_tripwire_skips_incomparable_records():
+    cur = _streaming_section(25000.0)
+    rec_tpu = {"metric": "m", "backend": "tpu",
+               "streaming": _streaming_section(50000.0)}
+    assert bench.streaming_ingest_tripwire(
+        cur, rec_tpu, "x", backend="cpu") is None
+    rec_none = {"metric": "m", "backend": "cpu"}  # pre-streaming record
+    assert bench.streaming_ingest_tripwire(
+        cur, rec_none, "x", backend="cpu") is None
+    assert bench.streaming_ingest_tripwire(None, rec_tpu, "x") is None
+    assert bench.streaming_ingest_tripwire({}, rec_tpu, "x") is None

@@ -99,13 +99,27 @@ def _finding(t: TracedProgram, rule: str, message: str,
     )
 
 
+#: meta coordinates that are schedule-identity VARIANTS, not group splits:
+#: programs differing only in these must run the identical collective
+#: sequence ("world" — the elastic shrink/grow contract; "ingest" — the
+#: streamed data plane must not change any round-step program's schedule)
+_VARIANT_KEYS = ("world", "ingest")
+
+
 def _group_key(t: TracedProgram) -> tuple:
-    """Cross-world grouping: everything but ``world``."""
+    """Cross-variant grouping: everything but the variant coordinates."""
     return (
         t.record.name,
         tuple(sorted(
-            (k, v) for k, v in t.record.meta.items() if k != "world"
+            (k, v) for k, v in t.record.meta.items()
+            if k not in _VARIANT_KEYS
         )),
+    )
+
+
+def _variant_key(t: TracedProgram) -> tuple:
+    return tuple(
+        (k, t.record.meta[k]) for k in _VARIANT_KEYS if k in t.record.meta
     )
 
 
@@ -119,43 +133,54 @@ def check_trace_failures(traced: Sequence[TracedProgram],
 
 def check_schedule_identity(traced: Sequence[TracedProgram],
                             root: Optional[str] = None) -> List[Finding]:
-    """VER001: programs that only differ in ``world`` must run the identical
-    (prim, axes, dtype, rank) collective sequence — the deadlock-freedom
-    certificate for the elastic engine-cache's coexisting worlds."""
+    """VER001: programs that only differ in a VARIANT coordinate (``world``
+    and/or ``ingest``) must run the identical (prim, axes, dtype, rank)
+    collective sequence — the deadlock-freedom certificate for the elastic
+    engine-cache's coexisting worlds, and the streamed data plane's
+    round-step-identity certificate against the materialized world."""
     findings: List[Finding] = []
-    groups: Dict[tuple, Dict[int, List[TracedProgram]]] = {}
+    groups: Dict[tuple, Dict[tuple, List[TracedProgram]]] = {}
     for t in traced:
         if not t.ok or "world" not in t.record.meta:
             continue
         groups.setdefault(_group_key(t), {}).setdefault(
-            int(t.record.meta["world"]), []
+            _variant_key(t), []
         ).append(t)
-    for key, by_world in sorted(groups.items()):
-        if len(by_world) < 2:
+    for key, by_variant in sorted(groups.items()):
+        if len(by_variant) < 2:
             continue
-        worlds = sorted(by_world)
-        # per world: the sorted multiset of schedules (a name+meta can have
-        # several records at different shapes, all collective-free or alike)
-        def sched_set(w):
-            return sorted(t.analysis.schedule() for t in by_world[w])
-        ref_w = worlds[0]
-        ref = sched_set(ref_w)
-        for w in worlds[1:]:
-            cur = sched_set(w)
+        variants = sorted(by_variant)
+        # per variant: the sorted multiset of schedules (a name+meta can
+        # have several records at different shapes, all collective-free or
+        # alike)
+        def sched_set(v):
+            return sorted(t.analysis.schedule() for t in by_variant[v])
+
+        def label(v):
+            return ",".join(f"{k}={val}" for k, val in v)
+        if all(not s for v in variants for s in sched_set(v)):
+            # collective-free in every variant (e.g. the streamed upload
+            # assembly concats): record COUNTS may differ per variant (one
+            # per shape), but there is no schedule to diverge
+            continue
+        ref_v = variants[0]
+        ref = sched_set(ref_v)
+        for v in variants[1:]:
+            cur = sched_set(v)
             if cur == ref:
                 continue
-            t = by_world[w][0]
-            detail = _first_divergence(ref, cur, ref_w, w)
+            t = by_variant[v][0]
+            detail = _first_divergence(ref, cur, label(ref_v), label(v))
             findings.append(_finding(
                 t, "VER001",
-                f"collective schedule at world={w} differs from world="
-                f"{ref_w}: {detail}",
+                f"collective schedule at {label(v)} differs from "
+                f"{label(ref_v)}: {detail}",
                 root,
             ))
     return findings
 
 
-def _first_divergence(ref, cur, ref_w, w) -> str:
+def _first_divergence(ref, cur, ref_label, cur_label) -> str:
     if len(ref) != len(cur):
         return f"{len(ref)} vs {len(cur)} program variants"
     for rs, cs in zip(ref, cur):
@@ -164,10 +189,10 @@ def _first_divergence(ref, cur, ref_w, w) -> str:
         n = min(len(rs), len(cs))
         for i in range(n):
             if rs[i] != cs[i]:
-                return (f"position {i}: world={ref_w} runs {rs[i]}, "
-                        f"world={w} runs {cs[i]}")
-        return (f"length {len(rs)} (world={ref_w}) vs {len(cs)} "
-                f"(world={w}) collectives")
+                return (f"position {i}: {ref_label} runs {rs[i]}, "
+                        f"{cur_label} runs {cs[i]}")
+        return (f"length {len(rs)} ({ref_label}) vs {len(cs)} "
+                f"({cur_label}) collectives")
     return "schedules differ"
 
 

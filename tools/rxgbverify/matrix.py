@@ -32,6 +32,13 @@ class MatrixEntry:
     label: str
     overrides: Dict[str, object]
     worlds: Tuple[int, ...]
+    # streamed ingestion: the engine is built from chunked shard streams
+    # (multi-chunk, so the real streamed branch runs — single-chunk loads
+    # degrade to the materialized path by design). Streamed rows register
+    # round programs under meta ingest="streamed"; VER001 treats "ingest"
+    # like "world" and certifies the streamed schedule identical to the
+    # materialized row's.
+    streamed: bool = False
 
 
 #: the full CI matrix: grower x hist_quant(none/int8/int16) x sampling x
@@ -128,6 +135,17 @@ FULL_MATRIX: Tuple[MatrixEntry, ...] = (
         {"feature_parallel": 2, "gh_precision": "int8"},
         (2, 4),
     ),
+    # streamed ingestion (stream/): the rows-born-binned data plane. The
+    # round steps must trace the EXACT materialized schedules (VER001
+    # groups them with the rows above via the ingest variant axis), and the
+    # streamed cuts merge registers under the same engine.sketch_cuts name
+    # — pinning pmin/pmax/psum shape identity with the materialized sketch.
+    MatrixEntry("depthwise-streamed", {}, (2, 4), streamed=True),
+    MatrixEntry(
+        # composition: quantized gh over a streamed (pre-binned) matrix
+        "depthwise-streamed-int8gh", {"gh_precision": "int8"}, (4,),
+        streamed=True,
+    ),
 )
 
 #: tier-1 test subset: the two keystone rows (plain + quantized) at two
@@ -143,6 +161,10 @@ QUICK_MATRIX: Tuple[MatrixEntry, ...] = (
     # quantized gradients: the gh-plane analog of the quantized wire —
     # exercises the VER004 gh sub-checks in the fast tier
     MatrixEntry("depthwise-int8gh", {"gh_precision": "int8"}, (2, 4)),
+    # streamed ingestion at the keystone config: VER001 certifies the
+    # streamed world's collective schedule (round steps AND the sketch
+    # merge) is identical to the materialized depthwise-f32 rows above
+    MatrixEntry("depthwise-streamed", {}, (2, 4), streamed=True),
 )
 
 _GBLINEAR_WORLDS = (2, 4)
@@ -191,7 +213,18 @@ def trace_matrix(
         for entry in entries:
             for world in entry.worlds:
                 params = parse_params({**_BASE_PARAMS, **entry.overrides})
-                eng = TpuEngine(shards, params, num_actors=world)
+                if entry.streamed:
+                    from xgboost_ray_tpu.stream.reader import (
+                        array_shard_stream,
+                    )
+
+                    entry_shards = [array_shard_stream(
+                        shards[0]["data"], label=shards[0]["label"],
+                        chunk_rows=_ROWS // 4,
+                    )]
+                else:
+                    entry_shards = shards
+                eng = TpuEngine(entry_shards, params, num_actors=world)
                 eng.build_programs()
                 engines.append(eng)
         if not quick:

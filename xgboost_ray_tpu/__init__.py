@@ -34,6 +34,7 @@ from xgboost_ray_tpu.matrix import (
     RayDeviceQuantileDMatrix,
     RayQuantileDMatrix,
     RayShardingMode,
+    RayStreamingDMatrix,
     combine_data,
 )
 from xgboost_ray_tpu.data_sources import RayFileType
@@ -58,6 +59,7 @@ __all__ = [
     "RayDMatrix",
     "RayDeviceQuantileDMatrix",
     "RayQuantileDMatrix",
+    "RayStreamingDMatrix",
     "RayFileType",
     "RayShardingMode",
     "Data",

@@ -23,4 +23,18 @@ AXIS_ACTORS = "actors"
 #: ops/provider.py FeatureShard).
 AXIS_FEATURES = "features"
 
-__all__ = ["AXIS_ACTORS", "AXIS_FEATURES"]
+#: synthesized per-row fill for an optional column absent on SOME shards
+#: (or streamed chunks) while present on others — the ONE table consumed by
+#: both the materialized concat (``engine._concat_shards``) and the
+#: streamed ingest (``stream/ingest._concat_optional``), so the
+#: streamed/materialized parity contract cannot drift column by column.
+#: (qid is absent: its -1 fill is materialized-only — streamed qid gates.)
+SHARD_COLUMN_FILLS = {
+    "label": 0.0,
+    "weight": 1.0,
+    "base_margin": 0.0,
+    "label_lower_bound": 0.0,
+    "label_upper_bound": float("inf"),
+}
+
+__all__ = ["AXIS_ACTORS", "AXIS_FEATURES", "SHARD_COLUMN_FILLS"]

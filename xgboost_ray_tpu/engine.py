@@ -39,7 +39,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from xgboost_ray_tpu import obs
 from xgboost_ray_tpu import progreg
 from xgboost_ray_tpu.compat import shard_map_compat
-from xgboost_ray_tpu.constants import AXIS_ACTORS, AXIS_FEATURES
+from xgboost_ray_tpu.constants import (
+    AXIS_ACTORS,
+    AXIS_FEATURES,
+    SHARD_COLUMN_FILLS,
+)
 from xgboost_ray_tpu.models.booster import RayXGBoostBooster, stack_trees
 from xgboost_ray_tpu.ops import binning
 from xgboost_ray_tpu.ops.histogram import (
@@ -308,31 +312,94 @@ class TpuEngine:
         names = list(params.eval_metric) or [self.objective.default_metric]
         self.metric_names = names
 
+        # ---- streamed ingestion detection --------------------------------
+        # A streamed shard carries {"stream": ShardStream} instead of a raw
+        # array. Streams that fit in ONE chunk materialize here and take the
+        # standard path below — the engine then traces the EXACT
+        # pre-streaming programs, which is the bitwise-parity contract for
+        # small streamed loads (the PR 4/PR 10 default-traces-the-old-
+        # program discipline).
+        from xgboost_ray_tpu.stream import reader as stream_reader
+
+        streams = stream_reader.shard_streams(shards)
+        if streams is not None and all(s.n_chunks <= 1 for s in streams):
+            materialized = [
+                stream_reader.materialize_shard(sh) for sh in shards
+            ]
+            # eval entries aliasing the train shard list must keep aliasing
+            # the materialized one (the is-identity drives the train-set
+            # eval fast path); single-chunk streamed eval sets degrade too
+            evals = [
+                (
+                    materialized if eval_shards is shards
+                    else self._materialize_if_single_chunk(eval_shards),
+                    name,
+                )
+                for eval_shards, name in evals
+            ]
+            shards = materialized
+            streams = None
+        self._streamed = streams is not None
+        self._stream_stats: Optional[Dict[str, Any]] = None
+        self._stream_cuts_np: Optional[np.ndarray] = None
+        if self._streamed:
+            from xgboost_ray_tpu.params import validate_streaming_params
+
+            validate_streaming_params(params)
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "streamed ingestion is single-process only for now: the "
+                    "multi-host global row layout needs per-process chunk "
+                    "streams. Materialize the matrix on multi-host worlds."
+                )
+
         # ---- host data assembly ------------------------------------------
-        x, label, weight, base_margin, qid, lo, hi = _concat_shards(shards)
+        if self._streamed:
+            from xgboost_ray_tpu.stream import ingest as stream_ingest
+
+            # the FULL budget fail-fast before any byte streams: the
+            # N-scaling block-buffer term needs only the declared row
+            # counts, the mesh size, and the bin dtype — all known now.
+            # (bin_upload_pass re-checks with the measured sketch bytes.)
+            declared = sum(s.n_rows for s in streams)
+            _, _, pre_pad_to = self._global_row_layout(declared)
+            stream_ingest.prevalidate_budget(
+                streams,
+                block_rows=pre_pad_to // self.n_devices,
+                bin_itemsize=np.dtype(
+                    binning.bin_dtype(params.max_bin)
+                ).itemsize,
+                n_devices=self.n_devices,
+            )
+            pass1 = stream_ingest.sketch_pass(
+                streams, params.max_bin, cat_features=self._cat_features
+            )
+            x = None
+            label = (
+                pass1.label if pass1.label is not None
+                else np.zeros(pass1.n_rows, np.float32)
+            )
+            weight, base_margin, qid = pass1.weight, pass1.base_margin, pass1.qid
+            lo, hi = pass1.lower, pass1.upper
+            self.n_rows = pass1.n_rows
+            self.n_features = pass1.n_features
+        else:
+            x, label, weight, base_margin, qid, lo, hi = _concat_shards(shards)
+            self.n_rows = x.shape[0]
+            self.n_features = x.shape[1]
         if self.is_survival and lo is None and label is None:
             raise ValueError(
                 "survival:aft requires label_lower_bound/label_upper_bound "
                 "(or a plain label, interpreted as uncensored times)."
             )
-        self.n_rows = x.shape[0]
-        self.n_features = x.shape[1]
 
-        if any(i >= self.n_features for i in self._cat_features):
-            raise ValueError("feature_types has more entries than features.")
-        for fi in self._cat_features:
-            col = x[:, fi]
-            vals = col[~np.isnan(col)]
-            if vals.size and (
-                (vals < 0).any()
-                or (vals != np.round(vals)).any()
-                or vals.max() > params.max_bin - 2
-            ):
-                raise ValueError(
-                    f"categorical feature {fi} must hold integer codes in "
-                    f"[0, {params.max_bin - 2}] (max_bin={params.max_bin}); "
-                    f"raise max_bin or re-encode the column."
-                )
+        binning.validate_feature_types_count(self._cat_features, self.n_features)
+        # streamed loads validate categorical codes per chunk in sketch_pass
+        # via the same shared validator (the full column never materializes)
+        if not self._streamed:
+            binning.validate_categorical_codes(
+                x, self._cat_features, params.max_bin
+            )
 
         # monotone / interaction constraints: validated against the real
         # feature count, then attached to the (jit-static) grow config.
@@ -425,7 +492,9 @@ class TpuEngine:
 
         self._put_rows = put_rows
         self.pad_to = pad_to
-        x_dev = put_rows(x, np.float32, fill=np.nan)
+        x_dev = None
+        if not self._streamed:
+            x_dev = put_rows(x, np.float32, fill=np.nan)
         self.valid = put_rows(np.ones(self._local_rows, bool), bool, fill=False)
         self.label_dev = put_rows(label, np.float32)
         self.weight_dev = put_rows(
@@ -452,9 +521,42 @@ class TpuEngine:
         # weight), so cut points concentrate where the weighted mass is.
         # weight_dev is all-ones when the user passed no weights, which makes
         # the weighted sketch bit-identical to the unweighted one.
-        self.bins, self.cuts, self._feat_has_missing = self._sketch_and_bin(
-            x_dev, self.valid, self.weight_dev
-        )
+        self._stream_init_margins = None
+        if self._streamed:
+            # streamed: two-pass host sketch -> device cuts merge (the SAME
+            # pmin/pmax/psum collective schedule as the materialized sketch
+            # program) -> chunked host binning with double-buffered upload.
+            # Rows are born binned; the raw f32 matrix never exists.
+            self.cuts, self._feat_has_missing, cuts_np, sk_err = (
+                stream_ingest.merged_cuts(self, pass1)
+            )
+            self._stream_cuts_np = cuts_np
+            self.bins, up_stats = stream_ingest.bin_upload_pass(
+                self, streams, cuts_np,
+                sketch_bytes=sum(
+                    sk.memory_bytes() for sk in pass1.sketches
+                ),
+            )
+            self._stream_stats = {
+                "chunks": int(pass1.chunks),
+                "sketch_s": round(pass1.sketch_s, 4),
+                "pass1_wall_s": round(pass1.wall_s, 4),
+                "rank_error_bound_max": float(sk_err.max(initial=0.0)),
+            }
+            for k, v in up_stats.items():
+                self._stream_stats[k] = (
+                    round(v, 4) if isinstance(v, float) else v
+                )
+            # warm start has no raw rows to walk: route the init forest over
+            # the binned matrix on device, BEFORE any feature-axis sharding
+            if init_booster is not None and init_booster.num_trees:
+                self._stream_init_margins = self._init_margins_from_bins(
+                    init_booster
+                )
+        else:
+            self.bins, self.cuts, self._feat_has_missing = self._sketch_and_bin(
+                x_dev, self.valid, self.weight_dev
+            )
 
         # ---- feature-axis sharding (feature_parallel > 1) ----------------
         # Sketch/binning ran at full F (one-off, row-parallel); the binned
@@ -539,10 +641,11 @@ class TpuEngine:
             else True
         )
         if init_booster is not None and init_booster.num_trees:
-            margins0 = margins0 + (
-                init_booster.predict_margin_np(x)
-                - init_booster.base_score_margin_np()
-            )
+            if not self._streamed:
+                margins0 = margins0 + (
+                    init_booster.predict_margin_np(x)
+                    - init_booster.base_score_margin_np()
+                )
             self._init_trees = [init_booster.forest]
             self._init_tree_weights = (
                 init_booster.tree_weights
@@ -550,6 +653,11 @@ class TpuEngine:
                 else np.ones(init_booster.num_trees, np.float32)
             )
         self.margins = put_rows(margins0, np.float32)
+        if self._stream_init_margins is not None:
+            # streamed warm start: the device binned-walk contribution
+            # (computed against this load's bins before feature sharding)
+            self.margins = self.margins + self._stream_init_margins
+            self._stream_init_margins = None
         self.dart = params.booster == "dart"
         if self.dart:
             self._margins_static_dev = put_rows(margins_static, np.float32)
@@ -626,6 +734,8 @@ class TpuEngine:
             )
         if params.gh_precision != "float32":
             self._obs_round_attrs["gh_precision"] = params.gh_precision
+        if self._streamed:
+            self._obs_round_attrs["streamed"] = True
         if samp_spec is not None:
             self._obs_round_attrs["sample_rows_per_shard"] = int(
                 sampling.row_budget(self.pad_to // self.n_devices, samp_spec)
@@ -730,6 +840,85 @@ class TpuEngine:
         bins, cuts, has_missing = jit_fn(x_dev, valid, weight_dev)
         return bins, cuts, has_missing
 
+    @staticmethod
+    def _materialize_if_single_chunk(shard_list):
+        """Degrade a single-chunk streamed shard list to materialized
+        fields (mirrors the train-set degrade); multi-chunk lists pass
+        through untouched (and hit the streamed-eval gate downstream)."""
+        from xgboost_ray_tpu.stream import reader as stream_reader
+
+        st = stream_reader.shard_streams(shard_list)
+        if st is not None and all(s.n_chunks <= 1 for s in st):
+            return [stream_reader.materialize_shard(sh) for sh in shard_list]
+        return shard_list
+
+    def _init_margins_from_bins(self, init_booster) -> jnp.ndarray:
+        """Warm-start margin contribution of ``init_booster`` over a
+        STREAMED load: walk the init forest against the binned device matrix
+        (raw features never exist), routing on ``split_bin``.
+
+        split_bin routing is only valid against the cuts the forest was
+        grown with. Streamed cuts are deterministic in (data, chunking,
+        world), so restart-from-checkpoint on an unchanged world always
+        matches bitwise; any cut drift is gated loudly instead of silently
+        mis-routing every split.
+        """
+        booster_cuts = np.asarray(init_booster.cuts, np.float32)
+        my_cuts = self._stream_cuts_np
+        if booster_cuts.shape != my_cuts.shape or not np.array_equal(
+            booster_cuts, my_cuts
+        ):
+            raise NotImplementedError(
+                "streamed warm start requires the checkpoint booster's "
+                "sketch cuts to equal this load's (same data, same "
+                "chunking, same world): re-binned rows cannot ride the "
+                "forest's split_bin routing across cut drift. Materialize "
+                "the matrix to warm start across worlds/cut changes."
+            )
+        forest = init_booster.forest
+        weights = (
+            init_booster.tree_weights
+            if init_booster.tree_weights is not None
+            else np.ones(forest.feature.shape[0], np.float32)
+        )
+        t_cap = forest.feature.shape[0]
+        k_out = self.n_outputs
+        tp = max(1, int(getattr(init_booster.params, "num_parallel_tree", 1)))
+        depth = int(init_booster.max_depth)
+        missing_bin = self.params.max_bin
+        cats = self.cfg.cat_features
+        forest_dev = Tree(*[jnp.asarray(f) for f in forest])
+        w_dev = jnp.asarray(np.asarray(weights, np.float32))
+        # round-major tree layout: tree t -> class (t // tp) % K (the
+        # predict_ops.predict_margin mapping)
+        cls_onehot = jax.nn.one_hot(
+            (jnp.arange(t_cap) // tp) % k_out, k_out, dtype=jnp.float32
+        )
+
+        def fn(bins):
+            leaf = jax.vmap(
+                lambda tr: predict_tree_binned(
+                    tr, bins, depth, missing_bin, cat_features=cats
+                )
+            )(forest_dev)  # [T, S]
+            return jnp.einsum(
+                "ts,tk->sk", leaf * w_dev[:, None], cls_onehot
+            ) / tp
+
+        mapped = shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(P(AXIS_ACTORS),),
+            out_specs=P(AXIS_ACTORS),
+        )
+        jit_fn = progreg.register_jit(
+            "stream.init_margins",
+            mapped,
+            example_args=(self.bins,),
+            meta=self._program_meta(),
+        )
+        return jit_fn(self.bins)
+
     def _bin_with_cuts(self, x_dev):
         max_bin = self.params.max_bin
         jit_fn = progreg.register_jit(
@@ -824,6 +1013,20 @@ class TpuEngine:
             es.upper_np = getattr(self, "upper_np", None)
             self.evals.append(es)
             return
+        from xgboost_ray_tpu.stream.reader import is_streamed_shards
+
+        # a single-chunk streamed eval set degrades to materialized fields
+        # regardless of how the TRAIN set arrived (the same contract as the
+        # train-side single-chunk degrade); only genuinely multi-chunk
+        # streams hit the gate
+        eval_shards = self._materialize_if_single_chunk(eval_shards)
+        if is_streamed_shards(eval_shards):
+            raise NotImplementedError(
+                f"eval set {name!r} is a streamed matrix: streamed "
+                f"ingestion is train-set only (eval margins need per-round "
+                f"device residency anyway). Materialize eval sets, or "
+                f"evaluate on the train set."
+            )
         x, label, weight, base_margin, qid, lo, hi = _concat_shards(eval_shards)
         local_rows = x.shape[0]
         n_global, local_pad, pad_to = self._global_row_layout(local_rows)
@@ -1192,6 +1395,13 @@ class TpuEngine:
             # cross-world identity group
             "max_depth": int(self.cfg.max_depth),
             "max_leaves": int(self.cfg.max_leaves),
+            # ingestion mode: like "world", a WITHIN-group variant axis —
+            # rxgbverify's VER001 requires streamed and materialized
+            # programs of one config to execute the identical collective
+            # schedule (the streamed sketch merge must not change any
+            # round-step program)
+            "ingest": "streamed" if getattr(self, "_streamed", False)
+            else "materialized",
         }
 
     def _default_group_rows(self):
@@ -1798,8 +2008,15 @@ class TpuEngine:
         mesh (feature_parallel > 1) likewise falls back to the legacy
         restart path: the elastic shrink/grow machinery reshapes the ROW
         axis only, and re-laying feature tiles over a changed world is not
-        supported until 2D reshard lands (README "2D mesh sharding")."""
-        return not self.dart and self.feature_parallel == 1
+        supported until 2D reshard lands (README "2D mesh sharding").
+        Streamed loads fall back too: a shrunken world re-streams and
+        re-sketches, producing new cuts the cached engine's binned matrix
+        cannot ride (README "Streaming ingestion", composition matrix)."""
+        return (
+            not self.dart
+            and self.feature_parallel == 1
+            and not self._streamed
+        )
 
     def reset_from_booster(self, shards, evals, init_booster) -> None:
         """Re-shard entry point: reuse this engine (compiled step programs,
@@ -1820,6 +2037,12 @@ class TpuEngine:
                 "reset_from_booster is not supported with "
                 "feature_parallel > 1 (2D meshes use the legacy restart "
                 "path; see can_reshard)."
+            )
+        if self._streamed:
+            raise ValueError(
+                "reset_from_booster is not supported for streamed matrices "
+                "(the legacy restart path re-streams and warm starts via "
+                "the binned forest walk; see can_reshard)."
             )
         x, _label, _weight, base_margin, _qid, _lo, _hi = _concat_shards(shards)
         if x.shape[0] != self._local_rows or x.shape[1] != self.n_features:
@@ -2378,6 +2601,13 @@ def shard_layout_fingerprint(shards) -> tuple:
     without an O(N) comparison."""
     parts = []
     for sh in shards:
+        stream = sh.get("stream")
+        if stream is not None:
+            # streamed shards: loaders are deterministic in (source, rank,
+            # chunking), so the stream's declared identity stands in for
+            # value samples (no rows exist to sample)
+            parts.append(stream.fingerprint())
+            continue
         d = np.asarray(sh["data"])
         flat = d.ravel()
         stride = max(1, flat.size // 256)
@@ -2392,7 +2622,11 @@ def shard_layout_fingerprint(shards) -> tuple:
 
 
 def _concat_shards(shards):
-    """Merge per-actor shard dicts (rank order) into global host arrays."""
+    """Merge per-actor shard dicts (rank order) into global host arrays.
+
+    Absent-column fills come from ``constants.SHARD_COLUMN_FILLS`` — the
+    same table the streamed ingest synthesizes from."""
+    fills = SHARD_COLUMN_FILLS
     xs, ys, ws, bs, qs = [], [], [], [], []
     has_w = has_b = has_q = False
     for sh in shards:
@@ -2401,13 +2635,14 @@ def _concat_shards(shards):
         ys.append(
             np.asarray(lab, np.float32)
             if lab is not None
-            else np.zeros(xs[-1].shape[0], np.float32)
+            else np.full(xs[-1].shape[0], fills["label"], np.float32)
         )
         w = sh.get("weight")
         if w is not None:
             has_w = True
         ws.append(
-            np.asarray(w, np.float32) if w is not None else np.ones(xs[-1].shape[0], np.float32)
+            np.asarray(w, np.float32) if w is not None
+            else np.full(xs[-1].shape[0], fills["weight"], np.float32)
         )
         b = sh.get("base_margin")
         if b is not None:
@@ -2437,7 +2672,8 @@ def _concat_shards(shards):
     w = (np.concatenate(ws, axis=0) if len(ws) > 1 else ws[0]) if has_w else None
     if has_b:
         bs = [
-            b if b is not None else np.zeros(xi.shape[0], np.float32)
+            b if b is not None
+            else np.full(xi.shape[0], fills["base_margin"], np.float32)
             for b, xi in zip(bs, xs)
         ]
         b = np.concatenate(bs, axis=0) if len(bs) > 1 else bs[0]
@@ -2453,7 +2689,8 @@ def _concat_shards(shards):
         q = None
     if has_ll:
         lls = [
-            l if l is not None else np.zeros(xi.shape[0], np.float32)
+            l if l is not None
+            else np.full(xi.shape[0], fills["label_lower_bound"], np.float32)
             for l, xi in zip(lls, xs)
         ]
         ll = np.concatenate(lls, axis=0) if len(lls) > 1 else lls[0]
@@ -2461,7 +2698,8 @@ def _concat_shards(shards):
         ll = None
     if has_lu:
         lus = [
-            l if l is not None else np.full(xi.shape[0], np.inf, np.float32)
+            l if l is not None
+            else np.full(xi.shape[0], fills["label_upper_bound"], np.float32)
             for l, xi in zip(lus, xs)
         ]
         lu = np.concatenate(lus, axis=0) if len(lus) > 1 else lus[0]

@@ -240,7 +240,10 @@ class RayXGBoostActor:
         faults.fire("actor.load_shard", rank=self.rank)
         self._distributed_callbacks.before_data_loading(self, data)
         shard = data.get_data(self.rank, self.num_actors)
-        n = shard["data"].shape[0] if shard["data"] is not None else 0
+        if shard.get("stream") is not None:
+            n = shard["stream"].n_rows
+        else:
+            n = shard["data"].shape[0] if shard.get("data") is not None else 0
         self._local_n[data] = n
         self._data[data] = shard
         self._distributed_callbacks.after_data_loading(self, data)
@@ -724,6 +727,12 @@ def _train(
         else:
             eff_params["max_bin"] = int(dm_max_bin)
     parsed = parse_params(eff_params)
+    if getattr(dtrain, "streamed", False):
+        # fail the unsupported compositions (gblinear, ranking) BEFORE any
+        # actor loads a chunk — the engine re-validates defensively
+        from xgboost_ray_tpu.params import validate_streaming_params
+
+        validate_streaming_params(parsed)
     train_cats = dtrain.resolved_categories
 
     def _build_world(world_actors, world_init):
@@ -2120,6 +2129,14 @@ def predict(
             f"The `data` argument passed to `predict()` is not a RayDMatrix, "
             f"but of type {type(data)}. FIX THIS by instantiating a "
             f"RayDMatrix first: `data = RayDMatrix(data)`."
+        )
+    if getattr(data, "streamed", False):
+        raise NotImplementedError(
+            "predict() over a streamed matrix is not supported: the tree "
+            "walk needs raw feature values (thresholds), which a streamed "
+            "load never materializes. Streamed ingestion is a training-side "
+            "memory optimization — predict from a materialized RayDMatrix "
+            "(or the serve/ layer)."
         )
     model = _coerce_model(model)
     max_actor_restarts = (

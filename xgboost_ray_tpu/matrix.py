@@ -42,14 +42,23 @@ class RayShardingMode(Enum):
     FIXED = 3
 
 
+def _batch_split_points(num_actors: int, n: int) -> np.ndarray:
+    """Contiguous BATCH row boundaries (the reference's remainder
+    semantics, ``matrix.py:1088-1110``): rank r owns
+    ``[points[r], points[r+1])``. The ONE place the split math lives —
+    consumed by ``_get_sharding_indices`` and the streamed .npy row
+    windows, which must never diverge."""
+    n_per_actor, extras = divmod(n, num_actors)
+    sizes = [n_per_actor + 1] * extras + [n_per_actor] * (num_actors - extras)
+    return np.concatenate([[0], np.cumsum(sizes)])
+
+
 def _get_sharding_indices(
     sharding: RayShardingMode, rank: int, num_actors: int, n: int
 ) -> List[int]:
     """Row/file indices owned by ``rank`` (semantics of ``matrix.py:1088-1110``)."""
     if sharding == RayShardingMode.BATCH:
-        n_per_actor, extras = divmod(n, num_actors)
-        sizes = [n_per_actor + 1] * extras + [n_per_actor] * (num_actors - extras)
-        points = np.concatenate([[0], np.cumsum(sizes)])
+        points = _batch_split_points(num_actors, n)
         return list(range(points[rank], points[rank + 1]))
     if sharding == RayShardingMode.INTERLEAVED:
         return list(range(rank, n, num_actors))
@@ -312,11 +321,16 @@ class _CentralRayDMatrixLoader(_RayDMatrixLoader):
     """Driver loads the full dataset once, then row-shards per rank
     (``matrix.py:431-487``)."""
 
-    def load_data(self, num_actors: int, sharding: RayShardingMode):
+    def load_fields(self) -> Dict[str, Optional[np.ndarray]]:
+        """Load + split ONCE without per-rank copies (the streamed central
+        path slices chunks out of these arrays lazily)."""
         source = self.get_data_source()
         df = source.load_data(self.data, ignore=self.ignore, **self.kwargs)
         df = source.update_feature_names(df, None)
-        fields = self._split_dataframe(df)
+        return self._split_dataframe(df)
+
+    def load_data(self, num_actors: int, sharding: RayShardingMode):
+        fields = self.load_fields()
         n = fields["data"].shape[0]
         if num_actors > n:
             raise RuntimeError(
@@ -430,8 +444,32 @@ class RayDMatrix:
         distributed: Optional[bool] = None,
         sharding: RayShardingMode = RayShardingMode.INTERLEAVED,
         lazy: bool = False,
+        stream: bool = False,
+        chunk_rows: Optional[int] = None,
+        budget_mb: Optional[float] = None,
+        sketch_capacity: Optional[int] = None,
         **kwargs,
     ):
+        # streamed ingestion mode (ROADMAP item 1): shards materialize as
+        # chunked readers instead of raw arrays; the engine's two-pass
+        # sketch->bin pipeline keeps peak host memory O(chunk + sketch).
+        # RXGB_STREAM_* env knobs fill whatever isn't passed explicitly.
+        self.streamed = bool(stream)
+        self.stream_config = None
+        if self.streamed:
+            from xgboost_ray_tpu.stream.reader import StreamConfig
+
+            self.stream_config = StreamConfig(
+                chunk_rows=chunk_rows,
+                budget_mb=budget_mb,
+                sketch_capacity=sketch_capacity,
+            )
+        elif chunk_rows is not None or budget_mb is not None \
+                or sketch_capacity is not None:
+            raise ValueError(
+                "chunk_rows/budget_mb/sketch_capacity require stream=True "
+                "(or RayStreamingDMatrix)."
+            )
         if kwargs.get("group", None) is not None:
             raise ValueError(
                 "`group` parameter is not supported; use `qid` instead."
@@ -552,6 +590,10 @@ class RayDMatrix:
             raise ValueError("Pass `num_actors` to load a RayDMatrix.")
         if self.loaded:
             return
+        if self.streamed:
+            self._load_streamed()
+            self.loaded = True
+            return
         if isinstance(self.loader, _CentralRayDMatrixLoader):
             self.refs, self.n = self.loader.load_data(self.num_actors, self.sharding)
             self.loaded = True
@@ -559,17 +601,161 @@ class RayDMatrix:
             # distributed: shards materialize per rank in get_data
             self.loaded = True
 
+    # -- streamed loading --------------------------------------------------
+
+    @staticmethod
+    def _is_npy(path) -> bool:
+        return isinstance(path, str) and path.endswith(".npy")
+
+    def _load_streamed(self) -> None:
+        """Build the per-rank {"stream": ShardStream} refs.
+
+        Three chunk sources: a .npy feature file (raw offset reads; BATCH
+        row windows per rank), in-memory central data (lazy row slices of
+        the once-loaded arrays — no per-rank copies), and file lists
+        (per-rank CSV/Parquet chunk iteration, built lazily in get_data).
+        """
+        from xgboost_ray_tpu.stream.reader import (
+            fields_shard_stream,
+            npy_shard_stream,
+        )
+
+        if self._is_npy(self.loader.data):
+            if self.sharding != RayShardingMode.BATCH:
+                raise ValueError(
+                    "streamed .npy ingestion reads contiguous row windows; "
+                    "pass sharding=RayShardingMode.BATCH."
+                )
+            for field, val in (("label", self.loader.label),
+                               ("weight", self.loader.weight)):
+                if val is not None and not self._is_npy(val):
+                    raise ValueError(
+                        f"streamed .npy ingestion takes `{field}` as a "
+                        f".npy path aligned row-for-row with the data file."
+                    )
+            # anything the npy reader cannot deliver must fail loudly, not
+            # silently train without it (the no-silent-fallback invariant)
+            for field in ("base_margin", "label_lower_bound",
+                          "label_upper_bound", "qid"):
+                if getattr(self.loader, field) is not None:
+                    raise NotImplementedError(
+                        f"streamed .npy ingestion supports label/weight "
+                        f"side files only; `{field}` would be silently "
+                        f"dropped. Use CSV/Parquet streaming (column "
+                        f"references) or materialize the matrix."
+                    )
+            # ditto for the dataframe-split transforms the raw offset reads
+            # bypass: a `missing` sentinel would be sketched/binned as real
+            # feature values, and `ignore` has no column names to act on
+            if self.loader.missing is not None and \
+                    not np.isnan(self.loader.missing):
+                raise NotImplementedError(
+                    "streamed .npy ingestion does not apply a `missing` "
+                    "sentinel (raw offset reads bypass the dataframe "
+                    "split); encode missing values as NaN in the .npy "
+                    "file, or use CSV/Parquet streaming."
+                )
+            if self.loader.ignore:
+                raise NotImplementedError(
+                    "streamed .npy ingestion cannot honor `ignore`: a "
+                    ".npy matrix has no column names. Drop the columns "
+                    "from the file, or use CSV/Parquet streaming."
+                )
+            probe = npy_shard_stream(self.loader.data, config=self.stream_config)
+            n = probe.n_rows
+            if self.num_actors > n:
+                raise RuntimeError(
+                    f"Trying to shard data for {self.num_actors} actors, "
+                    f"but the dataset has only {n} rows. Use fewer actors."
+                )
+            points = _batch_split_points(self.num_actors, n)
+            for rank in range(self.num_actors):
+                self.refs[rank] = {"stream": npy_shard_stream(
+                    self.loader.data,
+                    label_path=self.loader.label,
+                    weight_path=self.loader.weight,
+                    config=self.stream_config,
+                    row_range=(int(points[rank]), int(points[rank + 1])),
+                )}
+            self.n = n
+            return
+        if isinstance(self.loader, _CentralRayDMatrixLoader):
+            fields = self.loader.load_fields()
+            n = fields["data"].shape[0]
+            if self.num_actors > n:
+                raise RuntimeError(
+                    f"Trying to shard data for {self.num_actors} actors, "
+                    f"but the dataset has only {n} rows. Use fewer actors."
+                )
+            for rank in range(self.num_actors):
+                idx = np.asarray(_get_sharding_indices(
+                    self.sharding, rank, self.num_actors, n
+                ))
+                self.refs[rank] = {"stream": fields_shard_stream(
+                    fields, idx, config=self.stream_config,
+                    source_token=("central", self._uid, rank),
+                )}
+            self.n = n
+            return
+        # distributed file lists: per-rank streams build lazily in get_data
+
+    def _streamed_file_shard(self, rank: int) -> Dict[str, Any]:
+        from xgboost_ray_tpu.stream.reader import file_shard_stream
+
+        loader = self.loader
+        data = loader._expand()
+        source = loader.get_data_source()
+        if loader.actor_shards is not None:
+            indices = loader.actor_shards.get(rank, [])
+        else:
+            n_parts = source.get_n(data)
+            if self.num_actors > n_parts:
+                raise RuntimeError(
+                    f"Trying to shard {n_parts} files/partitions across "
+                    f"{self.num_actors} actors: use fewer actors or central "
+                    f"loading."
+                )
+            indices = _get_sharding_indices(
+                self.sharding, rank, self.num_actors, n_parts
+            )
+        files = [data[i] for i in indices] if isinstance(data, (list, tuple)) \
+            else ([data] if indices else [])
+        if not files or not all(isinstance(f, str) for f in files):
+            raise NotImplementedError(
+                "streamed distributed loading needs file paths (CSV or "
+                "Parquet); partition/frame sources must be materialized."
+            )
+        ftype = {RayFileType.CSV: "csv", RayFileType.PARQUET: "parquet"}.get(
+            loader.filetype
+        )
+        if ftype is None:
+            raise NotImplementedError(
+                f"streamed ingestion supports CSV/Parquet/.npy sources; got "
+                f"filetype {loader.filetype!r}."
+            )
+
+        def split_fn(df):
+            df = source.update_feature_names(df, None)
+            return loader._split_dataframe(df)
+
+        return {"stream": file_shard_stream(
+            files, split_fn, ftype, config=self.stream_config,
+            read_kwargs=loader.kwargs,
+        )}
+
     def get_data(
         self, rank: int, num_actors: Optional[int] = None
     ) -> Dict[str, Optional[np.ndarray]]:
         self.load_data(num_actors)
         if rank not in self.refs:
-            if isinstance(self.loader, _DistributedRayDMatrixLoader):
+            if not isinstance(self.loader, _DistributedRayDMatrixLoader):
+                raise KeyError(f"No shard for rank {rank}")
+            if self.streamed:
+                self.refs[rank] = self._streamed_file_shard(rank)
+            else:
                 self.refs[rank] = self.loader.load_shard(
                     rank, self.num_actors, self.sharding
                 )
-            else:
-                raise KeyError(f"No shard for rank {rank}")
         return self.refs[rank]
 
     def unload_data(self):
@@ -589,8 +775,12 @@ class RayDMatrix:
     # -- introspection -----------------------------------------------------
 
     def get_shard_sizes(self) -> Dict[int, int]:
-        return {r: (s["data"].shape[0] if s["data"] is not None else 0)
-                for r, s in self.refs.items()}
+        def size(s):
+            if s.get("stream") is not None:
+                return s["stream"].n_rows
+            return s["data"].shape[0] if s.get("data") is not None else 0
+
+        return {r: size(s) for r, s in self.refs.items()}
 
     @property
     def resolved_feature_names(self) -> Optional[List[str]]:
@@ -619,6 +809,30 @@ class RayDMatrix:
 
     def __eq__(self, other):
         return isinstance(other, RayDMatrix) and self._uid == other._uid
+
+
+class RayStreamingDMatrix(RayDMatrix):
+    """Out-of-core ingestion mode: shards are chunked readers, not arrays.
+
+    Equivalent to ``RayDMatrix(..., stream=True)``. Training never
+    materializes the raw [N, F] float32 shard: a deterministic mergeable
+    quantile sketch streams over chunks (pass 1), global cuts merge on the
+    mesh through the materialized sketch program's collective shape, and
+    each chunk bins straight into the per-actor ``bin_dtype`` buffer with
+    double-buffered host→device upload (pass 2). Peak host memory is
+    O(chunk + sketch). Loads that fit in one chunk take the EXACT
+    materialized path (bitwise-identical cuts, bins, and trained forest).
+
+    Knobs (env fallbacks in parentheses): ``chunk_rows``
+    (``RXGB_STREAM_CHUNK_ROWS``), ``budget_mb`` (``RXGB_STREAM_BUDGET_MB``;
+    also derives chunk_rows when unset and validates the configured peak),
+    ``sketch_capacity`` (``RXGB_STREAM_SKETCH_CAP``). See README
+    "Streaming ingestion" for the memory model and composition matrix.
+    """
+
+    def __init__(self, *args, **kwargs):
+        kwargs["stream"] = True
+        super().__init__(*args, **kwargs)
 
 
 class RayQuantileDMatrix(RayDMatrix):

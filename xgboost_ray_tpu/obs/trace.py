@@ -62,6 +62,10 @@ _DEFAULT_CAPACITY = 8192
 TRACE_NAMES = frozenset({
     # engine round/phase spans (engine.py; phase spans via profile_phases)
     "round", "sample", "hist", "split", "partition", "margin", "allreduce",
+    # streamed ingestion (stream/ingest.py + stream/upload.py): one fenced
+    # span per sketch/bin chunk and per H2D transfer, one per cuts merge —
+    # a streamed load is reconstructible from the timeline alone
+    "data.sketch_chunk", "data.bin_chunk", "data.h2d", "data.cuts_merge",
     # driver lifecycle (main.py)
     "attempt", "failure.detected", "recovered", "backoff",
     "world.shrink", "world.grow", "world.resume", "world.restart",

@@ -46,14 +46,15 @@ def bin_dtype(max_bin: int):
 # ---------------------------------------------------------------------------
 
 
-def sketch_cuts_np(
+def _sketch_cuts_np_loop(
     x: np.ndarray, max_bin: int, sample_weight: Optional[np.ndarray] = None
 ) -> np.ndarray:
-    """Compute per-feature cut points on the host. Returns [F, max_bin-1].
+    """Reference per-feature-loop implementation of :func:`sketch_cuts_np`.
 
-    Cut points are the (i+1)/max_bin weighted quantiles of each feature's
-    non-missing values. Duplicate cuts are allowed (they produce empty bins,
-    which split finding simply never selects).
+    Kept (non-exported) as the bitwise oracle the vectorized version is
+    pinned against in ``tests/test_streaming.py`` — host sketching sits on
+    the streaming ingest hot path now, so the vectorized form is the one
+    that ships.
     """
     x = np.asarray(x, dtype=np.float32)
     if x.ndim != 2:
@@ -85,11 +86,117 @@ def sketch_cuts_np(
     return cuts
 
 
-def bin_matrix_np(x: np.ndarray, cuts: np.ndarray, max_bin: int) -> np.ndarray:
-    """Bin a raw feature matrix on the host. Returns [N, F] ints in 0..max_bin.
+def sketch_cuts_np(
+    x: np.ndarray, max_bin: int, sample_weight: Optional[np.ndarray] = None
+) -> np.ndarray:
+    """Compute per-feature cut points on the host. Returns [F, max_bin-1].
 
-    bin(x) = #cuts <= x  (``searchsorted(..., side='right')``), NaN -> max_bin.
+    Cut points are the (i+1)/max_bin weighted quantiles of each feature's
+    non-missing values. Duplicate cuts are allowed (they produce empty bins,
+    which split finding simply never selects).
+
+    Vectorized across the feature axis (bitwise-equal to
+    :func:`_sketch_cuts_np_loop`): the unweighted path is one
+    ``nanquantile`` over axis 0; the weighted path sorts every column at
+    once (stable, NaN last, NaN weights zeroed so the tail is inert) and
+    reads the weighted CDF per feature with the loop's exact
+    ``searchsorted(..., side='left')``, with no float-key arithmetic that
+    could flip boundary cases.
     """
+    x = np.asarray(x, dtype=np.float32)
+    if x.ndim != 2:
+        raise ValueError(f"expected 2-D feature matrix, got shape {x.shape}")
+    n, num_features = x.shape
+    qs = np.arange(1, max_bin, dtype=np.float64) / max_bin
+    nan = np.isnan(x)
+    all_nan = nan.all(axis=0)
+
+    def unweighted_cuts(cols: np.ndarray, cols_all_nan: np.ndarray):
+        with np.errstate(invalid="ignore"), \
+                np.testing.suppress_warnings() as sup:
+            sup.filter(RuntimeWarning)
+            out = (
+                np.nanquantile(cols, qs, axis=0).T.astype(np.float32)
+                if n else np.zeros((cols.shape[1], max_bin - 1), np.float32)
+            )
+        out[cols_all_nan] = 0.0
+        return out
+
+    if sample_weight is None or n == 0:
+        return unweighted_cuts(x, all_nan)
+
+    w = np.asarray(sample_weight, dtype=np.float64).reshape(n, 1)
+    w_eff = np.where(nan, 0.0, w)  # [n, F]
+    order = np.argsort(x, axis=0, kind="stable")  # NaN sorts last
+    sv = np.take_along_axis(x, order, axis=0)
+    sw = np.take_along_axis(w_eff, order, axis=0)
+    cw = np.cumsum(sw, axis=0)
+    total = cw[-1] if n else np.zeros(num_features)
+    weighted_ok = total > 0
+    z = cw / np.where(weighted_ok, total, 1.0)[None, :]
+    # per-feature searchsorted('left') on the sorted CDF == count of
+    # z < q, the loop oracle's exact semantics (a flat float-offset key
+    # could collapse z-vs-q boundary cases; per-quantile full-matrix
+    # comparison counts would be O(max_bin·N·F)). The zero-weight NaN
+    # tail holds z == 1.0 exactly, never counted for q < 1.
+    zt = np.ascontiguousarray(z.T)
+    idx = np.empty((num_features, max_bin - 1), np.int64)
+    for f in range(num_features):
+        idx[f] = np.searchsorted(zt[f], qs, side="left")
+    finite_n = n - nan.sum(axis=0)
+    idx = np.clip(idx, 0, np.maximum(finite_n, 1)[:, None] - 1)
+    cuts = np.take_along_axis(sv, idx.T, axis=0).T.astype(np.float32)
+    if weighted_ok.all():
+        return cuts
+    # unweighted fallback only for the zero-total-weight columns (the loop
+    # oracle's np.quantile arm) — not a full second quantile pass
+    bad = ~weighted_ok
+    cuts[bad] = unweighted_cuts(x[:, bad], all_nan[bad])
+    return cuts
+
+
+def validate_feature_types_count(cat_features, n_features: int) -> None:
+    """Every categorical feature index must name a real column."""
+    if any(i >= n_features for i in cat_features):
+        raise ValueError("feature_types has more entries than features.")
+
+
+def validate_categorical_codes(
+    x: np.ndarray, cat_features, max_bin: int
+) -> None:
+    """Categorical columns must hold integer codes in [0, max_bin-2]
+    (NaN = missing is fine). The ONE validator shared by the engine's
+    materialized load and the streamed per-chunk mirror, so the two paths
+    structurally cannot accept different data."""
+    validate_feature_types_count(cat_features, x.shape[1])
+    for fi in cat_features:
+        col = x[:, fi]
+        vals = col[~np.isnan(col)]
+        if vals.size and (
+            (vals < 0).any()
+            or (vals != np.round(vals)).any()
+            or vals.max() > max_bin - 2
+        ):
+            raise ValueError(
+                f"categorical feature {fi} must hold integer codes in "
+                f"[0, {max_bin - 2}] (max_bin={max_bin}); raise max_bin or "
+                f"re-encode the column."
+            )
+
+
+def _f32_order_keys(a: np.ndarray) -> np.ndarray:
+    """Strictly order-preserving uint64 keys of float32 values: the
+    sign-flipped bit pattern, with -0.0 normalized to +0.0 first so float
+    equality survives the transform. NaN keys are unspecified (mask them)."""
+    a = np.asarray(a, np.float32) + np.float32(0.0)  # -0.0 -> +0.0
+    u = a.view(np.uint32)
+    keys = np.where(u >> 31 == 1, ~u, u | np.uint32(0x80000000))
+    return keys.astype(np.uint64)
+
+
+def _bin_matrix_np_loop(x: np.ndarray, cuts: np.ndarray, max_bin: int) -> np.ndarray:
+    """Reference per-feature-loop implementation of :func:`bin_matrix_np`
+    (the bitwise oracle for the flat-searchsorted version)."""
     x = np.asarray(x, dtype=np.float32)
     n, num_features = x.shape
     out = np.empty((n, num_features), dtype=bin_dtype(max_bin))
@@ -98,6 +205,52 @@ def bin_matrix_np(x: np.ndarray, cuts: np.ndarray, max_bin: int) -> np.ndarray:
         b = np.searchsorted(cuts[f], col, side="right")
         b = np.where(np.isnan(col), max_bin, b)
         out[:, f] = b.astype(out.dtype)
+    return out
+
+
+#: row-block size bounding bin_matrix_np's transient uint64 key buffers
+#: (~4 x F x 8 bytes per row in flight; 8192 rows x F=2048 ≈ 0.5 GB would
+#: be the 65536 figure — the streaming budget wants these transients small)
+_BIN_BLOCK_ROWS = 8192
+
+
+def bin_matrix_np(x: np.ndarray, cuts: np.ndarray, max_bin: int) -> np.ndarray:
+    """Bin a raw feature matrix on the host. Returns [N, F] ints in 0..max_bin.
+
+    bin(x) = #cuts <= x  (``searchsorted(..., side='right')``), NaN -> max_bin.
+
+    One flat ``searchsorted`` over the whole feature axis instead of a
+    per-column Python loop (this is the streaming ingest hot path; at
+    F=2048 the per-column loop is real time): values and cuts map through
+    the order-preserving float32 bit-pattern keys, offset per feature by
+    ``f << 32`` so feature blocks can never interleave — bitwise-equal to
+    :func:`_bin_matrix_np_loop` by strict monotonicity of the key map.
+    """
+    x = np.asarray(x, dtype=np.float32)
+    cuts = np.asarray(cuts, np.float32)
+    if np.isnan(cuts).any():
+        # NaN keys are unspecified under _f32_order_keys, so NaN cuts (a
+        # feature whose quantiles mix -inf and +inf) would break the flat
+        # key array's sortedness and bin silently differently from the
+        # per-feature oracle — fail loudly instead
+        raise ValueError(
+            "cut points contain NaN (a feature holding both -inf and "
+            "+inf?); clean non-finite values out of the feature matrix."
+        )
+    n, num_features = x.shape
+    n_cuts = cuts.shape[1]
+    feat_off = (np.arange(num_features, dtype=np.uint64) << np.uint64(32))
+    flat_cuts = (_f32_order_keys(cuts) + feat_off[:, None]).ravel()
+    out = np.empty((n, num_features), dtype=bin_dtype(max_bin))
+    for lo in range(0, n, _BIN_BLOCK_ROWS):
+        hi = min(lo + _BIN_BLOCK_ROWS, n)
+        block = x[lo:hi]
+        keys = _f32_order_keys(block) + feat_off[None, :]
+        b = np.searchsorted(flat_cuts, keys.ravel(), side="right").reshape(
+            hi - lo, num_features
+        )
+        b = b - np.arange(num_features, dtype=np.int64)[None, :] * n_cuts
+        out[lo:hi] = np.where(np.isnan(block), max_bin, b).astype(out.dtype)
     return out
 
 
@@ -137,6 +290,32 @@ def sketch_histogram(
     wv = jnp.where(mask, w[:, None], 0.0)
     # One scatter-add per feature via segment offsets into a flat histogram.
     flat_idx = idx + (jnp.arange(num_features, dtype=jnp.int32) * SKETCH_BINS)[None, :]
+    hist = jnp.zeros((num_features * SKETCH_BINS,), jnp.float32)
+    hist = hist.at[flat_idx.reshape(-1)].add(wv.reshape(-1))
+    return hist.reshape(num_features, SKETCH_BINS)
+
+
+def sketch_histogram_items(
+    vals: jnp.ndarray, wts: jnp.ndarray, mn: jnp.ndarray, mx: jnp.ndarray
+) -> jnp.ndarray:
+    """Rasterize per-feature summary items onto the fine sketch grid.
+
+    The streamed-ingest analog of :func:`sketch_histogram`: instead of raw
+    rows, the input is one actor's exported quantile-sketch summary —
+    ``vals``/``wts`` [F, C] (inert slots hold (+inf, 0)). The bucket-index
+    formula is identical, so the merged histogram feeds the SAME
+    :func:`cuts_from_sketch` readout and the psum merge shape matches the
+    materialized sketch program collective for collective.
+    """
+    num_features, _cap = vals.shape
+    scale = jnp.where(mx > mn, (mx - mn), 1.0)
+    t = (vals - mn[:, None]) / scale[:, None]
+    idx = jnp.clip((t * SKETCH_BINS).astype(jnp.int32), 0, SKETCH_BINS - 1)
+    mask = jnp.isfinite(vals) & (wts > 0)
+    wv = jnp.where(mask, wts.astype(jnp.float32), 0.0)
+    flat_idx = idx + (
+        jnp.arange(num_features, dtype=jnp.int32) * SKETCH_BINS
+    )[:, None]
     hist = jnp.zeros((num_features * SKETCH_BINS,), jnp.float32)
     hist = hist.at[flat_idx.reshape(-1)].add(wv.reshape(-1))
     return hist.reshape(num_features, SKETCH_BINS)

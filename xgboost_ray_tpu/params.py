@@ -136,6 +136,49 @@ class TrainParams:
     feature_parallel: int = 1
 
 
+def validate_streaming_params(params: "TrainParams") -> None:
+    """Composition gates for streamed (external-memory) ingestion.
+
+    Streaming happens POST-sketch/PRE-histogram, so anything that only
+    consumes the binned matrix composes: ``feature_parallel > 1`` (sharding
+    happens post-bin), ``gh_precision`` (the gh plane is margin-derived),
+    ``hist_quant``/``hist_impl``/``hist_precision``, row sampling (uniform
+    and GOSS compact binned rows), depthwise and lossguide growers,
+    monotone/interaction constraints, dart, custom objectives, survival
+    bounds, and elastic training for SAME-WORLD restarts (failures take
+    the legacy restart-and-re-stream path — see ``TpuEngine.can_reshard``;
+    a permanently shrunken world re-sketches to different cuts and the
+    warm-start cut-drift gate raises instead of mis-routing split_bin).
+
+    What does NOT compose is gated loudly here (the repo's
+    no-silent-fallback invariant):
+
+    * ``booster='gblinear'`` — the linear engine consumes raw feature
+      values, which a streamed load never materializes;
+    * ``rank:*`` objectives — query groups need a global qid-contiguity
+      sort the chunk pipeline cannot perform (the qid column itself is also
+      rejected at ingest).
+
+    Multi-host worlds and streamed EVAL sets are gated at their own seams
+    (engine init / ``_add_eval_set``).
+    """
+    if params.booster == "gblinear":
+        raise NotImplementedError(
+            "streamed ingestion is not supported with booster='gblinear': "
+            "the linear engine trains on raw feature values, which a "
+            "streamed load never materializes. Materialize the matrix or "
+            "use a tree booster."
+        )
+    obj = params.objective
+    if isinstance(obj, str) and obj.startswith("rank:"):
+        raise NotImplementedError(
+            f"streamed ingestion is not supported with objective={obj!r}: "
+            f"ranking needs qid-contiguous query groups, which require a "
+            f"global sort the chunk pipeline cannot do. Materialize the "
+            f"matrix for ranking."
+        )
+
+
 def cat_feature_indices(feature_types: Optional[Sequence[Any]]) -> tuple:
     """Indices marked categorical ('c') in an xgboost feature_types list."""
     return tuple(

@@ -1,0 +1,53 @@
+"""Out-of-core streaming data plane: train from data that never fully
+materializes as a raw float32 matrix.
+
+The reference's L4 layer (``matrix.py`` + 10 pluggable data sources) exists
+so beyond-RAM data can stream in shards; this package is the TPU-native
+equivalent:
+
+* :mod:`xgboost_ray_tpu.stream.sketch` — a mergeable, deterministic,
+  weight-aware KLL-style per-feature quantile sketch updated chunk by chunk
+  on the host. Same rows in the same order produce the bitwise-same summary
+  for ANY chunking, and every compaction's rank perturbation is accumulated
+  into a runtime error certificate.
+* :mod:`xgboost_ray_tpu.stream.reader` — chunked readers
+  (numpy arrays, ``.npy`` files, CSV, Parquet) wrapped as ``ShardStream``
+  objects: the shard handle the engine ingests instead of a raw array.
+* :mod:`xgboost_ray_tpu.stream.upload` — the double-buffered host→device
+  uploader: chunk binning on the host overlaps the H2D transfer of the
+  previous chunk.
+* :mod:`xgboost_ray_tpu.stream.ingest` — the two-pass sketch→bin pipeline
+  the engine drives: pass 1 streams chunks through the sketch (and collects
+  the small per-row columns), the per-actor summaries merge on device
+  through the SAME pmin/pmax/psum collective shape as the materialized
+  sketch (``engine.sketch_cuts``), and pass 2 bins each chunk straight into
+  the per-actor ``bin_dtype`` buffer with overlapped upload. Peak host
+  memory is O(chunk + sketch), never O(N·F) float32.
+
+Environment knobs (all overridable per-matrix via ``RayStreamingDMatrix``
+arguments): ``RXGB_STREAM_CHUNK_ROWS`` (rows per ingest chunk),
+``RXGB_STREAM_BUDGET_MB`` (host-memory budget the chunk size is derived
+from and validated against), ``RXGB_STREAM_SKETCH_CAP`` (per-level sketch
+buffer capacity), ``RXGB_STREAM_PREFETCH`` (upload queue depth; 2 = double
+buffering).
+"""
+
+from xgboost_ray_tpu.stream.reader import (
+    ShardStream,
+    StreamConfig,
+    array_shard_stream,
+    is_streamed_shards,
+    materialize_shard,
+    shard_streams,
+)
+from xgboost_ray_tpu.stream.sketch import StreamSketch
+
+__all__ = [
+    "ShardStream",
+    "StreamConfig",
+    "StreamSketch",
+    "array_shard_stream",
+    "is_streamed_shards",
+    "materialize_shard",
+    "shard_streams",
+]

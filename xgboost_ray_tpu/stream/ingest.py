@@ -1,0 +1,478 @@
+"""Two-pass streamed ingestion pipeline (the engine's streamed data plane).
+
+Pass 1 (``sketch_pass``): each shard's chunk stream runs through a
+deterministic :class:`~xgboost_ray_tpu.stream.sketch.StreamSketch` on the
+host while the small per-row columns (label/weight/base_margin/bounds)
+accumulate — the raw [N, F] float32 matrix never exists; peak memory is
+O(chunk + sketch).
+
+Cuts merge (``merged_cuts``): per-device merged summaries ride a shard_map
+program with the SAME collective shape as the materialized sketch
+(``pmin(min) → pmax(max) → psum(fine histogram) → psum(missing mass)``,
+reusing ``ops/binning.py``'s grid and CDF readout) — registered under the
+same ``engine.sketch_cuts`` program name so rxgbverify's schedule-identity
+pass certifies streamed and materialized worlds execute identical
+collective sequences.
+
+Pass 2 (``bin_upload_pass``): chunks re-stream, bin on the host with the
+vectorized ``bin_matrix_np`` straight into ``bin_dtype`` blocks, and a
+:class:`~xgboost_ray_tpu.stream.upload.DoubleBufferedUploader` overlaps the
+H2D transfer of each block part with the binning of the next chunk. Each
+phase emits fenced spans (``data.sketch_chunk`` / ``data.cuts_merge`` /
+``data.bin_chunk`` / ``data.h2d``), so a streamed load is reconstructible
+from the timeline alone.
+"""
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from xgboost_ray_tpu import obs, progreg
+from xgboost_ray_tpu.compat import shard_map_compat as shard_map
+from xgboost_ray_tpu.constants import AXIS_ACTORS, SHARD_COLUMN_FILLS
+from xgboost_ray_tpu.ops import binning
+from xgboost_ray_tpu.stream.reader import ShardStream
+from xgboost_ray_tpu.stream.sketch import DEFAULT_EXPORT_CAPACITY, StreamSketch
+from xgboost_ray_tpu.stream.upload import DoubleBufferedUploader
+
+
+class PassOneResult:
+    """Sketches + small columns of one streamed load's first pass."""
+
+    def __init__(self):
+        self.sketches: List[StreamSketch] = []
+        self.shard_rows: List[int] = []
+        self.label: Optional[np.ndarray] = None
+        self.weight: Optional[np.ndarray] = None
+        self.base_margin: Optional[np.ndarray] = None
+        self.qid: Optional[np.ndarray] = None
+        self.lower: Optional[np.ndarray] = None
+        self.upper: Optional[np.ndarray] = None
+        self.n_rows = 0
+        self.n_features = 0
+        self.sketch_s = 0.0
+        self.wall_s = 0.0
+        self.chunks = 0
+
+
+def _concat_optional(parts: List[List[Optional[np.ndarray]]],
+                     shard_rows: List[int],
+                     fill: Optional[float]) -> Optional[np.ndarray]:
+    """Concatenate a per-shard list of per-chunk optional columns with
+    ``_concat_shards`` semantics: absent everywhere -> None; absent on some
+    shards -> synthesized fill for those shards (None fill: zeros)."""
+    present = [any(p is not None for p in shard) for shard in parts]
+    if not any(present):
+        return None
+    out = []
+    for shard, rows, has in zip(parts, shard_rows, present):
+        if has:
+            if any(p is None for p in shard):
+                raise ValueError(
+                    "a streamed column is present in some chunks of a shard "
+                    "but not others"
+                )
+            out.append(np.concatenate([np.asarray(p, np.float32).ravel()
+                                       for p in shard]))
+        else:
+            val = 0.0 if fill is None else fill
+            out.append(np.full(rows, val, np.float32))
+    return np.concatenate(out) if len(out) > 1 else out[0]
+
+
+
+
+def apriori_sketch_bytes(
+    streams: Sequence[ShardStream], n_features: int, cap: int
+) -> int:
+    """Summed a-priori sketch estimate across shards, per stream at the
+    level count it will actually reach (levels ~ log2(rows/capacity),
+    ceiling MAX_LEVELS) — a fixed small multiplier would let long streams
+    outgrow the budget mid-pass with the fail-fast already passed. Summed
+    because the driver holds EVERY shard's sketch concurrently through
+    pass 1. Closed form: never allocates sketch-sized arrays itself."""
+    from xgboost_ray_tpu.stream.sketch import MAX_LEVELS
+
+    base_bytes = StreamSketch.level_nbytes(n_features, cap)
+    return sum(
+        base_bytes * min(
+            MAX_LEVELS,
+            max(1, (max(s.n_rows, 1) // max(cap, 1)).bit_length()) + 1,
+        )
+        for s in streams
+    )
+
+
+def export_summary_ceiling(n_features: int) -> int:
+    """Ceiling on the per-device export-summary item count the cuts merge
+    will use (the F-scaled cap in :func:`merged_cuts`) — shared with the
+    budget model so the merge's stacked summaries are a charged term."""
+    return (
+        DEFAULT_EXPORT_CAPACITY if n_features <= 128
+        else 2048 if n_features <= 1024 else 512
+    )
+
+
+def prevalidate_budget(
+    streams: Sequence[ShardStream],
+    block_rows: int,
+    bin_itemsize: int,
+    n_devices: int,
+) -> None:
+    """The FULL streaming-budget fail-fast, callable BEFORE any byte
+    streams: every input — each shard's declared rows, the mesh block
+    size, the bin dtype, the merge's summary ceiling — is known up front,
+    so the N-scaling block-buffer and cuts-merge terms must not wait for
+    the end of pass 1 (hours of I/O on a beyond-RAM load) to reject the
+    config."""
+    if not streams:
+        return
+    n_features = streams[0].n_features
+    est = apriori_sketch_bytes(
+        streams, n_features, streams[0].sketch_capacity
+    )
+    # stacked [n_devices, F, export_cap] f32 vals + wts summaries the cuts
+    # merge holds on host before device_put
+    merge_bytes = (
+        n_devices * n_features * export_summary_ceiling(n_features) * 4 * 2
+    )
+    for s in streams:
+        s.config.validate_budget(
+            s.n_rows, s.n_features, s.chunk_rows, est,
+            block_rows=block_rows, bin_itemsize=bin_itemsize,
+            merge_bytes=merge_bytes,
+        )
+
+
+def sketch_pass(
+    streams: Sequence[ShardStream],
+    max_bin: int,
+    cat_features: Sequence[int] = (),
+) -> PassOneResult:
+    """Pass 1: stream every shard once, building per-shard sketches and the
+    small per-row columns."""
+    tracer = obs.get_tracer()
+    res = PassOneResult()
+    res.n_features = streams[0].n_features
+    # before any chunk validation indexes columns — the engine's shared
+    # loud error, not a fork of it
+    binning.validate_feature_types_count(cat_features, res.n_features)
+    cap = streams[0].sketch_capacity
+    for s in streams:
+        if s.n_features != res.n_features:
+            raise ValueError(
+                f"streamed shards disagree on feature count "
+                f"({s.n_features} vs {res.n_features})"
+            )
+        if s.sketch_capacity != cap:
+            raise ValueError("streamed shards disagree on sketch capacity")
+    wall0 = time.perf_counter()
+    # "qid" is deliberately absent: the per-chunk gate below rejects it on
+    # first sight, so collecting it would be dead plumbing
+    cols: Dict[str, List[List[Optional[np.ndarray]]]] = {
+        k: [] for k in ("label", "weight", "base_margin",
+                        "label_lower_bound", "label_upper_bound")
+    }
+    est_sketch_total = apriori_sketch_bytes(streams, res.n_features, cap)
+    for s in streams:
+        s.config.validate_budget(
+            s.n_rows, s.n_features, s.chunk_rows, est_sketch_total
+        )
+    for s in streams:
+        sketch = StreamSketch(res.n_features, capacity=cap)
+        shard_cols = {k: [] for k in cols}
+        rows = 0
+        for chunk in s.chunks():
+            if chunk.get("qid") is not None:
+                # gate on the FIRST qid-carrying chunk — a beyond-RAM load
+                # must not stream to completion before learning its query
+                # groups cannot be honored
+                raise NotImplementedError(
+                    "streamed ingestion does not support qid/ranking data "
+                    "yet (query groups need a global contiguity sort the "
+                    "chunk pipeline cannot do); materialize the matrix for "
+                    "ranking."
+                )
+            x = np.asarray(chunk["data"], np.float32)
+            binning.validate_categorical_codes(x, cat_features, max_bin)
+            t0 = time.perf_counter()
+            with tracer.span(
+                "data.sketch_chunk", rows=int(x.shape[0]),
+                shard=len(res.sketches),
+            ):
+                sketch.update(x, weight=chunk.get("weight"))
+            res.sketch_s += time.perf_counter() - t0
+            for k in shard_cols:
+                shard_cols[k].append(chunk.get(k))
+            rows += x.shape[0]
+            res.chunks += 1
+        if rows != s.n_rows:
+            raise ValueError(
+                f"stream produced {rows} rows but declared {s.n_rows}"
+            )
+        res.sketches.append(sketch)
+        res.shard_rows.append(rows)
+        for k in cols:
+            cols[k].append(shard_cols[k])
+    res.n_rows = sum(res.shard_rows)
+    fills = SHARD_COLUMN_FILLS  # _concat_shards parity, one table
+    res.label = _concat_optional(
+        cols["label"], res.shard_rows, fill=fills["label"]
+    )
+    res.weight = _concat_optional(
+        cols["weight"], res.shard_rows, fill=fills["weight"]
+    )
+    res.base_margin = _concat_optional(
+        cols["base_margin"], res.shard_rows, fill=fills["base_margin"]
+    )
+    res.lower = _concat_optional(
+        cols["label_lower_bound"], res.shard_rows,
+        fill=fills["label_lower_bound"],
+    )
+    res.upper = _concat_optional(
+        cols["label_upper_bound"], res.shard_rows,
+        fill=fills["label_upper_bound"],
+    )
+    res.wall_s = time.perf_counter() - wall0
+    return res
+
+
+# ---------------------------------------------------------------------------
+# cuts merge (device, same collective shape as the materialized sketch)
+# ---------------------------------------------------------------------------
+
+
+def merged_cuts(
+    engine,
+    pass1: PassOneResult,
+) -> Tuple[jnp.ndarray, jnp.ndarray, np.ndarray, np.ndarray]:
+    """Merge per-shard sketches into global cuts on the mesh.
+
+    Shard sketches fold deterministically (rank order, round-robin over the
+    ``n_devices`` mesh slots), export to fixed-shape summaries, and merge on
+    device through pmin/pmax + histogram/missing psums — the materialized
+    sketch program's exact collective schedule. Returns (cuts_dev [F, B-1],
+    has_missing_dev [F] bool, cuts_np, rank_error_bound [F]).
+    """
+    tracer = obs.get_tracer()
+    max_bin = engine.params.max_bin
+    cat_features = engine._cat_features
+    n_dev = engine.n_devices
+    num_features = pass1.n_features
+    with tracer.span("data.cuts_merge", world=n_dev) as span_attrs:
+        groups: List[Optional[StreamSketch]] = [None] * n_dev
+        for i, sk in enumerate(pass1.sketches):
+            d = i % n_dev
+            groups[d] = sk if groups[d] is None else groups[d].merge(sk)
+        # export shape: tight power-of-two over the fullest group's live
+        # items, capped by an F-scaled ceiling — the stacked [D, F, export]
+        # summaries are the merge program's memory, so shipping mostly-inert
+        # padding (or summaries far finer than the SKETCH_BINS grid they
+        # rasterize onto) costs real RSS at wide F for no cut accuracy
+        items_max = max(
+            (g.item_count() for g in groups if g is not None), default=1
+        )
+        export_cap = min(
+            export_summary_ceiling(num_features),
+            max(256, 1 << (items_max - 1).bit_length()),
+        )
+        mns, mxs, valss, wtss, missws = [], [], [], [], []
+        err = np.zeros(num_features, np.float64)
+        for g in groups:
+            if g is None:
+                # inert empty summary, bitwise what an empty sketch exports
+                # — without allocating its full [F, cap] level buffers
+                mns.append(np.full(num_features, np.inf, np.float32))
+                mxs.append(np.full(num_features, -np.inf, np.float32))
+                valss.append(
+                    np.full((num_features, export_cap), np.inf, np.float32)
+                )
+                wtss.append(
+                    np.zeros((num_features, export_cap), np.float32)
+                )
+                missws.append(np.zeros(num_features, np.float32))
+                continue
+            vals, wts, g_err = g.export(export_cap)
+            err += g_err
+            mns.append(g.min)
+            mxs.append(g.max)
+            valss.append(vals)
+            wtss.append(wts)
+            missws.append(g.missing_weight.astype(np.float32))
+        rows = NamedSharding(engine.mesh, P(AXIS_ACTORS))
+        mn_dev = jax.device_put(np.stack(mns), rows)
+        mx_dev = jax.device_put(np.stack(mxs), rows)
+        vals_dev = jax.device_put(np.stack(valss), rows)
+        wts_dev = jax.device_put(np.stack(wtss), rows)
+        miss_dev = jax.device_put(np.stack(missws), rows)
+
+        def fn(mn, mx, vals, wts, missw):
+            mn = jax.lax.pmin(mn[0], AXIS_ACTORS)
+            mx = jax.lax.pmax(mx[0], AXIS_ACTORS)
+            hist = binning.sketch_histogram_items(vals[0], wts[0], mn, mx)
+            hist = jax.lax.psum(hist, AXIS_ACTORS)
+            cuts = binning.cuts_from_sketch(mn, mx, hist, max_bin)
+            if cat_features:
+                from xgboost_ray_tpu.ops.grow import cat_mask_const
+
+                cat_mask = cat_mask_const(cat_features, num_features)
+                code_cuts = jnp.arange(max_bin - 1, dtype=cuts.dtype) + 0.5
+                cuts = jnp.where(cat_mask[:, None], code_cuts[None, :], cuts)
+            miss = jax.lax.psum(missw[0], AXIS_ACTORS)
+            return cuts, miss > 0
+
+        mapped = shard_map(
+            fn,
+            mesh=engine.mesh,
+            in_specs=(
+                P(AXIS_ACTORS), P(AXIS_ACTORS), P(AXIS_ACTORS),
+                P(AXIS_ACTORS), P(AXIS_ACTORS),
+            ),
+            out_specs=(P(), P()),
+        )
+        jit_fn = progreg.register_jit(
+            "engine.sketch_cuts",
+            mapped,
+            example_args=(mn_dev, mx_dev, vals_dev, wts_dev, miss_dev),
+            meta=engine._program_meta(),
+        )
+        cuts_dev, has_missing = jit_fn(
+            mn_dev, mx_dev, vals_dev, wts_dev, miss_dev
+        )
+        # the pipeline's ONE documented device->host read: pass 2 bins on
+        # the host against these cuts
+        cuts_np = np.asarray(cuts_dev)
+        span_attrs["rank_error_bound_max"] = float(err.max(initial=0.0))
+    return cuts_dev, has_missing, cuts_np, err
+
+
+# ---------------------------------------------------------------------------
+# pass 2: bin on host, double-buffered upload, on-device assembly
+# ---------------------------------------------------------------------------
+
+
+def _mesh_block_devices(engine) -> List[Tuple[Any, List[Any]]]:
+    """Per row-block (primary device, replica devices): 1D meshes have no
+    replicas; a 2D row x feature mesh replicates each row block over the
+    feature axis."""
+    dev = np.asarray(engine.mesh.devices)
+    if dev.ndim == 1:
+        return [(d, []) for d in dev.tolist()]
+    return [(row[0], list(row[1:])) for row in dev.tolist()]
+
+
+def bin_upload_pass(
+    engine,
+    streams: Sequence[ShardStream],
+    cuts_np: np.ndarray,
+    sketch_bytes: int = 0,
+) -> Tuple[jnp.ndarray, Dict[str, float]]:
+    """Pass 2: re-stream chunks, bin each on the host straight into the
+    current device block's ``bin_dtype`` buffer, upload completed blocks
+    double-buffered, assemble the [pad_to, F] row-sharded device matrix.
+
+    Rows arrive in global row order, so exactly ONE per-actor block buffer
+    is being filled at any time; a completed block hands off to the
+    background uploader (one H2D transfer per device block — the device
+    holds exactly the final binned bytes, no concat/update churn) while the
+    next block's chunks bin on the main thread. Peak host memory:
+    O(chunk + prefetch·block_bytes), with block_bytes = per-actor rows x F
+    in bin_dtype (uint8/int16) — the "rows are born binned" buffer.
+
+    Returns (bins_global, stats). Tail padding rows bin to the missing
+    bucket — exactly where the materialized path's NaN-padded rows land, so
+    a streamed matrix is indistinguishable downstream.
+    """
+    tracer = obs.get_tracer()
+    max_bin = engine.params.max_bin
+    dtype = binning.bin_dtype(max_bin)
+    num_features = cuts_np.shape[0]
+    pad_to = engine.pad_to
+    block = pad_to // engine.n_devices
+    block_devices = _mesh_block_devices(engine)
+    prefetch = streams[0].config.prefetch
+    # the full budget check: now that the mesh layout is known, the
+    # N-scaling term (per-actor block buffers alive at once) is included
+    streams[0].config.validate_budget(
+        sum(s.n_rows for s in streams), num_features,
+        max(s.chunk_rows for s in streams), sketch_bytes,
+        block_rows=block, bin_itemsize=np.dtype(dtype).itemsize,
+    )
+    uploader = DoubleBufferedUploader(depth=prefetch, tracer=tracer)
+    wall0 = time.perf_counter()
+    bin_s = 0.0
+    cursor = 0
+    buf: Optional[np.ndarray] = None  # the block being filled
+
+    def submit_rows(rows: np.ndarray) -> None:
+        nonlocal cursor, buf
+        pos = 0
+        while pos < rows.shape[0]:
+            b = cursor // block
+            off = cursor - b * block
+            if buf is None:
+                buf = np.full((block, num_features), max_bin, dtype)
+            take = min(block - off, rows.shape[0] - pos)
+            buf[off : off + take] = rows[pos : pos + take]
+            pos += take
+            cursor += take
+            if off + take == block:
+                primary, replicas = block_devices[b]
+                uploader.submit((b, 0), buf, primary)
+                for ci, rdev in enumerate(replicas):
+                    uploader.submit((b, ci + 1), buf, rdev)
+                buf = None
+
+    try:
+        for si, s in enumerate(streams):
+            for chunk in s.chunks():
+                x = np.asarray(chunk["data"], np.float32)
+                t0 = time.perf_counter()
+                with tracer.span(
+                    "data.bin_chunk", rows=int(x.shape[0]), shard=si
+                ):
+                    bins_chunk = binning.bin_matrix_np(x, cuts_np, max_bin)
+                bin_s += time.perf_counter() - t0
+                submit_rows(bins_chunk)
+        if cursor < pad_to:
+            # padding tail: the partially-filled block buffer already holds
+            # the missing bucket in its unwritten rows; flush block by block
+            while cursor < pad_to:
+                b = cursor // block
+                take = block * (b + 1) - cursor
+                if buf is None:
+                    buf = np.full((block, num_features), max_bin, dtype)
+                cursor += take
+                primary, replicas = block_devices[b]
+                uploader.submit((b, 0), buf, primary)
+                for ci, rdev in enumerate(replicas):
+                    uploader.submit((b, ci + 1), buf, rdev)
+                buf = None
+        results = uploader.drain()
+    finally:
+        uploader.close()
+
+    sharding = engine._row_sharding
+    shape = (pad_to, num_features)
+    per_device = {}
+    for b, (primary, replicas) in enumerate(block_devices):
+        for ci, dev in enumerate([primary] + replicas):
+            per_device[dev] = results[(b, ci)]
+    arrays = [
+        per_device[d]
+        for d, _idx in sharding.addressable_devices_indices_map(shape).items()
+    ]
+    bins_global = jax.make_array_from_single_device_arrays(
+        shape, sharding, arrays
+    )
+    stats = dict(uploader.stats())
+    stats.update({
+        "bin_s": bin_s,
+        "pass2_wall_s": time.perf_counter() - wall0,
+    })
+    return bins_global, stats

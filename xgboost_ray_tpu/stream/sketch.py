@@ -1,0 +1,327 @@
+"""Mergeable deterministic weight-aware quantile sketch (KLL-style).
+
+The streaming counterpart of the in-memory histogram-CDF sketch in
+``ops/binning.py``: where that sketch needs the full shard on device (global
+min/max before the fine histogram), this one ingests a row stream chunk by
+chunk on the host in O(capacity · levels) memory per feature and merges
+associatively — across chunks (trivially: the state is a function of the row
+prefix only, so ANY chunking of the same rows yields the bitwise-same
+summary) and across actors (explicit :meth:`StreamSketch.merge`, driver
+merges in rank order).
+
+Structure (vectorized over features; all buffers are ``[F, capacity]``):
+
+* level buffers of (value, weight) items; rows insert at level 0;
+* a full level is *compacted*: items sorted by value (stable), then
+  ``capacity/2`` equi-weight representatives are selected at deterministic
+  targets ``(j + offset) * T / S`` (offset alternates 0.25/0.75 per
+  compaction so consecutive rank perturbations cancel in practice), each
+  carrying weight ``T / S``; survivors push into the next level;
+* missing values (NaN) enter as ``(+inf, 0)`` placeholders so every row
+  advances the shared fill counter (keeping the state fully vectorized);
+  their real weight is tracked per feature in ``missing_weight``.
+
+Rank-error certificate
+----------------------
+One compaction replaces the buffer's cumulative-weight function by a step
+function with steps of ``T/S``, perturbing any rank query by at most
+``T/S``. Every performed compaction adds its ``T/S`` to ``_err`` — so at
+readout, for every value v, ``|C_sketch(v) - C_true(v)| <= _err[f]``, and a
+quantile read off the summary is within ``rank_error_bound()`` (the
+certificate plus one item weight of readout resolution) of the true rank.
+The bound is computed from the compactions that actually happened, not a
+worst-case formula, and is pinned against exact quantiles by
+``tests/test_streaming.py``.
+"""
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+#: default per-level buffer capacity (items per feature per level); the
+#: certificate scales as O(levels · N / capacity) worst case, far better in
+#: practice thanks to the alternating compaction offsets
+DEFAULT_CAPACITY = 2048
+
+#: level count ceiling: compacting the top level re-inserts survivors into
+#: itself, bounding memory at O(MAX_LEVELS · capacity · F) while the error
+#: certificate keeps accounting for every extra compaction honestly
+MAX_LEVELS = 12
+
+#: exported summary size (items per feature) for the fixed-shape device
+#: merge; a fuller sketch equi-weight-compacts down to this on export
+DEFAULT_EXPORT_CAPACITY = 4096
+
+
+class _Level:
+    """One level's (value, weight) buffer, [F, capacity]."""
+
+    __slots__ = ("vals", "wts", "n", "compactions")
+
+    def __init__(self, n_features: int, capacity: int):
+        self.vals = np.full((n_features, capacity), np.inf, np.float32)
+        self.wts = np.zeros((n_features, capacity), np.float64)
+        self.n = 0  # filled item count (shared across features)
+        self.compactions = 0  # drives the alternating selection offset
+
+
+def _flat_searchsorted(z: np.ndarray, targets: np.ndarray) -> np.ndarray:
+    """Per-feature searchsorted in ONE flat call.
+
+    ``z`` [F, m] is per-feature non-decreasing with values in [0, 1];
+    ``targets`` [F, k] likewise in (0, 1). Keys offset each feature by 2·f
+    (z stays within [0, 1] ⊂ [0, 2), so feature blocks never interleave).
+    Returns per-feature left-insertion indices [F, k] in [0, m].
+    """
+    num_features, m = z.shape
+    base = (np.arange(num_features, dtype=np.float64) * 2.0)[:, None]
+    idx = np.searchsorted(
+        (base + z).ravel(), (base + targets).ravel(), side="left"
+    ).reshape(targets.shape)
+    return idx - np.arange(num_features, dtype=np.int64)[:, None] * m
+
+
+class StreamSketch:
+    """Deterministic mergeable per-feature quantile sketch."""
+
+    def __init__(
+        self,
+        n_features: int,
+        capacity: Optional[int] = None,
+        export_capacity: Optional[int] = None,
+    ):
+        if n_features < 1:
+            raise ValueError("n_features must be >= 1")
+        cap = int(capacity or DEFAULT_CAPACITY)
+        if cap < 8 or cap % 2:
+            raise ValueError(f"capacity must be even and >= 8; got {cap}")
+        self.n_features = int(n_features)
+        self.capacity = cap
+        self.export_capacity = int(export_capacity or DEFAULT_EXPORT_CAPACITY)
+        self.levels: List[_Level] = [_Level(self.n_features, cap)]
+        self.min = np.full(n_features, np.inf, np.float32)
+        self.max = np.full(n_features, -np.inf, np.float32)
+        self.total_weight = np.zeros(n_features, np.float64)  # finite rows
+        self.missing_weight = np.zeros(n_features, np.float64)
+        self.n_rows = 0
+        self._err = np.zeros(n_features, np.float64)
+
+    # -- ingestion -----------------------------------------------------------
+
+    def update(self, x: np.ndarray, weight: Optional[np.ndarray] = None) -> None:
+        """Insert one chunk of rows. ``x`` [n, F] float; ``weight`` [n] or
+        None (unit weights). Rows insert in order — chunk boundaries leave
+        no trace in the state, which is what makes any chunking of the same
+        row stream produce the bitwise-identical sketch."""
+        x = np.asarray(x, np.float32)
+        if x.ndim != 2 or x.shape[1] != self.n_features:
+            raise ValueError(
+                f"expected [n, {self.n_features}] chunk, got {x.shape}"
+            )
+        n = x.shape[0]
+        if n == 0:
+            return
+        if weight is None:
+            w = np.ones(n, np.float64)
+        else:
+            w = np.asarray(weight, np.float64).ravel()
+            if w.shape[0] != n:
+                raise ValueError("weight length does not match chunk rows")
+            if (w < 0).any():
+                raise ValueError("sketch weights must be non-negative")
+        nan = np.isnan(x)
+        finite_w = np.where(nan, 0.0, w[:, None])  # [n, F]
+        self.total_weight += finite_w.sum(axis=0)
+        self.missing_weight += (np.where(nan, w[:, None], 0.0)).sum(axis=0)
+        with np.errstate(invalid="ignore"):
+            self.min = np.fmin(self.min, np.min(np.where(nan, np.inf, x), axis=0))
+            self.max = np.fmax(self.max, np.max(np.where(nan, -np.inf, x), axis=0))
+        self.n_rows += n
+
+        vals = np.where(nan, np.float32(np.inf), x)  # [n, F]
+        lvl0 = self.levels[0]
+        pos = 0
+        while pos < n:
+            take = min(self.capacity - lvl0.n, n - pos)
+            sl = slice(pos, pos + take)
+            lvl0.vals[:, lvl0.n : lvl0.n + take] = vals[sl].T
+            lvl0.wts[:, lvl0.n : lvl0.n + take] = finite_w[sl].T
+            lvl0.n += take
+            pos += take
+            if lvl0.n == self.capacity:
+                self._compact(0)
+
+    # -- compaction ----------------------------------------------------------
+
+    def _select_equiweight(
+        self,
+        vals: np.ndarray,
+        wts: np.ndarray,
+        n_out: int,
+        offset: float,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Equi-weight representatives of sorted-by-value item buffers.
+
+        Returns (values [F, n_out] f32, weights [F, n_out] f64, per-feature
+        rank-error contribution [F] f64). Features with zero total weight
+        (everything missing so far) yield (+inf, 0) placeholders.
+        """
+        order = np.argsort(vals, axis=1, kind="stable")
+        sv = np.take_along_axis(vals, order, axis=1)
+        sw = np.take_along_axis(wts, order, axis=1)
+        cw = np.cumsum(sw, axis=1)
+        total = cw[:, -1]
+        has_mass = total > 0
+        safe_total = np.where(has_mass, total, 1.0)
+        z = cw / safe_total[:, None]
+        targets = (np.arange(n_out, dtype=np.float64) + offset)[None, :] / n_out
+        idx = np.clip(_flat_searchsorted(z, np.broadcast_to(
+            targets, (vals.shape[0], n_out)
+        )), 0, vals.shape[1] - 1)
+        out_vals = np.take_along_axis(sv, idx, axis=1)
+        out_w = np.broadcast_to((total / n_out)[:, None], out_vals.shape)
+        out_vals = np.where(has_mass[:, None], out_vals, np.float32(np.inf))
+        out_w = np.where(has_mass[:, None], out_w, 0.0)
+        err = np.where(has_mass, total / n_out, 0.0)
+        return out_vals.astype(np.float32), out_w, err
+
+    def _compact(self, level: int) -> None:
+        lvl = self.levels[level]
+        half = self.capacity // 2
+        offset = 0.25 if lvl.compactions % 2 == 0 else 0.75
+        lvl.compactions += 1
+        out_vals, out_w, err = self._select_equiweight(
+            lvl.vals[:, : lvl.n], lvl.wts[:, : lvl.n], half, offset
+        )
+        self._err += err
+        lvl.vals[:] = np.inf
+        lvl.wts[:] = 0.0
+        lvl.n = 0
+        # promote survivors; the top level compacts into itself (bounded
+        # memory, honestly accounted error)
+        dest_idx = level + 1
+        if dest_idx >= MAX_LEVELS:
+            dest_idx = level
+        if dest_idx == len(self.levels):
+            self.levels.append(_Level(self.n_features, self.capacity))
+        self._insert_items(dest_idx, out_vals, out_w)
+
+    def _insert_items(self, level: int, vals: np.ndarray, wts: np.ndarray) -> None:
+        """Append pre-weighted items into ``level`` (in column order),
+        compacting on fill."""
+        lvl = self.levels[level]
+        m = vals.shape[1]
+        pos = 0
+        while pos < m:
+            take = min(self.capacity - lvl.n, m - pos)
+            lvl.vals[:, lvl.n : lvl.n + take] = vals[:, pos : pos + take]
+            lvl.wts[:, lvl.n : lvl.n + take] = wts[:, pos : pos + take]
+            lvl.n += take
+            pos += take
+            if lvl.n == self.capacity:
+                self._compact(level)
+
+    # -- merge ---------------------------------------------------------------
+
+    def merge(self, other: "StreamSketch") -> "StreamSketch":
+        """Fold ``other`` into this sketch (in place; returns self).
+
+        Level-aligned item insertion: deterministic given the two operands'
+        states, so a fixed merge order (the driver merges in rank order)
+        yields a fully deterministic result. Error certificates add."""
+        if other.n_features != self.n_features:
+            raise ValueError("cannot merge sketches over different feature counts")
+        if other.capacity != self.capacity:
+            raise ValueError("cannot merge sketches with different capacities")
+        self.min = np.fmin(self.min, other.min)
+        self.max = np.fmax(self.max, other.max)
+        self.total_weight += other.total_weight
+        self.missing_weight += other.missing_weight
+        self.n_rows += other.n_rows
+        self._err += other._err
+        for li, lvl in enumerate(other.levels):
+            if lvl.n:
+                dest = min(li, MAX_LEVELS - 1)
+                while dest >= len(self.levels):
+                    self.levels.append(_Level(self.n_features, self.capacity))
+                self._insert_items(dest, lvl.vals[:, : lvl.n], lvl.wts[:, : lvl.n])
+        return self
+
+    # -- readout -------------------------------------------------------------
+
+    def item_count(self) -> int:
+        """Live summary items per feature (drives the export shape)."""
+        return max(1, sum(lvl.n for lvl in self.levels))
+
+    def _all_items(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Every live item, level order: ([F, m] values, [F, m] weights)."""
+        parts_v = [lvl.vals[:, : lvl.n] for lvl in self.levels if lvl.n]
+        parts_w = [lvl.wts[:, : lvl.n] for lvl in self.levels if lvl.n]
+        if not parts_v:
+            return (
+                np.full((self.n_features, 1), np.inf, np.float32),
+                np.zeros((self.n_features, 1), np.float64),
+            )
+        return np.concatenate(parts_v, axis=1), np.concatenate(parts_w, axis=1)
+
+    def export(
+        self, capacity: Optional[int] = None
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Fixed-shape summary for the device merge: (values [F, cap] f32,
+        weights [F, cap] f32, rank-error bound [F] f64 including any export
+        compaction). Unused slots hold (+inf, 0) — weightless, so they are
+        inert under the rasterizing scatter-add."""
+        cap = int(capacity or self.export_capacity)
+        vals, wts = self._all_items()
+        err = self._err.copy()
+        if vals.shape[1] > cap:
+            vals, wts, extra = self._select_equiweight(vals, wts, cap, 0.5)
+            err += extra
+        pad = cap - vals.shape[1]
+        if pad:
+            vals = np.concatenate(
+                [vals, np.full((self.n_features, pad), np.inf, np.float32)], axis=1
+            )
+            wts = np.concatenate(
+                [wts, np.zeros((self.n_features, pad), np.float64)], axis=1
+            )
+        return vals.astype(np.float32), wts.astype(np.float32), err
+
+    def quantiles(self, qs: np.ndarray) -> np.ndarray:
+        """Estimated per-feature quantile values [F, len(qs)] over the
+        non-missing mass (host readout; the training cuts instead go through
+        the device rasterized merge for schedule parity)."""
+        qs = np.asarray(qs, np.float64).ravel()
+        vals, wts = self._all_items()
+        order = np.argsort(vals, axis=1, kind="stable")
+        sv = np.take_along_axis(vals, order, axis=1)
+        sw = np.take_along_axis(wts, order, axis=1)
+        cw = np.cumsum(sw, axis=1)
+        total = cw[:, -1]
+        has_mass = total > 0
+        z = cw / np.where(has_mass, total, 1.0)[:, None]
+        idx = np.clip(
+            _flat_searchsorted(z, np.broadcast_to(qs[None, :], (self.n_features, qs.size))),
+            0, sv.shape[1] - 1,
+        )
+        out = np.take_along_axis(sv, idx, axis=1)
+        return np.where(has_mass[:, None], out, np.float32(0.0)).astype(np.float32)
+
+    def rank_error_bound(self) -> np.ndarray:
+        """Per-feature certified rank-error bound (absolute weight units) of
+        a quantile read off this sketch: the accumulated compaction
+        certificate plus one item weight of readout resolution."""
+        _, wts = self._all_items()
+        return self._err + wts.max(axis=1)
+
+    def memory_bytes(self) -> int:
+        """Current buffer footprint (the ``sketch`` term of the streaming
+        memory model)."""
+        return sum(lvl.vals.nbytes + lvl.wts.nbytes for lvl in self.levels)
+
+    @staticmethod
+    def level_nbytes(n_features: int, capacity: int) -> int:
+        """Bytes of ONE level's buffers (f32 values + f64 weights) — the
+        closed form of a fresh sketch's :meth:`memory_bytes`, for budget
+        estimates that must not themselves allocate sketch-sized arrays."""
+        return n_features * capacity * (4 + 8)
