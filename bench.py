@@ -22,6 +22,7 @@ the parent retries with a smaller fused-scan chunk, then falls back to the
 virtual CPU mesh with an unmistakably-labeled extrapolated metric.
 """
 
+import contextlib
 import glob
 import json
 import os
@@ -313,11 +314,15 @@ def elastic_recovery_tripwire(current_chaos, prev_rec, prev_name=None,
     The elastic-continuation analog of ``chaos_recovery_tripwire``: the
     tracked figure is ``continue_vs_restart.ratio`` (elastic in-flight
     recovery time over restart-from-checkpoint recovery time — smaller is
-    better, < 1 means continuation keeps its edge). Returns
-    ``{prev_ratio, prev_record, ratio, fired}`` or None when no comparable
-    record exists (different backend, no recorded pairing). Like-for-like
-    only: a different chaos config is reported with ``config_mismatch`` set
-    and never fires."""
+    better, < 1 means continuation keeps its edge), compared for the base
+    pairing AND the per-config pairings (``elastic_2d`` /
+    ``elastic_streamed`` — the 2D-mesh and streamed arms that used to be
+    fallback cases). Returns ``{prev_ratio, prev_record, ratio, fired[,
+    arms]}`` or None when no comparable record exists (different backend,
+    no recorded base pairing); ``fired`` is True when ANY arm regresses
+    past the threshold. Like-for-like only: a different chaos config is
+    reported with ``config_mismatch`` set and never fires (per arm for the
+    per-config pairings)."""
     if not isinstance(current_chaos, dict):
         return None
     cur = (current_chaos.get("continue_vs_restart") or {}).get("ratio")
@@ -338,20 +343,51 @@ def elastic_recovery_tripwire(current_chaos, prev_rec, prev_name=None,
         "ratio": round(ratio, 3),
         "fired": False,
     }
-    if prev_chaos.get("config") != current_chaos.get("config"):
+    base_config_matches = (
+        prev_chaos.get("config") == current_chaos.get("config")
+    )
+    if not base_config_matches:
+        # the base pairing is reported-but-never-fired on a config change;
+        # the per-config arms below still compare (each against its OWN
+        # config), so a soak-config change cannot mask an arm regression
         out["config_mismatch"] = True
-        return out
-    if ratio > threshold:
+
+    def _fire(label, c, p, r):
         out["fired"] = True
         print(
-            f"[bench] ELASTIC TRIPWIRE: continue-vs-restart recovery ratio "
-            f"{cur:.3f} is {ratio:.2f}x the newest recorded run "
-            f"({prev:.3f} in {prev_name or 'BENCH_*.json'}) — "
+            f"[bench] ELASTIC TRIPWIRE [{label}]: continue-vs-restart "
+            f"recovery ratio {c:.3f} is {r:.2f}x the newest recorded run "
+            f"({p:.3f} in {prev_name or 'BENCH_*.json'}) — "
             f">{(threshold - 1) * 100:.0f}% regression of the zero-replay "
             f"continuation's advantage. Investigate the in-flight recovery "
             f"path before trusting this build's elastic training.",
             file=sys.stderr,
         )
+
+    if base_config_matches and ratio > threshold:
+        _fire("base", float(cur), float(prev), ratio)
+    arms = {}
+    for key in ("elastic_2d", "elastic_streamed"):
+        cur_arm = current_chaos.get(key) or {}
+        prev_arm = prev_chaos.get(key) or {}
+        c = (cur_arm.get("continue_vs_restart") or {}).get("ratio")
+        p = (prev_arm.get("continue_vs_restart") or {}).get("ratio")
+        if not c or not p:
+            continue  # arm absent on one side (older record) — not comparable
+        a_ratio = float(c) / float(p)
+        arm_out = {
+            "prev_ratio": round(float(p), 4),
+            "ratio": round(a_ratio, 3),
+            "fired": False,
+        }
+        if prev_arm.get("config") != cur_arm.get("config"):
+            arm_out["config_mismatch"] = True
+        elif a_ratio > threshold:
+            arm_out["fired"] = True
+            _fire(key, float(c), float(p), a_ratio)
+        arms[key] = arm_out
+    if arms:
+        out["arms"] = arms
     return out
 
 
@@ -1406,6 +1442,51 @@ def _timeline_fault_events(timeline):
     return out
 
 
+@contextlib.contextmanager
+def _immediate_reintegration_env():
+    """Zero the elastic scheduler's resource-check/grace knobs for the
+    scope (the immediate-reintegration posture every continue arm runs
+    under), restoring the ambient values after — shared by the base
+    restart-vs-continue pairing and the per-config arms so the two cannot
+    drift on which knobs define 'continue'."""
+    saved = {}
+    for k in ("RXGB_ELASTIC_RESTART_RESOURCE_CHECK_S",
+              "RXGB_ELASTIC_RESTART_GRACE_PERIOD_S"):
+        saved[k] = os.environ.get(k)
+        os.environ[k] = "0"
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _continue_vs_restart_block(restart_ttr, cont_ttr, label):
+    """The tripwire-tracked pairing dict (or None when either recovery is
+    unmeasured), with the shared not-faster warning — ONE definition of
+    the ratio semantics for the base pairing and every per-config arm."""
+    if not restart_ttr or not cont_ttr:
+        return None
+    ratio = round(cont_ttr / restart_ttr, 4)
+    if ratio >= 1.0:
+        print(
+            f"[bench] WARNING: {label} elastic continuation recovered in "
+            f"{cont_ttr:.2f}s, NOT faster than the restart-from-checkpoint "
+            f"policy ({restart_ttr:.2f}s) — the zero-replay path has lost "
+            f"its edge.",
+            file=sys.stderr,
+        )
+    return {
+        "restart_time_to_recover_s": restart_ttr,
+        "continue_time_to_recover_s": cont_ttr,
+        "ratio": ratio,
+        "continue_faster": ratio < 1.0,
+    }
+
+
 def run_chaos_measurement():
     """Deterministic chaos soak on the ambient mesh: one training run with a
     mid-run rank kill plus a straggler delay (driven by a ``FaultPlan``, no
@@ -1568,13 +1649,8 @@ def run_chaos_measurement():
             {"site": "actor.train_round", "action": "delay",
              "match": {"round": straggle_round}, "delay_s": straggle_s},
         ])
-        saved_env = {}
-        for k in ("RXGB_ELASTIC_RESTART_RESOURCE_CHECK_S",
-                  "RXGB_ELASTIC_RESTART_GRACE_PERIOD_S"):
-            saved_env[k] = os.environ.get(k)
-            os.environ[k] = "0"
         res_cont = {}
-        try:
+        with _immediate_reintegration_env():
             with faults.active_plan(cont_plan):
                 bst_cont = train(
                     params, RayDMatrix(x, y), rounds,
@@ -1586,12 +1662,6 @@ def run_chaos_measurement():
                         max_actor_restarts=2,
                     ),
                 )
-        finally:
-            for k, v in saved_env.items():
-                if v is None:
-                    os.environ.pop(k, None)
-                else:
-                    os.environ[k] = v
         rob_c = res_cont.get("robustness", {})
         cont_timeline = (res_cont.get("obs") or {}).get("timeline") or []
         cont_ttr_timeline = _timeline_recovery_s(cont_timeline)
@@ -1623,24 +1693,135 @@ def run_chaos_measurement():
             # the timeline recorded it, round indices included
             "fault_events": _timeline_fault_events(cont_timeline),
         }
-        if restart_ttr and cont_ttr:
-            ratio = round(cont_ttr / restart_ttr, 4)
-            section["continue_vs_restart"] = {
-                "restart_time_to_recover_s": restart_ttr,
-                "continue_time_to_recover_s": cont_ttr,
-                "ratio": ratio,
-                "continue_faster": ratio < 1.0,
-            }
-            if ratio >= 1.0:
-                print(
-                    f"[bench] WARNING: elastic continuation recovered in "
-                    f"{cont_ttr:.2f}s, NOT faster than the "
-                    f"restart-from-checkpoint policy ({restart_ttr:.2f}s) — "
-                    f"the zero-replay path has lost its edge.",
-                    file=sys.stderr,
-                )
+        cvr = _continue_vs_restart_block(restart_ttr, cont_ttr, "base")
+        if cvr is not None:
+            section["continue_vs_restart"] = cvr
+    # per-config pairings: the SAME restart-vs-continue experiment over the
+    # configurations that used to be fallback cases — the 2D row x feature
+    # mesh and the streamed (out-of-core) matrix. Each arm runs its own
+    # uninterrupted reference, a kill under the restart-from-checkpoint
+    # policy, and the same kill under elastic in-flight continuation; the
+    # continue_vs_restart ratios feed elastic_recovery_tripwire alongside
+    # the base pairing.
+    if actors >= 2:
+        arm_rows = int(os.environ.get("BENCH_CHAOS_ARM_ROWS",
+                                      min(n_rows, 8_000)))
+        arm_rounds = int(os.environ.get("BENCH_CHAOS_ARM_ROUNDS", rounds))
+        arm_kill = max(1, arm_rounds // 3) | 1
+        ax, ay = make_higgs_like(arm_rows, 28, seed=3)
+        actors_2d = max(2, actors // 2)
+        if actors_2d * 2 <= len(jax.devices()):
+            section["elastic_2d"] = _paired_continue_vs_restart(
+                label="2d",
+                params={**params, "feature_parallel": 2},
+                make_dmatrix=lambda: RayDMatrix(ax, ay),
+                x=ax,
+                rounds=arm_rounds, actors=actors_2d, kill_round=arm_kill,
+                config={"rows": arm_rows, "rounds": arm_rounds,
+                        "actors": actors_2d, "feature_parallel": 2,
+                        "kill_round": arm_kill, "max_depth": 6},
+            )
+        chunk_rows = max(256, arm_rows // 8)
+        section["elastic_streamed"] = _paired_continue_vs_restart(
+            label="streamed",
+            params=params,
+            make_dmatrix=lambda: RayDMatrix(
+                ax, ay, stream=True, chunk_rows=chunk_rows
+            ),
+            x=ax,
+            rounds=arm_rounds, actors=actors, kill_round=arm_kill,
+            config={"rows": arm_rows, "rounds": arm_rounds,
+                    "actors": actors, "streamed": True,
+                    "chunk_rows": chunk_rows, "kill_round": arm_kill,
+                    "max_depth": 6},
+        )
     print(f"[bench] chaos section: {section}", file=sys.stderr)
     return section
+
+
+def _paired_continue_vs_restart(label, params, make_dmatrix, x, rounds,
+                                actors, kill_round, config):
+    """One restart-vs-continue pairing for a specific training config: the
+    same deterministic kill, once under the restart-from-checkpoint policy
+    and once under elastic in-flight continuation (immediate
+    reintegration). Returns the arm dict with both recoveries, the
+    continue arm's zero-replay/identity verdicts, and the
+    ``continue_vs_restart`` ratio the elastic tripwire tracks."""
+    from xgboost_ray_tpu import RayParams, faults, train
+
+    noop = faults.FaultPlan(rules=[{
+        "site": "actor.train_round", "action": "raise",
+        "match": {"round": -1},
+    }])
+    with faults.active_plan(noop):
+        ref = train(params, make_dmatrix(), rounds,
+                    ray_params=RayParams(num_actors=actors,
+                                         checkpoint_frequency=2))
+    ref_margin = ref.predict(x, output_margin=True)
+
+    def kill_plan():
+        return faults.FaultPlan(rules=[{
+            "site": "actor.train_round", "action": "raise",
+            "match": {"round": kill_round}, "ranks": [actors - 1],
+            "message": f"chaos: scheduled rank kill ({label})",
+        }])
+
+    # restart-from-checkpoint policy
+    res_r = {}
+    with faults.active_plan(kill_plan()):
+        bst_r = train(params, make_dmatrix(), rounds, additional_results=res_r,
+                      ray_params=RayParams(num_actors=actors,
+                                           checkpoint_frequency=2,
+                                           max_actor_restarts=2))
+    rob_r = res_r.get("robustness", {})
+    tl_r = (res_r.get("obs") or {}).get("timeline") or []
+    restart_ttr = _timeline_recovery_s(tl_r) or rob_r.get(
+        "time_to_recover_s", 0.0
+    )
+
+    # elastic in-flight continuation, immediate reintegration
+    res_c = {}
+    with _immediate_reintegration_env():
+        with faults.active_plan(kill_plan()):
+            bst_c = train(params, make_dmatrix(), rounds,
+                          additional_results=res_c,
+                          ray_params=RayParams(num_actors=actors,
+                                               checkpoint_frequency=2,
+                                               elastic_training=True,
+                                               max_failed_actors=actors - 1,
+                                               max_actor_restarts=2))
+    rob_c = res_c.get("robustness", {})
+    tl_c = (res_c.get("obs") or {}).get("timeline") or []
+    cont_ttr = _timeline_recovery_s(tl_c) or rob_c.get(
+        "time_to_recover_s", 0.0
+    )
+    arm = {
+        "restart": {
+            "time_to_recover_s": restart_ttr,
+            "restarts": rob_r.get("restarts", 0),
+            "rounds_replayed": rob_r.get("rounds_replayed", 0),
+            "model_matches": bool(np.allclose(
+                bst_r.predict(x, output_margin=True), ref_margin, atol=1e-5
+            )),
+        },
+        "elastic": {
+            "time_to_recover_s": cont_ttr,
+            "restarts": rob_c.get("restarts", 0),
+            "rounds_replayed": rob_c.get("rounds_replayed", 0),
+            "shrinks": rob_c.get("shrinks", 0),
+            "grows": rob_c.get("grows", 0),
+            "model_matches": bool(np.allclose(
+                bst_c.predict(x, output_margin=True), ref_margin, atol=1e-5
+            )),
+            "fault_events": _timeline_fault_events(tl_c),
+        },
+        "config": config,
+    }
+    cvr = _continue_vs_restart_block(restart_ttr, cont_ttr, label)
+    if cvr is not None:
+        arm["continue_vs_restart"] = cvr
+    print(f"[bench] chaos {label} pairing: {arm}", file=sys.stderr)
+    return arm
 
 
 def run_serve_measurement():
@@ -2327,6 +2508,17 @@ def chaos_only_main():
         ok = ok and elastic_sec["model_matches"]
         ok = ok and elastic_sec["rounds_replayed"] == 0
         cvr = section.get("continue_vs_restart")
+        if cvr is not None:
+            ok = ok and cvr["continue_faster"]
+    # the per-config pairings carry the same contract: zero replay,
+    # uninterrupted-model identity, continuation strictly faster
+    for key in ("elastic_2d", "elastic_streamed"):
+        arm = section.get(key)
+        if arm is None:
+            continue
+        ok = ok and arm["elastic"]["rounds_replayed"] == 0
+        ok = ok and arm["elastic"]["model_matches"]
+        cvr = arm.get("continue_vs_restart")
         if cvr is not None:
             ok = ok and cvr["continue_faster"]
     print(

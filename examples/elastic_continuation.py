@@ -13,6 +13,11 @@ CI smoke (kill + reintegrate via env):
     RXGB_FAULT_PLAN='{"rules": [{"site": "actor.train_round",
         "action": "raise", "ranks": [1], "match": {"round": 3}}]}' \
     python examples/elastic_continuation.py
+
+Config knobs (the CI smokes run the 2D-mesh and streamed variants through
+the same script — every shipped gbtree configuration continues in-flight):
+    RXGB_SMOKE_FEATURE_PARALLEL=2   # train on the 2D (R, C) mesh
+    RXGB_SMOKE_STREAM=1             # streamed (out-of-core) ingestion
 """
 
 import os
@@ -30,11 +35,22 @@ def main():
     x = rng.randn(2048, 8).astype(np.float32)
     y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float32)
 
+    params = {"objective": "binary:logistic", "eval_metric": ["logloss"],
+              "max_depth": 4}
+    fp = int(os.environ.get("RXGB_SMOKE_FEATURE_PARALLEL", "1"))
+    if fp > 1:
+        params["feature_parallel"] = fp
+    if os.environ.get("RXGB_SMOKE_STREAM") == "1":
+        # multi-chunk so the real streamed branch runs (single-chunk loads
+        # degrade to the materialized path by design)
+        dtrain = RayDMatrix(x, y, stream=True, chunk_rows=256)
+    else:
+        dtrain = RayDMatrix(x, y)
+
     res = {}
     bst = train(
-        {"objective": "binary:logistic", "eval_metric": ["logloss"],
-         "max_depth": 4},
-        RayDMatrix(x, y),
+        params,
+        dtrain,
         8,
         additional_results=res,
         ray_params=RayParams(num_actors=2, elastic_training=True,

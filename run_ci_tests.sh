@@ -50,3 +50,17 @@ echo "========= Running elastic-continuation chaos smoke (kill + reintegrate) ==
 PYTHONPATH=".:$PYTHONPATH" \
 RXGB_FAULT_PLAN='{"rules": [{"site": "actor.train_round", "action": "raise", "ranks": [1], "match": {"round": 3}}]}' \
     python examples/elastic_continuation.py
+echo "========= Running 2D-mesh elastic-continuation chaos smoke ========="
+# the same kill on the 2D (R, C) row x feature mesh: the shrink/grow path
+# must absorb it in-flight (feature tiles fixed, zero rounds replayed)
+PYTHONPATH=".:$PYTHONPATH" \
+RXGB_SMOKE_FEATURE_PARALLEL=2 \
+RXGB_FAULT_PLAN='{"rules": [{"site": "actor.train_round", "action": "raise", "ranks": [1], "match": {"round": 3}}]}' \
+    python examples/elastic_continuation.py
+echo "========= Running streamed elastic-continuation chaos smoke ========="
+# the same kill on a streamed (out-of-core) matrix: continuation reuses the
+# survivors' binned blocks + frozen cuts (zero re-stream, zero re-sketch)
+PYTHONPATH=".:$PYTHONPATH" \
+RXGB_SMOKE_STREAM=1 \
+RXGB_FAULT_PLAN='{"rules": [{"site": "actor.train_round", "action": "raise", "ranks": [1], "match": {"round": 3}}]}' \
+    python examples/elastic_continuation.py

@@ -230,6 +230,118 @@ def test_elastic_tripwire_skips_incomparable_records():
     assert bench.elastic_recovery_tripwire({}, rec_tpu, "x") is None
 
 
+_ARM_CFG_2D = {"rows": 8000, "rounds": 12, "actors": 4,
+               "feature_parallel": 2, "kill_round": 5, "max_depth": 6}
+_ARM_CFG_STREAMED = {"rows": 8000, "rounds": 12, "actors": 8,
+                     "streamed": True, "chunk_rows": 1000, "kill_round": 5,
+                     "max_depth": 6}
+
+
+def _arm(ratio, cfg):
+    return {
+        "restart": {"time_to_recover_s": 10.0, "restarts": 1,
+                    "rounds_replayed": 1, "model_matches": True},
+        "elastic": {"time_to_recover_s": round(10.0 * ratio, 4),
+                    "restarts": 0, "rounds_replayed": 0, "shrinks": 0,
+                    "grows": 1, "model_matches": True, "fault_events": []},
+        "continue_vs_restart": {
+            "restart_time_to_recover_s": 10.0,
+            "continue_time_to_recover_s": round(10.0 * ratio, 4),
+            "ratio": ratio,
+            "continue_faster": ratio < 1.0,
+        },
+        "config": dict(cfg),
+    }
+
+
+def _full_elastic_section(base_ratio, ratio_2d, ratio_streamed,
+                          cfg_2d=None, cfg_streamed=None):
+    sec = _elastic_chaos_section(base_ratio)
+    sec["elastic_2d"] = _arm(ratio_2d, cfg_2d or _ARM_CFG_2D)
+    sec["elastic_streamed"] = _arm(
+        ratio_streamed, cfg_streamed or _ARM_CFG_STREAMED
+    )
+    return sec
+
+
+def test_elastic_tripwire_fires_on_2d_arm_regression(capsys):
+    """The base pairing holding steady must not mask a regression of the
+    2D-mesh arm: 0.2 -> 0.3 on elastic_2d alone fires, tagged per arm."""
+    rec = {"metric": "m", "backend": "cpu",
+           "chaos": _full_elastic_section(0.2, 0.2, 0.2)}
+    out = bench.elastic_recovery_tripwire(
+        _full_elastic_section(0.2, 0.3, 0.2), rec, "BENCH_r08.json",
+        backend="cpu",
+    )
+    assert out is not None and out["fired"]
+    assert out["ratio"] == 1.0  # the base pairing itself is steady
+    assert out["arms"]["elastic_2d"]["fired"]
+    assert out["arms"]["elastic_2d"]["ratio"] == 1.5
+    assert not out["arms"]["elastic_streamed"]["fired"]
+    err = capsys.readouterr().err
+    assert "ELASTIC TRIPWIRE [elastic_2d]" in err
+
+
+def test_elastic_tripwire_fires_on_streamed_arm_regression(capsys):
+    rec = {"metric": "m", "backend": "cpu",
+           "chaos": _full_elastic_section(0.2, 0.2, 0.2)}
+    out = bench.elastic_recovery_tripwire(
+        _full_elastic_section(0.2, 0.2, 0.5), rec, "x", backend="cpu",
+    )
+    assert out is not None and out["fired"]
+    assert out["arms"]["elastic_streamed"]["fired"]
+    assert out["arms"]["elastic_streamed"]["ratio"] == 2.5
+    assert "ELASTIC TRIPWIRE [elastic_streamed]" in capsys.readouterr().err
+
+
+def test_elastic_tripwire_arm_config_mismatch_reports_never_fires(capsys):
+    """A per-arm config change (e.g. a different streamed chunking) is
+    reported on that arm and never fires it — the base pairing and the
+    other arm still compare."""
+    other = dict(_ARM_CFG_STREAMED, chunk_rows=500)
+    rec = {"metric": "m", "backend": "cpu",
+           "chaos": _full_elastic_section(0.2, 0.2, 0.2,
+                                          cfg_streamed=other)}
+    out = bench.elastic_recovery_tripwire(
+        _full_elastic_section(0.2, 0.2, 0.9), rec, "x", backend="cpu",
+    )
+    assert out is not None and not out["fired"]
+    assert out["arms"]["elastic_streamed"]["config_mismatch"] is True
+    assert not out["arms"]["elastic_streamed"]["fired"]
+    assert "ELASTIC TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_elastic_tripwire_base_config_mismatch_does_not_mask_arms(capsys):
+    """Changing only the BASE soak config must not skip the per-config
+    arms: an elastic_2d regression at matching arm config still fires,
+    while the base pairing reports config_mismatch and stays quiet."""
+    prev = _full_elastic_section(0.2, 0.2, 0.2)
+    cur = _full_elastic_section(0.2, 0.5, 0.2)
+    cur["config"] = dict(cur["config"], rows=999)  # base soak config drifts
+    rec = {"metric": "m", "backend": "cpu", "chaos": prev}
+    out = bench.elastic_recovery_tripwire(cur, rec, "x", backend="cpu")
+    assert out is not None and out["fired"]
+    assert out["config_mismatch"] is True  # base never fires...
+    assert out["arms"]["elastic_2d"]["fired"]  # ...but the arm does
+    err = capsys.readouterr().err
+    assert "ELASTIC TRIPWIRE [elastic_2d]" in err
+    assert "ELASTIC TRIPWIRE [base]" not in err
+
+
+def test_elastic_tripwire_tolerates_records_without_arms(capsys):
+    """A previous record from before the per-config pairings existed (no
+    elastic_2d / elastic_streamed) compares the base pairing only; the new
+    arms are skipped, not treated as regressions."""
+    rec = {"metric": "m", "backend": "cpu",
+           "chaos": _elastic_chaos_section(0.2)}
+    out = bench.elastic_recovery_tripwire(
+        _full_elastic_section(0.2, 0.9, 0.9), rec, "x", backend="cpu",
+    )
+    assert out is not None and not out["fired"]
+    assert "arms" not in out
+    assert "ELASTIC TRIPWIRE" not in capsys.readouterr().err
+
+
 _SAMP_CFG = {"rows": 200000, "features": 28, "rounds": 20, "actors": 8,
              "max_depth": 6, "subsample_rate": 0.5, "goss_top_rate": 0.1,
              "goss_other_rate": 0.1}

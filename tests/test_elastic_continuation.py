@@ -300,13 +300,164 @@ def test_too_many_dead_still_aborts_in_flight(monkeypatch):
                                        checkpoint_frequency=2))
 
 
-def test_dart_elastic_falls_back_to_restart(monkeypatch):
-    """dart cannot re-shard mid-flight (capacity-padded device forest) —
-    an elastic kill must fall back to the legacy restart-from-checkpoint
-    continuation instead of failing."""
+def test_dart_elastic_continues_in_flight(monkeypatch):
+    """dart is no longer a fallback case: the capacity-padded device
+    forest, tree weights and slot cursor rebuild from the in-memory
+    booster (``_reset_dart_state`` keeps the compiled capacity), and the
+    per-round drop RNG is a pure function of (seed, global round) — so a
+    mid-attempt kill shrinks in place with zero replay, no restart, and
+    the whole chaotic run is bitwise reproducible."""
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_DISABLED", "1")
+    x, y = _data(256)
+    params = dict(_PARAMS, booster="dart", rate_drop=0.1)
+    outs = []
+    for _ in range(2):
+        res = {}
+        with faults.active_plan(_kill_plan(3, [1])):
+            bst = train(params, RayDMatrix(x, y), 6, additional_results=res,
+                        ray_params=RayParams(num_actors=2,
+                                             elastic_training=True,
+                                             max_failed_actors=1,
+                                             max_actor_restarts=2,
+                                             checkpoint_frequency=2))
+        outs.append(bst.predict(x, output_margin=True))
+    assert bst.num_boosted_rounds() == 6
+    rob = res["robustness"]
+    assert rob["rounds_replayed"] == 0
+    assert rob["restarts"] == 0 and rob["elastic_restarts"] == 0
+    assert rob["shrinks"] == 1 and rob["grows"] == 0
+    assert np.array_equal(outs[0], outs[1])
+
+
+def test_dart_shrink_then_boundary_growback_bitwise_rerun(monkeypatch):
+    """dart shrink + boundary grow-back into the cached engine
+    (``reset_from_booster`` refills the pinned-capacity forest): zero
+    replay end to end, world restored, chaos-vs-chaos bitwise."""
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_RESOURCE_CHECK_S", "0")
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_GRACE_PERIOD_S", "0")
+    x, y = _data(512)
+    params = dict(_PARAMS, booster="dart", rate_drop=0.1)
+    plan_rules = [
+        {"site": "actor.train_round", "action": "raise", "ranks": [1],
+         "match": {"round": 3}},
+        {"site": "actor.load_shard", "action": "delay", "delay_s": 2.0,
+         "match": {"rank": 1}, "at": 2},
+    ]
+    outs = []
+    for _ in range(2):
+        res = {}
+        with faults.active_plan(faults.FaultPlan(rules=list(plan_rules))):
+            bst = train(params, RayDMatrix(x, y), 12, additional_results=res,
+                        ray_params=RayParams(num_actors=2,
+                                             elastic_training=True,
+                                             max_failed_actors=1,
+                                             max_actor_restarts=2,
+                                             checkpoint_frequency=4))
+        outs.append(bst.predict(x, output_margin=True))
+    rob = res["robustness"]
+    assert rob["rounds_replayed"] == 0 and rob["restarts"] == 0
+    assert rob["shrinks"] == 1 and rob["grows"] == 1
+    assert res["total_n"] == 512
+    assert np.array_equal(outs[0], outs[1])
+
+
+def test_2d_immediate_growback_is_bitwise_identical(monkeypatch):
+    """2D row x feature mesh (feature_parallel=2): a kill whose replacement
+    stages within the fast path continues on the SAME compiled (R, C)
+    engine — bitwise identical to the uninterrupted 2D run."""
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_RESOURCE_CHECK_S", "0")
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_GRACE_PERIOD_S", "0")
+    x, y = _data(256)
+    params = dict(_PARAMS, feature_parallel=2)
+    with faults.active_plan(_noop_plan()):
+        ref = train(params, RayDMatrix(x, y), 8,
+                    ray_params=RayParams(num_actors=2,
+                                         checkpoint_frequency=3))
+    res = {}
+    with faults.active_plan(_kill_plan(4, [0])):
+        bst = train(params, RayDMatrix(x, y), 8, additional_results=res,
+                    ray_params=RayParams(num_actors=2, elastic_training=True,
+                                         max_failed_actors=1,
+                                         max_actor_restarts=2,
+                                         checkpoint_frequency=3))
+    rob = res["robustness"]
+    assert rob["rounds_replayed"] == 0 and rob["restarts"] == 0
+    assert rob["grows"] == 1 and rob["shrinks"] == 0
+    assert np.array_equal(
+        bst.predict(x, output_margin=True),
+        ref.predict(x, output_margin=True),
+    )
+
+
+def test_2d_shrink_then_boundary_growback_bitwise_rerun(monkeypatch):
+    """The PR's 2D keystone: a kill on the (2, 2) mesh shrinks to (1, 2)
+    in place — feature tiles fixed, row axis retraced — then grows back
+    into the CACHED (2, 2) engine at a round boundary via
+    ``reset_from_booster``. Zero replay throughout, the full world's rows
+    restored, and the whole chaotic run bitwise reproducible."""
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_RESOURCE_CHECK_S", "0")
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_GRACE_PERIOD_S", "0")
+    x, y = _data(512)
+    params = dict(_PARAMS, feature_parallel=2)
+    plan_rules = [
+        {"site": "actor.train_round", "action": "raise", "ranks": [1],
+         "match": {"round": 3}},
+        {"site": "actor.load_shard", "action": "delay", "delay_s": 2.0,
+         "match": {"rank": 1}, "at": 2},
+    ]
+    outs = []
+    for _ in range(2):
+        res = {}
+        with faults.active_plan(faults.FaultPlan(rules=list(plan_rules))):
+            bst = train(params, RayDMatrix(x, y), 12, additional_results=res,
+                        ray_params=RayParams(num_actors=2,
+                                             elastic_training=True,
+                                             max_failed_actors=1,
+                                             max_actor_restarts=2,
+                                             checkpoint_frequency=4))
+        outs.append(bst.predict(x, output_margin=True))
+    assert bst.num_boosted_rounds() == 12
+    rob = res["robustness"]
+    assert rob["rounds_replayed"] == 0
+    assert rob["restarts"] == 0 and rob["elastic_restarts"] == 0
+    assert rob["shrinks"] == 1 and rob["grows"] == 1
+    assert res["total_n"] == 512
+    assert np.array_equal(outs[0], outs[1])
+
+
+def test_2d_int8gh_shrink_composition(monkeypatch):
+    """Composition case: quantized gradients (gh_precision=int8) on the 2D
+    mesh still continue in place — the stochastic-rounding salt folds on
+    (seed, global round, actor), so the shrunken world's draws are
+    deterministic and the chaos rerun is bitwise."""
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_DISABLED", "1")
+    x, y = _data(256)
+    params = dict(_PARAMS, feature_parallel=2, gh_precision="int8")
+    outs = []
+    for _ in range(2):
+        res = {}
+        with faults.active_plan(_kill_plan(3, [1])):
+            bst = train(params, RayDMatrix(x, y), 6, additional_results=res,
+                        ray_params=RayParams(num_actors=2,
+                                             elastic_training=True,
+                                             max_failed_actors=1,
+                                             max_actor_restarts=2,
+                                             checkpoint_frequency=2))
+        outs.append(bst.predict(x, output_margin=True))
+    rob = res["robustness"]
+    assert rob["rounds_replayed"] == 0 and rob["restarts"] == 0
+    assert rob["shrinks"] == 1
+    assert np.array_equal(outs[0], outs[1])
+
+
+def test_gblinear_elastic_falls_back_to_restart(monkeypatch):
+    """gblinear is the one remaining restart-only booster (``LinearEngine``
+    has no ``can_reshard``; the driver's probe defaults to False) — an
+    elastic kill must still take the legacy restart-from-checkpoint path
+    instead of failing."""
     monkeypatch.setenv("RXGB_ELASTIC_RESTART_DISABLED", "1")
     x, y = _data(128)
-    params = dict(_PARAMS, booster="dart", rate_drop=0.1)
+    params = dict(_PARAMS, booster="gblinear")
     res = {}
     with faults.active_plan(_kill_plan(3, [1])):
         bst = train(params, RayDMatrix(x, y), 6, additional_results=res,

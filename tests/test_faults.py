@@ -56,6 +56,106 @@ def _noop_plan():
 
 
 # ---------------------------------------------------------------------------
+# streaming-plane fault sites (stream.read_chunk / stream.h2d_upload)
+# ---------------------------------------------------------------------------
+
+
+def test_stream_read_chunk_fault_site():
+    """A scheduled raise at the k-th chunk read surfaces from the ingest
+    pipeline at exactly that chunk (the streaming plane's analog of
+    actor.load_shard), and is reproducible: the counter advances per
+    chunks() iteration, so the same plan fails at the same chunk."""
+    from xgboost_ray_tpu.engine import TpuEngine
+    from xgboost_ray_tpu.params import parse_params
+    from xgboost_ray_tpu.stream.reader import array_shard_stream
+
+    x, y = _data(n=1200)
+    p = parse_params(_PARAMS)
+    plan = faults.FaultPlan(rules=[{
+        "site": "stream.read_chunk", "action": "raise", "at": 3,
+        "message": "chaos: chunk source died",
+    }])
+    with faults.active_plan(plan):
+        with pytest.raises(RuntimeError, match="chunk source died"):
+            TpuEngine([array_shard_stream(x, label=y, chunk_rows=300)], p,
+                      num_actors=2)
+    # match-filtered by chunk index: only the matching chunk advances it
+    plan2 = faults.FaultPlan(rules=[{
+        "site": "stream.read_chunk", "action": "raise",
+        "match": {"chunk": 2}, "message": "chaos: third chunk",
+    }])
+    with faults.active_plan(plan2):
+        with pytest.raises(RuntimeError, match="third chunk"):
+            TpuEngine([array_shard_stream(x, label=y, chunk_rows=300)], p,
+                      num_actors=2)
+
+
+def test_stream_h2d_upload_fault_site():
+    """A scheduled raise at the k-th H2D submit surfaces on the TRAINING
+    thread (where drain() would surface a real transfer failure), and a
+    delay models a stalled upload pipe without wedging the worker."""
+    from xgboost_ray_tpu.engine import TpuEngine
+    from xgboost_ray_tpu.params import parse_params
+    from xgboost_ray_tpu.stream.reader import array_shard_stream
+
+    x, y = _data(n=1200)
+    p = parse_params(_PARAMS)
+    plan = faults.FaultPlan(rules=[{
+        "site": "stream.h2d_upload", "action": "raise",
+        "message": "chaos: upload failed",
+    }])
+    with faults.active_plan(plan):
+        with pytest.raises(RuntimeError, match="upload failed"):
+            TpuEngine([array_shard_stream(x, label=y, chunk_rows=300)], p,
+                      num_actors=2)
+    # a delayed upload only slows ingest; training still completes and the
+    # injected fault lands on the timeline
+    from xgboost_ray_tpu import obs
+
+    tracer = obs.Tracer(enabled=True)
+    plan2 = faults.FaultPlan(rules=[{
+        "site": "stream.h2d_upload", "action": "delay", "delay_s": 0.05,
+    }])
+    with obs.use_tracer(tracer):
+        with faults.active_plan(plan2):
+            eng = TpuEngine(
+                [array_shard_stream(x, label=y, chunk_rows=300)], p,
+                num_actors=2,
+            )
+            eng.step(0)
+    injected = [r for r in tracer.records() if r["name"] == "fault.injected"]
+    assert any(r["attrs"]["site"] == "stream.h2d_upload" for r in injected)
+
+
+def test_streamed_ingest_fault_is_deterministic():
+    """Chaos-vs-chaos over the streaming plane: two runs of the same
+    read-chunk straggler plan train bitwise-identical forests (the delay
+    perturbs wall time only, never data order)."""
+    from xgboost_ray_tpu.engine import TpuEngine
+    from xgboost_ray_tpu.params import parse_params
+    from xgboost_ray_tpu.stream.reader import array_shard_stream
+
+    x, y = _data(n=1200)
+    p = parse_params(_PARAMS)
+    outs = []
+    for _ in range(2):
+        plan = faults.FaultPlan(rules=[{
+            "site": "stream.read_chunk", "action": "delay",
+            "delay_s": 0.05, "at": 2,
+        }])
+        with faults.active_plan(plan):
+            eng = TpuEngine(
+                [array_shard_stream(x, label=y, chunk_rows=300)], p,
+                num_actors=2,
+            )
+            for i in range(3):
+                eng.step(i)
+        outs.append([np.asarray(f) for f in eng.get_booster().forest])
+    for f1, f2 in zip(*outs):
+        assert np.array_equal(f1, f2)
+
+
+# ---------------------------------------------------------------------------
 # FaultPlan unit semantics (pure, no training)
 # ---------------------------------------------------------------------------
 

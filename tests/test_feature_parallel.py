@@ -380,15 +380,27 @@ def test_2d_schedule_pin():
 
 
 # ---------------------------------------------------------------------------
-# elastic: 2D falls back to the legacy restart path
+# elastic: 2D reshards in flight (zero-replay shrink/grow)
 # ---------------------------------------------------------------------------
 
-def test_2d_engine_refuses_reshard():
+def test_2d_engine_reshards_in_flight():
+    """2D engines re-shard now: ``can_reshard()`` is True and a reset
+    against the same shards reuses the compiled (R, C) programs, boosting
+    from the supplied booster with no retrace (the elastic grow-back
+    path)."""
     shards = _shards(rows=64, feats=4, missing=False)
     eng = TpuEngine(shards, parse_params({**_BASE, "feature_parallel": 2}),
                     num_actors=2)
-    assert not eng.can_reshard()
-    with pytest.raises(ValueError, match="feature_parallel"):
-        eng.reset_from_booster(shards, [], None)
-    eng1 = TpuEngine(shards, parse_params(_BASE), num_actors=2)
-    assert eng1.can_reshard()
+    assert eng.can_reshard()
+    for i in range(2):
+        eng.step(i)
+    bst = eng.get_booster()
+    step_fn = eng._step_fn
+    eng.reset_from_booster(shards, [], bst)
+    assert eng._step_fn is step_fn  # compiled 2D round program retained
+    assert eng.iteration_offset == 2
+    eng.step(0)
+    # a changed shard layout still refuses loudly
+    with pytest.raises(ValueError, match="layout changed"):
+        eng.reset_from_booster(_shards(rows=32, feats=4, missing=False),
+                               [], bst)

@@ -412,15 +412,161 @@ def test_streamed_warm_start_gates_on_cut_drift():
         )
 
 
-def test_streamed_engine_is_gated_out_of_inflight_reshard():
+def test_streamed_engine_reshards_in_flight():
+    """Streamed engines re-shard now: ``can_reshard()`` is True, a reset
+    against the SAME shard streams rebuilds margins via the binned forest
+    walk (retained cuts — no re-stream, no re-sketch), and a reset against
+    different streams (or materialized shards) is loudly rejected."""
     x, y = _data(n=3000, f=4, seed=10)
     p = parse_params(_PARAMS)
-    eng = TpuEngine([array_shard_stream(x, label=y, chunk_rows=500)], p,
-                    num_actors=2)
+    mk = lambda: [array_shard_stream(x, label=y, chunk_rows=500)]  # noqa: E731
+    shards = mk()
+    eng = TpuEngine(shards, p, num_actors=2)
     assert eng._streamed
-    assert not eng.can_reshard()
-    with pytest.raises(ValueError, match="streamed"):
-        eng.reset_from_booster([{"data": x, "label": y}], [], None)
+    assert eng.can_reshard()
+    for i in range(3):
+        eng.step(i)
+    bst = eng.get_booster()
+    step_fn = eng._step_fn
+    eng.reset_from_booster(shards, [], bst)
+    assert eng._step_fn is step_fn  # compiled round program retained
+    assert eng.iteration_offset == 3
+    eng.step(0)
+    # materialized shards / different streams cannot ride the reset
+    with pytest.raises(ValueError, match="streamed shard identity"):
+        eng.reset_from_booster([{"data": x, "label": y}], [], bst)
+    with pytest.raises(ValueError, match="streamed shard identity"):
+        eng.reset_from_booster(
+            [array_shard_stream(x, label=y, chunk_rows=250)], [], bst
+        )
+
+
+def test_streamed_shrink_reuses_donor_bins_zero_resketch():
+    """The PR's streamed keystone at engine level: a shrunken world built
+    with ``stream_donor=`` reuses the survivors' binned blocks and FROZEN
+    cuts — the timeline after the donor build shows bin-reuse spans and
+    ZERO sketch/bin-chunk work, and the shrunken engine's cuts are bitwise
+    the donor's."""
+    from xgboost_ray_tpu.stream.reader import StreamConfig, fields_shard_stream
+
+    x, y = _data(n=3000, f=5, seed=11)
+    cfg = lambda: StreamConfig(chunk_rows=400)  # noqa: E731
+
+    def shard(lo, hi, rank):
+        return {"stream": fields_shard_stream(
+            {"data": x[lo:hi], "label": y[lo:hi]}, config=cfg(),
+            source_token=("central", "uid", rank),
+        )}
+
+    p = parse_params(_PARAMS)
+    donor = TpuEngine([shard(0, 1500, 0), shard(1500, 3000, 1)], p,
+                      num_actors=2)
+    for i in range(3):
+        donor.step(i)
+    bst = donor.get_booster()
+
+    tracer = obs.Tracer(enabled=True)
+    with obs.use_tracer(tracer):
+        surv = TpuEngine([shard(0, 1500, 0)], p, num_actors=1,
+                         init_booster=bst, stream_donor=donor)
+    names = [r["name"] for r in tracer.records()]
+    assert "data.sketch_chunk" not in names
+    assert "data.bin_chunk" not in names
+    assert "data.cuts_merge" not in names
+    assert "data.bin_reuse" in names
+    assert surv._stream_stats["reused_from_donor"] is True
+    assert np.array_equal(surv._stream_cuts_np, donor._stream_cuts_np)
+    assert surv.iteration_offset == 3
+    surv.step(0)
+
+    # the shrunken world's binned rows are bitwise the donor's survivor rows
+    assert np.array_equal(
+        np.asarray(surv.bins)[:1500], np.asarray(donor.bins)[:1500]
+    )
+
+
+def test_streamed_growback_restreams_only_the_new_shard():
+    """Grow-back onto a brand-new replacement shard (engine-cache miss):
+    the donor seeds every surviving shard from memory and only the ONE new
+    shard re-streams — binned against the donor's frozen cuts, with
+    bin-chunk spans for that shard alone and still zero sketch work."""
+    from xgboost_ray_tpu.stream.reader import StreamConfig, fields_shard_stream
+
+    x, y = _data(n=3000, f=5, seed=12)
+
+    def shard(lo, hi, rank, uid="uid"):
+        return {"stream": fields_shard_stream(
+            {"data": x[lo:hi], "label": y[lo:hi]},
+            config=StreamConfig(chunk_rows=400),
+            source_token=("central", uid, rank),
+        )}
+
+    p = parse_params(_PARAMS)
+    donor = TpuEngine([shard(0, 1500, 0)], p, num_actors=1)
+    for i in range(2):
+        donor.step(i)
+    bst = donor.get_booster()
+
+    tracer = obs.Tracer(enabled=True)
+    with obs.use_tracer(tracer):
+        grown = TpuEngine(
+            [shard(0, 1500, 0), shard(1500, 3000, 1, uid="uid2")], p,
+            num_actors=2, init_booster=bst, stream_donor=donor,
+        )
+    names = [r["name"] for r in tracer.records()]
+    assert "data.sketch_chunk" not in names
+    assert "data.cuts_merge" not in names
+    assert "data.bin_chunk" in names  # the one re-streamed shard
+    st = grown._stream_stats
+    assert st["reused_shards"] == 1 and st["restreamed_shards"] == 1
+    assert st["restreamed_rows"] == 1500
+    assert np.array_equal(grown._stream_cuts_np, donor._stream_cuts_np)
+    grown.step(0)
+    # the re-streamed shard binned against the frozen cuts lands bitwise
+    # where a direct host binning of its raw rows would
+    expect = binning.bin_matrix_np(
+        x[1500:3000], donor._stream_cuts_np, p.max_bin
+    )
+    assert np.array_equal(np.asarray(grown.bins)[1500:3000], expect)
+
+
+def test_streamed_growback_restream_is_budget_prevalidated():
+    """A grow-back re-stream that cannot fit the host budget must fail
+    BEFORE the new shard's first byte streams (the reuse pass runs the
+    same validate_budget model as the original ingest)."""
+    from xgboost_ray_tpu.stream.reader import (
+        ShardStream, StreamConfig, fields_shard_stream,
+    )
+
+    x, y = _data(n=3000, f=5, seed=13)
+
+    def shard(lo, hi, rank):
+        return {"stream": fields_shard_stream(
+            {"data": x[lo:hi], "label": y[lo:hi]},
+            config=StreamConfig(chunk_rows=400),
+            source_token=("central", "uid", rank),
+        )}
+
+    p = parse_params(_PARAMS)
+    donor = TpuEngine([shard(0, 1500, 0)], p, num_actors=1)
+    donor.step(0)
+    bst = donor.get_booster()
+
+    reads = {"n": 0}
+
+    def bomb_chunk_fn(lo, hi):
+        reads["n"] += 1
+        return {"data": x[1500 + lo:1500 + hi], "label": y[1500 + lo:1500 + hi]}
+
+    bomb = {"stream": ShardStream(
+        1500, 5, bomb_chunk_fn,
+        config=StreamConfig(chunk_rows=400, budget_mb=0.001),
+        source_token=("central", "uid2", 1),
+    )}
+    with pytest.raises(ValueError, match="cannot hold"):
+        TpuEngine([shard(0, 1500, 0), bomb], p, num_actors=2,
+                  init_booster=bst, stream_donor=donor)
+    assert reads["n"] == 0, "budget must reject before any byte streams"
 
 
 # ---------------------------------------------------------------------------

@@ -115,7 +115,12 @@ FULL_MATRIX: Tuple[MatrixEntry, ...] = (
     # election all_gather + bin-column psums on the features axis) across
     # coexisting row worlds the same way the 1D quantized schedule is
     # pinned.
-    MatrixEntry("depthwise-2d", {"feature_parallel": 2}, (2, 4)),
+    # world 3 is the SHRUNKEN-WORLD row: an elastic shrink of the (4, 2)
+    # mesh rebuilds as (3, 2) with feature tiles fixed, so the odd row
+    # extent must trace the identical collective schedule as its siblings
+    # (VER001 cross-world identity = the deadlock-freedom certificate for
+    # the shrunken 2D meshes the zero-replay continuation compiles).
+    MatrixEntry("depthwise-2d", {"feature_parallel": 2}, (2, 3, 4)),
     MatrixEntry(
         "depthwise-2d-int8",
         {"feature_parallel": 2, "hist_quant": "int8",
@@ -130,10 +135,11 @@ FULL_MATRIX: Tuple[MatrixEntry, ...] = (
     MatrixEntry(
         # 2D row x feature mesh under quantized gh: histogram psums stay
         # int32 on the actors axis; the feature axis still carries only the
-        # tiny election/broadcast traffic
+        # tiny election/broadcast traffic. World 3 pins the shrunken-world
+        # composition (int8 gh x 2D after an elastic shrink).
         "depthwise-2d-int8gh",
         {"feature_parallel": 2, "gh_precision": "int8"},
-        (2, 4),
+        (2, 3, 4),
     ),
     # streamed ingestion (stream/): the rows-born-binned data plane. The
     # round steps must trace the EXACT materialized schedules (VER001

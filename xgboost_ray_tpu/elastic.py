@@ -162,8 +162,9 @@ def _update_scheduled_actor_states(training_state, raise_on_ready: bool = True):
 
     Returns True when reintegration is due: the grace period has expired
     with at least one READY pending worker. With ``raise_on_ready`` (the
-    legacy restart-from-checkpoint mode, kept for engines that cannot
-    re-shard in place) a due reintegration raises
+    legacy restart-from-checkpoint mode — since every gbtree engine
+    re-shards in place now, this arm remains only for gblinear and
+    engines without a ``can_reshard`` probe) a due reintegration raises
     ``RayXGBoostActorAvailable`` instead of returning; the driver's
     in-flight grow path passes ``raise_on_ready=False`` and re-shards the
     running world at the round boundary — zero rounds replayed.

@@ -181,7 +181,15 @@ class TpuEngine:
         feature_weights: Optional[Any] = None,
         feature_types: Optional[List[str]] = None,
         categories: Optional[Dict[int, tuple]] = None,
+        stream_donor: Optional["TpuEngine"] = None,
     ):
+        # ``stream_donor``: a prior streamed engine of the SAME training run
+        # (the elastic driver passes the engine being swapped out). When this
+        # load's shard streams overlap the donor's, the new world is seeded
+        # from the donor's retained binned rows and frozen cuts — zero
+        # re-sketch, zero re-stream of surviving shards (stream/ingest.py's
+        # reuse passes). Ignored for materialized loads and incompatible
+        # donors (the full pipeline runs instead).
         self.params = params
         self.feature_names = feature_names
         # NOTE on placement: in this SPMD runtime the mesh IS the placement —
@@ -357,23 +365,44 @@ class TpuEngine:
         if self._streamed:
             from xgboost_ray_tpu.stream import ingest as stream_ingest
 
+            # elastic continuation: when a donor engine already holds (a
+            # superset of) these shards binned, skip the sketch pipeline
+            # entirely — the donor's frozen cuts + binned rows seed this
+            # world (shrink keeps every survivor shard; a grow-back onto a
+            # NEW replacement actor re-streams only that one shard)
+            self._stream_reuse_plan = stream_ingest.plan_stream_reuse(
+                streams, stream_donor, max_bin=params.max_bin
+            )
             # the FULL budget fail-fast before any byte streams: the
             # N-scaling block-buffer term needs only the declared row
-            # counts, the mesh size, and the bin dtype — all known now.
-            # (bin_upload_pass re-checks with the measured sketch bytes.)
+            # counts, the mesh size, and the bin dtype — all known now
+            # (the bin passes re-check with measured figures). The reuse
+            # variant additionally guards the columns pass from reading a
+            # byte of an over-budget re-streamed replacement shard.
             declared = sum(s.n_rows for s in streams)
             _, _, pre_pad_to = self._global_row_layout(declared)
-            stream_ingest.prevalidate_budget(
-                streams,
-                block_rows=pre_pad_to // self.n_devices,
-                bin_itemsize=np.dtype(
-                    binning.bin_dtype(params.max_bin)
-                ).itemsize,
-                n_devices=self.n_devices,
-            )
-            pass1 = stream_ingest.sketch_pass(
-                streams, params.max_bin, cat_features=self._cat_features
-            )
+            pre_block = pre_pad_to // self.n_devices
+            pre_itemsize = np.dtype(binning.bin_dtype(params.max_bin)).itemsize
+            if self._stream_reuse_plan is not None:
+                stream_ingest.prevalidate_reuse_budget(
+                    streams, self._stream_reuse_plan,
+                    block_rows=pre_block,
+                    bin_itemsize=pre_itemsize,
+                )
+                pass1 = stream_ingest.reuse_columns_pass(
+                    streams, self._stream_reuse_plan, stream_donor,
+                    params.max_bin, cat_features=self._cat_features,
+                )
+            else:
+                stream_ingest.prevalidate_budget(
+                    streams,
+                    block_rows=pre_block,
+                    bin_itemsize=pre_itemsize,
+                    n_devices=self.n_devices,
+                )
+                pass1 = stream_ingest.sketch_pass(
+                    streams, params.max_bin, cat_features=self._cat_features
+                )
             x = None
             label = (
                 pass1.label if pass1.label is not None
@@ -523,30 +552,68 @@ class TpuEngine:
         # the weighted sketch bit-identical to the unweighted one.
         self._stream_init_margins = None
         if self._streamed:
-            # streamed: two-pass host sketch -> device cuts merge (the SAME
-            # pmin/pmax/psum collective schedule as the materialized sketch
-            # program) -> chunked host binning with double-buffered upload.
-            # Rows are born binned; the raw f32 matrix never exists.
-            self.cuts, self._feat_has_missing, cuts_np, sk_err = (
-                stream_ingest.merged_cuts(self, pass1)
-            )
-            self._stream_cuts_np = cuts_np
-            self.bins, up_stats = stream_ingest.bin_upload_pass(
-                self, streams, cuts_np,
-                sketch_bytes=sum(
-                    sk.memory_bytes() for sk in pass1.sketches
-                ),
-            )
-            self._stream_stats = {
-                "chunks": int(pass1.chunks),
-                "sketch_s": round(pass1.sketch_s, 4),
-                "pass1_wall_s": round(pass1.wall_s, 4),
-                "rank_error_bound_max": float(sk_err.max(initial=0.0)),
-            }
+            if self._stream_reuse_plan is not None:
+                # elastic continuation: FROZEN donor cuts (retained in
+                # memory — bitwise the cuts every reused shard was binned
+                # with, so the booster's split_bin routing stays valid) +
+                # block assembly from the donor's device binned rows; only
+                # a shard the donor never held re-streams, against these
+                # same cuts. No sketch pass, no cuts merge.
+                cuts_np = stream_donor._stream_cuts_np.copy()
+                repl = NamedSharding(self.mesh, P())
+                self.cuts = jax.device_put(cuts_np, repl)
+                self._feat_has_missing = jax.device_put(
+                    stream_donor._stream_fhm_np.copy(), repl
+                )
+                self._stream_cuts_np = cuts_np
+                self.bins, up_stats = stream_ingest.reuse_bin_pass(
+                    self, streams, self._stream_reuse_plan, stream_donor,
+                    cuts_np,
+                )
+                self._stream_stats = {
+                    "reused_from_donor": True,
+                    "chunks": int(pass1.chunks),
+                    "pass1_wall_s": round(pass1.wall_s, 4),
+                }
+            else:
+                # streamed: two-pass host sketch -> device cuts merge (the
+                # SAME pmin/pmax/psum collective schedule as the
+                # materialized sketch program) -> chunked host binning with
+                # double-buffered upload. Rows are born binned; the raw f32
+                # matrix never exists.
+                self.cuts, self._feat_has_missing, cuts_np, sk_err = (
+                    stream_ingest.merged_cuts(self, pass1)
+                )
+                self._stream_cuts_np = cuts_np
+                self.bins, up_stats = stream_ingest.bin_upload_pass(
+                    self, streams, cuts_np,
+                    sketch_bytes=sum(
+                        sk.memory_bytes() for sk in pass1.sketches
+                    ),
+                )
+                self._stream_stats = {
+                    "chunks": int(pass1.chunks),
+                    "sketch_s": round(pass1.sketch_s, 4),
+                    "pass1_wall_s": round(pass1.wall_s, 4),
+                    "rank_error_bound_max": float(sk_err.max(initial=0.0)),
+                }
             for k, v in up_stats.items():
                 self._stream_stats[k] = (
                     round(v, 4) if isinstance(v, float) else v
                 )
+            # elastic-continuation metadata: what a FUTURE shrink/grow needs
+            # to seed its world from this engine (``plan_stream_reuse``) and
+            # what ``reset_from_booster`` verifies stream identity against
+            self._stream_fhm_np = np.asarray(self._feat_has_missing)
+            self._stream_shard_fps = [s.fingerprint() for s in streams]
+            self._stream_shard_rows = [s.n_rows for s in streams]
+            self._stream_cols = {
+                "label": pass1.label,
+                "weight": pass1.weight,
+                "base_margin": pass1.base_margin,
+                "label_lower_bound": pass1.lower,
+                "label_upper_bound": pass1.upper,
+            }
             # warm start has no raw rows to walk: route the init forest over
             # the binned matrix on device, BEFORE any feature-axis sharding
             if init_booster is not None and init_booster.num_trees:
@@ -852,16 +919,24 @@ class TpuEngine:
             return [stream_reader.materialize_shard(sh) for sh in shard_list]
         return shard_list
 
-    def _init_margins_from_bins(self, init_booster) -> jnp.ndarray:
+    def _init_margins_from_bins(
+        self, init_booster, fsharded: bool = False
+    ) -> jnp.ndarray:
         """Warm-start margin contribution of ``init_booster`` over a
         STREAMED load: walk the init forest against the binned device matrix
         (raw features never exist), routing on ``split_bin``.
 
         split_bin routing is only valid against the cuts the forest was
         grown with. Streamed cuts are deterministic in (data, chunking,
-        world), so restart-from-checkpoint on an unchanged world always
-        matches bitwise; any cut drift is gated loudly instead of silently
-        mis-routing every split.
+        world) — and FROZEN through elastic shrink/grow — so continuation
+        and restart on retained cuts always match bitwise; any cut drift is
+        gated loudly instead of silently mis-routing every split.
+
+        ``fsharded=True`` walks ``self.bins`` in its 2D ``[N/R, F_pad/C]``
+        tile layout (the ``reset_from_booster`` entry point, where the
+        feature sharding already happened) via the fsharded walk's
+        owner-broadcast bin columns; at ``__init__`` time the walk runs
+        pre-sharding over the full-F row layout.
         """
         booster_cuts = np.asarray(init_booster.cuts, np.float32)
         my_cuts = self._stream_cuts_np
@@ -895,12 +970,24 @@ class TpuEngine:
             (jnp.arange(t_cap) // tp) % k_out, k_out, dtype=jnp.float32
         )
 
+        fshard = None
+        if fsharded:
+            fshard = FeatureShard(
+                AXIS_FEATURES, self.feature_parallel, self._f_padded,
+                self.n_features,
+            )
+
         def fn(bins):
-            leaf = jax.vmap(
-                lambda tr: predict_tree_binned(
-                    tr, bins, depth, missing_bin, cat_features=cats
+            def walk(tr):
+                if fshard is None:
+                    return predict_tree_binned(
+                        tr, bins, depth, missing_bin, cat_features=cats
+                    )
+                return predict_tree_binned_fsharded(
+                    tr, bins, depth, missing_bin, fshard, cat_features=cats
                 )
-            )(forest_dev)  # [T, S]
+
+            leaf = jax.vmap(walk)(forest_dev)  # [T, S]
             return jnp.einsum(
                 "ts,tk->sk", leaf * w_dev[:, None], cls_onehot
             ) / tp
@@ -908,7 +995,9 @@ class TpuEngine:
         mapped = shard_map(
             fn,
             mesh=self.mesh,
-            in_specs=(P(AXIS_ACTORS),),
+            in_specs=(
+                P(AXIS_ACTORS, AXIS_FEATURES) if fsharded else P(AXIS_ACTORS),
+            ),
             out_specs=P(AXIS_ACTORS),
         )
         jit_fn = progreg.register_jit(
@@ -2001,22 +2090,20 @@ class TpuEngine:
     def can_reshard(self) -> bool:
         """Whether this engine supports the zero-replay re-shard path.
 
-        dart keeps a capacity-padded device forest sized to the ORIGINAL
-        total_rounds and recomputes margins from tree weights each round;
-        resetting that mid-flight is not supported — the driver falls back
-        to the restart-from-checkpoint path instead. A 2D row x feature
-        mesh (feature_parallel > 1) likewise falls back to the legacy
-        restart path: the elastic shrink/grow machinery reshapes the ROW
-        axis only, and re-laying feature tiles over a changed world is not
-        supported until 2D reshard lands (README "2D mesh sharding").
-        Streamed loads fall back too: a shrunken world re-streams and
-        re-sketches, producing new cuts the cached engine's binned matrix
-        cannot ride (README "Streaming ingestion", composition matrix)."""
-        return (
-            not self.dart
-            and self.feature_parallel == 1
-            and not self._streamed
-        )
+        True for EVERY gbtree configuration this engine can train: the 1D
+        row mesh (PR 5), 2D row x feature meshes (a shrink rebuilds the
+        mesh as ``(R', C)`` with feature tiles fixed; a grow-back into a
+        previously-compiled ``(R, C)`` world hits the driver's engine
+        cache), streamed matrices (survivor shards' binned blocks and
+        frozen cuts are reused in memory — no re-stream, no re-sketch; see
+        ``stream/ingest.py``'s reuse passes), and dart (the
+        capacity-padded device forest and tree weights rebuild from the
+        in-memory booster via ``reset_from_booster``; the per-round drop
+        RNG is a pure function of (seed, global round), so it needs no
+        carried state). gblinear remains the one restart-only booster —
+        ``LinearEngine`` has no ``can_reshard`` and the driver's probe
+        defaults to False."""
+        return True
 
     def reset_from_booster(self, shards, evals, init_booster) -> None:
         """Re-shard entry point: reuse this engine (compiled step programs,
@@ -2025,32 +2112,43 @@ class TpuEngine:
 
         The caller guarantees ``shards``/``evals`` hold the SAME rows this
         engine was built over (``shard_layout_fingerprint`` at the driver's
-        world cache; shapes re-checked here) — the device-resident data
-        never moves, only the margin state and forest bookkeeping are
-        re-derived from the booster. Cost: one host forest walk per data
-        set. No retrace, no re-bin, no re-sketch.
+        world cache; shapes — or stream identities — re-checked here): the
+        device-resident data never moves, only the margin state and forest
+        bookkeeping are re-derived from the booster. Cost: one forest walk
+        per data set — a host walk over raw rows for materialized loads, a
+        compiled binned-matrix walk (``stream.init_margins``, fsharded on
+        2D meshes) for streamed loads whose raw rows never existed. dart
+        additionally rebuilds its capacity-padded device forest + weights
+        from the booster inside the engine's compiled capacity. No round
+        program retraces, no re-bin, no re-sketch.
         """
-        if self.dart:
-            raise ValueError("reset_from_booster is not supported with dart")
-        if self.feature_parallel > 1:
-            raise ValueError(
-                "reset_from_booster is not supported with "
-                "feature_parallel > 1 (2D meshes use the legacy restart "
-                "path; see can_reshard)."
-            )
+        base_margin = None
+        x = None
         if self._streamed:
-            raise ValueError(
-                "reset_from_booster is not supported for streamed matrices "
-                "(the legacy restart path re-streams and warm starts via "
-                "the binned forest walk; see can_reshard)."
+            # streamed: raw rows never existed — verify stream identity
+            # (the same fingerprints the driver's cache matched on), then
+            # re-derive margins from the retained binned matrix below
+            from xgboost_ray_tpu.stream import reader as stream_reader
+
+            streams = stream_reader.shard_streams(shards)
+            if streams is None or [
+                s.fingerprint() for s in streams
+            ] != self._stream_shard_fps:
+                raise ValueError(
+                    "reshard: streamed shard identity changed; a fresh "
+                    "engine build is required."
+                )
+            base_margin = self._stream_cols.get("base_margin")
+        else:
+            x, _label, _weight, base_margin, _qid, _lo, _hi = _concat_shards(
+                shards
             )
-        x, _label, _weight, base_margin, _qid, _lo, _hi = _concat_shards(shards)
-        if x.shape[0] != self._local_rows or x.shape[1] != self.n_features:
-            raise ValueError(
-                f"reshard: shard layout changed ({x.shape} vs "
-                f"({self._local_rows}, {self.n_features})); a fresh engine "
-                f"build is required."
-            )
+            if x.shape[0] != self._local_rows or x.shape[1] != self.n_features:
+                raise ValueError(
+                    f"reshard: shard layout changed ({x.shape} vs "
+                    f"({self._local_rows}, {self.n_features})); a fresh "
+                    f"engine build is required."
+                )
         self._init_has_stats = (
             getattr(init_booster, "_has_node_stats", True)
             if init_booster is not None
@@ -2058,11 +2156,15 @@ class TpuEngine:
         )
         have_init = init_booster is not None and init_booster.num_trees
 
-        def margins_for(xv, bm):
-            ms = np.full((xv.shape[0], self.n_outputs), self.base_margin0,
+        def static_margins(n_rows, bm):
+            ms = np.full((n_rows, self.n_outputs), self.base_margin0,
                          np.float32)
             if bm is not None:
-                ms = ms + bm.reshape(xv.shape[0], -1).astype(np.float32)
+                ms = ms + bm.reshape(n_rows, -1).astype(np.float32)
+            return ms
+
+        def margins_for(xv, bm):
+            ms = static_margins(xv.shape[0], bm)
             if have_init:
                 ms = ms + (
                     init_booster.predict_margin_np(xv)
@@ -2079,7 +2181,30 @@ class TpuEngine:
                 if init_booster.tree_weights is not None
                 else np.ones(init_booster.num_trees, np.float32)
             )
-        self.margins = self._put_rows(margins_for(x, base_margin), np.float32)
+        if self.dart:
+            # margins are recomputed from the device forest at every dart
+            # step (static + weighted forest walk), so only the static part
+            # is staged here; the forest/weights rebuild below is the state
+            # the next step actually consumes
+            self.margins = self._put_rows(
+                static_margins(self._local_rows, base_margin), np.float32
+            )
+            self._reset_dart_state(init_booster)
+        elif self._streamed:
+            self.margins = self._put_rows(
+                static_margins(self._local_rows, base_margin), np.float32
+            )
+            if have_init:
+                # the PR 14 warm-start walk, gated on bitwise cut equality
+                # — which holds trivially here: the cuts are retained in
+                # memory and the booster was grown on this engine's cuts
+                self.margins = self.margins + self._init_margins_from_bins(
+                    init_booster, fsharded=self.feature_parallel > 1
+                )
+        else:
+            self.margins = self._put_rows(
+                margins_for(x, base_margin), np.float32
+            )
 
         from xgboost_ray_tpu.distributed import put_rows_global
 
@@ -2088,13 +2213,20 @@ class TpuEngine:
         for (eval_shards, _name), es in zip(evals, self.evals):
             if es.is_train:
                 continue
+            # eval sets are materialized by construction (streamed evals
+            # are gated at _add_eval_set), so the host walk always applies
             ex, _, _, ebm, _, _, _ = _concat_shards(eval_shards)
             if ex.shape[0] != es.local_rows:
                 raise ValueError(
                     f"reshard: eval set {es.name!r} layout changed"
                 )
             _, local_pad, _ = self._global_row_layout(ex.shape[0])
-            arr = margins_for(ex, ebm)
+            # dart recomputes eval margins from the device forest per step
+            # against margins_static, which is already device-resident
+            arr = (
+                static_margins(ex.shape[0], ebm) if self.dart
+                else margins_for(ex, ebm)
+            )
             if arr.shape[0] < local_pad:
                 arr = np.pad(arr, [(0, local_pad - arr.shape[0]), (0, 0)])
             es.margins = put_rows_global(arr, self._row_sharding)
@@ -2112,6 +2244,28 @@ class TpuEngine:
             init_booster.num_boosted_rounds() if init_booster is not None else 0
         )
 
+    def _reset_dart_state(self, init_booster) -> None:
+        """Rebuild dart's capacity-padded device forest, tree weights and
+        slot cursor from ``init_booster`` WITHOUT changing ``_dart_t_cap``
+        — the capacity is a static shape of the compiled dart step, so a
+        reset that resized it would force a retrace (and the cached
+        program would dispatch against stale shapes). The per-round drop
+        RNG carries no state: ``_dart_sample_drops`` is a pure function of
+        (seed, iteration_offset + round, weights), and both offset and
+        weights are restored here."""
+        n_init = (
+            init_booster.num_trees
+            if init_booster is not None and init_booster.num_trees
+            else 0
+        )
+        if n_init > self._dart_t_cap:
+            raise ValueError(
+                f"reshard: booster carries {n_init} trees but this dart "
+                f"engine's compiled forest capacity is {self._dart_t_cap}; "
+                f"a fresh engine build is required."
+            )
+        self._init_dart_forest(t_cap=self._dart_t_cap)
+
 
     # ------------------------------------------------------------------
     # DART (dropout) booster: per-round dropout over the forest built so
@@ -2122,11 +2276,15 @@ class TpuEngine:
     # weight-vector edit, not a cache invalidation problem.
     # ------------------------------------------------------------------
 
-    def _init_dart_forest(self):
+    def _init_dart_forest(self, t_cap: Optional[int] = None):
+        """Allocate (or, with an explicit ``t_cap``, re-fill at the pinned
+        compiled capacity — the ``reset_from_booster`` path) the
+        capacity-padded device forest from ``_init_trees``/weights."""
         k_out = self.n_outputs
         heap = self.cfg.heap_size
         n_init = self._init_trees[0].feature.shape[0] if self._init_trees else 0
-        t_cap = n_init + max(1, self._dart_total_rounds) * k_out
+        if t_cap is None:
+            t_cap = n_init + max(1, self._dart_total_rounds) * k_out
 
         def empty(dtype, fill):
             return np.full((t_cap, heap), fill, dtype)
@@ -2276,6 +2434,16 @@ class TpuEngine:
     def step_dart(self, iteration: int) -> Dict[str, Dict[str, float]]:
         params = self.params
         span_ts, span_t0 = time.time(), time.perf_counter()
+        if self.dart_t + self.n_outputs > self._dart_t_cap:
+            # the in-program dynamic_update_slice CLAMPS an out-of-range
+            # slot, which would silently overwrite the newest trees —
+            # unreachable under the driver's round arithmetic (capacity
+            # covers init + total_rounds, resets keep the invariant), so
+            # tripping it means a bookkeeping bug, not a user error
+            raise RuntimeError(
+                f"dart forest capacity exhausted: slot {self.dart_t} + "
+                f"{self.n_outputs} trees > t_cap {self._dart_t_cap}"
+            )
         if self._dart_fn is None:
             self._dart_fn = self._make_dart_step()
         lr = params.learning_rate

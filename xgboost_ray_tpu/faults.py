@@ -27,6 +27,11 @@ launcher.worker       ``_launcher_worker`` bootstrap              ``process_id,
                                                                   attempt``
 serve.predict         ``MicroBatcher._execute``                   ``kind, rows``
 registry.swap         ``ModelRegistry.load``                      ``version``
+stream.read_chunk     ``ShardStream.chunks`` (stream/reader.py)   ``chunk, rows``
+stream.h2d_upload     ``DoubleBufferedUploader.submit``           ``bytes`` (the
+                      (stream/upload.py)                          k-th occurrence
+                                                                  = k-th submit;
+                                                                  use ``at``)
 ====================  ==========================================  ==============
 
 Actions: ``raise`` (an exception — ``RayActorError`` when ``ranks`` is set),
@@ -77,6 +82,8 @@ SITES = (
     "launcher.worker",
     "serve.predict",
     "registry.swap",
+    "stream.read_chunk",
+    "stream.h2d_upload",
 )
 
 _ENV_PLAN = "RXGB_FAULT_PLAN"

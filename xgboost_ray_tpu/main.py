@@ -422,6 +422,17 @@ def _resolve_mesh_devices(num: int, ray_params: Optional["RayParams"]) -> list:
     return _select_mesh_devices(num, str(strategy).upper())
 
 
+def _engine_can_reshard(engine) -> bool:
+    """The ONE probe of an engine's zero-replay re-shard capability — every
+    elastic decision point (caching a world, gating the in-flight recover,
+    choosing boundary-grow vs the legacy ``RayXGBoostActorAvailable``
+    restart) routes through here so the gate semantics cannot drift per
+    call site. Engines without the method (``LinearEngine``/gblinear, or a
+    user-supplied engine) are restart-only."""
+    probe = getattr(engine, "can_reshard", None)
+    return bool(probe()) if probe is not None else False
+
+
 def _handle_queue(queue: Queue, checkpoint: _Checkpoint, callback_returns: Dict):
     """Drain the callback queue (mirror of ``main.py:902-922``)."""
     while not queue.empty():
@@ -735,12 +746,17 @@ def _train(
         validate_streaming_params(parsed)
     train_cats = dtrain.resolved_categories
 
-    def _build_world(world_actors, world_init):
+    def _build_world(world_actors, world_init, donor=None):
         """The one engine factory of this attempt: assemble the given
         actors' shards, translate eval-set categories, and build the engine
         — or revive a cached engine whose compiled programs cover exactly
         this world (shrink->grow cycles re-enter previously compiled world
-        sizes; see ``_TrainingState.engine_cache``)."""
+        sizes; see ``_TrainingState.engine_cache``). ``donor`` is the
+        engine being swapped out by an elastic shrink/grow: a STREAMED
+        donor seeds the new world's binned matrix and frozen cuts in
+        memory (no re-sketch, no re-stream of surviving shards — only a
+        grow-back onto a brand-new replacement shard re-streams, and only
+        that shard)."""
         from xgboost_ray_tpu.engine import shard_layout_fingerprint
 
         train_shards = [a.get_shard(dtrain) for a in world_actors]
@@ -807,6 +823,7 @@ def _train(
             feature_weights=dtrain.feature_weights,
             feature_types=dtrain.resolved_feature_types,
             categories=train_cats,
+            stream_donor=donor,
         )
         eng._world_key = key
         eng._shard_fingerprint = fp
@@ -814,7 +831,7 @@ def _train(
 
     def _cache_world(eng):
         key = getattr(eng, "_world_key", None)
-        if key is None or not getattr(eng, "can_reshard", lambda: False)():
+        if key is None or not _engine_can_reshard(eng):
             return
         state.engine_cache[key] = eng
         while len(state.engine_cache) > 2:
@@ -956,7 +973,7 @@ def _train(
         _rewire_actors(state)
         target = [a for a in state.actors if a is not None]
         try:
-            new_engine = _build_world(target, booster_now)
+            new_engine = _build_world(target, booster_now, donor=engine)
         except Exception as exc:  # noqa: BLE001 - fall back to restart
             raise RayXGBoostActorAvailable(
                 f"In-place reintegration failed ({exc}); restarting from "
@@ -986,7 +1003,7 @@ def _train(
         restart-from-checkpoint policy."""
         if not ray_params.elastic_training:
             return False
-        if not getattr(engine, "can_reshard", lambda: False)():
+        if not _engine_can_reshard(engine):
             return False
         if state.consecutive_failures >= 3:
             # repeated failures with no completed round in between: stop
@@ -1061,7 +1078,7 @@ def _train(
                 # actor): pure resume — no rebuild, no recompile
                 new_engine = engine
             else:
-                new_engine = _build_world(target, booster_now)
+                new_engine = _build_world(target, booster_now, donor=engine)
         except Exception as build_exc:  # noqa: BLE001 - fall back to restart
             logger.warning(
                 "[RayXGBoost] in-flight elastic %s failed (%s); falling "
@@ -1204,9 +1221,7 @@ def _train(
                 _schedule_replacements()
                 if elastic_mod._update_scheduled_actor_states(
                     state,
-                    raise_on_ready=not getattr(
-                        engine, "can_reshard", lambda: False
-                    )(),
+                    raise_on_ready=not _engine_can_reshard(engine),
                 ):
                     _grow_at_boundary()
             if time.time() - last_status > ENV.STATUS_FREQUENCY_S:
@@ -1331,9 +1346,7 @@ def _train(
                 _schedule_replacements()
                 if elastic_mod._update_scheduled_actor_states(
                     state,
-                    raise_on_ready=not getattr(
-                        engine, "can_reshard", lambda: False
-                    )(),
+                    raise_on_ready=not _engine_can_reshard(engine),
                 ):
                     _grow_at_boundary()
 

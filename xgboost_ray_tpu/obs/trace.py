@@ -66,6 +66,12 @@ TRACE_NAMES = frozenset({
     # span per sketch/bin chunk and per H2D transfer, one per cuts merge —
     # a streamed load is reconstructible from the timeline alone
     "data.sketch_chunk", "data.bin_chunk", "data.h2d", "data.cuts_merge",
+    # elastic continuation of a streamed world (stream/ingest.py): donor
+    # binned-row reuse — one summary event per reuse pass plus one fenced
+    # span per donor block fetch; a shrink that re-used every survivor
+    # shard shows bin_reuse spans and NO sketch_chunk/bin_chunk after the
+    # kill (the zero-re-stream contract, asserted from the timeline)
+    "data.bin_reuse",
     # driver lifecycle (main.py)
     "attempt", "failure.detected", "recovered", "backoff",
     "world.shrink", "world.grow", "world.resume", "world.restart",

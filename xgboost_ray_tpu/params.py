@@ -145,10 +145,15 @@ def validate_streaming_params(params: "TrainParams") -> None:
     ``hist_quant``/``hist_impl``/``hist_precision``, row sampling (uniform
     and GOSS compact binned rows), depthwise and lossguide growers,
     monotone/interaction constraints, dart, custom objectives, survival
-    bounds, and elastic training for SAME-WORLD restarts (failures take
-    the legacy restart-and-re-stream path — see ``TpuEngine.can_reshard``;
-    a permanently shrunken world re-sketches to different cuts and the
-    warm-start cut-drift gate raises instead of mis-routing split_bin).
+    bounds, and elastic training IN-FLIGHT (``TpuEngine.can_reshard`` is
+    True for streamed loads: a shrink reuses the survivors' binned blocks
+    and frozen cuts in memory — zero re-stream, zero re-sketch — and a
+    grow-back onto a brand-new replacement actor re-streams only that one
+    shard against the frozen cuts, budget-prevalidated; see
+    ``stream/ingest.py``'s reuse passes. The warm-start cut-drift gate
+    still guards CHECKPOINT resumes whose world or data changed — frozen
+    in-memory cuts pass it trivially, re-sketched different ones raise
+    instead of mis-routing split_bin).
 
     What does NOT compose is gated loudly here (the repo's
     no-silent-fallback invariant):

@@ -366,46 +366,37 @@ def _mesh_block_devices(engine) -> List[Tuple[Any, List[Any]]]:
     return [(row[0], list(row[1:])) for row in dev.tolist()]
 
 
-def bin_upload_pass(
+def _upload_blocks(
     engine,
-    streams: Sequence[ShardStream],
-    cuts_np: np.ndarray,
-    sketch_bytes: int = 0,
+    rows_iter,
+    num_features: int,
+    prefetch: int,
 ) -> Tuple[jnp.ndarray, Dict[str, float]]:
-    """Pass 2: re-stream chunks, bin each on the host straight into the
-    current device block's ``bin_dtype`` buffer, upload completed blocks
-    double-buffered, assemble the [pad_to, F] row-sharded device matrix.
+    """Shared block assembly of the streamed data plane: consume binned
+    ``[k, F]`` row batches arriving in GLOBAL row order, fill the per-actor
+    ``bin_dtype`` block buffers, upload completed blocks double-buffered,
+    and assemble the [pad_to, F] row-sharded device matrix.
 
     Rows arrive in global row order, so exactly ONE per-actor block buffer
     is being filled at any time; a completed block hands off to the
     background uploader (one H2D transfer per device block — the device
     holds exactly the final binned bytes, no concat/update churn) while the
-    next block's chunks bin on the main thread. Peak host memory:
-    O(chunk + prefetch·block_bytes), with block_bytes = per-actor rows x F
-    in bin_dtype (uint8/int16) — the "rows are born binned" buffer.
-
-    Returns (bins_global, stats). Tail padding rows bin to the missing
+    next batch is produced on the main thread. Peak host memory:
+    O(batch + prefetch·block_bytes). Tail padding rows bin to the missing
     bucket — exactly where the materialized path's NaN-padded rows land, so
     a streamed matrix is indistinguishable downstream.
+
+    Consumed by :func:`bin_upload_pass` (batches = freshly binned chunks)
+    and :func:`reuse_bin_pass` (batches = donor fetches + re-binned chunks
+    of the one replacement shard).
     """
     tracer = obs.get_tracer()
     max_bin = engine.params.max_bin
     dtype = binning.bin_dtype(max_bin)
-    num_features = cuts_np.shape[0]
     pad_to = engine.pad_to
     block = pad_to // engine.n_devices
     block_devices = _mesh_block_devices(engine)
-    prefetch = streams[0].config.prefetch
-    # the full budget check: now that the mesh layout is known, the
-    # N-scaling term (per-actor block buffers alive at once) is included
-    streams[0].config.validate_budget(
-        sum(s.n_rows for s in streams), num_features,
-        max(s.chunk_rows for s in streams), sketch_bytes,
-        block_rows=block, bin_itemsize=np.dtype(dtype).itemsize,
-    )
     uploader = DoubleBufferedUploader(depth=prefetch, tracer=tracer)
-    wall0 = time.perf_counter()
-    bin_s = 0.0
     cursor = 0
     buf: Optional[np.ndarray] = None  # the block being filled
 
@@ -429,16 +420,8 @@ def bin_upload_pass(
                 buf = None
 
     try:
-        for si, s in enumerate(streams):
-            for chunk in s.chunks():
-                x = np.asarray(chunk["data"], np.float32)
-                t0 = time.perf_counter()
-                with tracer.span(
-                    "data.bin_chunk", rows=int(x.shape[0]), shard=si
-                ):
-                    bins_chunk = binning.bin_matrix_np(x, cuts_np, max_bin)
-                bin_s += time.perf_counter() - t0
-                submit_rows(bins_chunk)
+        for rows in rows_iter:
+            submit_rows(np.asarray(rows, dtype))
         if cursor < pad_to:
             # padding tail: the partially-filled block buffer already holds
             # the missing bucket in its unwritten rows; flush block by block
@@ -470,9 +453,307 @@ def bin_upload_pass(
     bins_global = jax.make_array_from_single_device_arrays(
         shape, sharding, arrays
     )
-    stats = dict(uploader.stats())
+    return bins_global, dict(uploader.stats())
+
+
+def bin_upload_pass(
+    engine,
+    streams: Sequence[ShardStream],
+    cuts_np: np.ndarray,
+    sketch_bytes: int = 0,
+) -> Tuple[jnp.ndarray, Dict[str, float]]:
+    """Pass 2: re-stream chunks, bin each on the host straight into the
+    current device block's ``bin_dtype`` buffer, and assemble the device
+    matrix through :func:`_upload_blocks` (one block buffer filling while
+    the previous block's H2D transfer is in flight).
+
+    Returns (bins_global, stats).
+    """
+    tracer = obs.get_tracer()
+    max_bin = engine.params.max_bin
+    dtype = binning.bin_dtype(max_bin)
+    num_features = cuts_np.shape[0]
+    block = engine.pad_to // engine.n_devices
+    prefetch = streams[0].config.prefetch
+    # the full budget check: now that the mesh layout is known, the
+    # N-scaling term (per-actor block buffers alive at once) is included
+    streams[0].config.validate_budget(
+        sum(s.n_rows for s in streams), num_features,
+        max(s.chunk_rows for s in streams), sketch_bytes,
+        block_rows=block, bin_itemsize=np.dtype(dtype).itemsize,
+    )
+    wall0 = time.perf_counter()
+    bin_state = {"bin_s": 0.0}
+
+    def binned_chunks():
+        for si, s in enumerate(streams):
+            for chunk in s.chunks():
+                x = np.asarray(chunk["data"], np.float32)
+                t0 = time.perf_counter()
+                with tracer.span(
+                    "data.bin_chunk", rows=int(x.shape[0]), shard=si
+                ):
+                    bins_chunk = binning.bin_matrix_np(x, cuts_np, max_bin)
+                bin_state["bin_s"] += time.perf_counter() - t0
+                yield bins_chunk
+
+    bins_global, stats = _upload_blocks(
+        engine, binned_chunks(), num_features, prefetch
+    )
     stats.update({
-        "bin_s": bin_s,
+        "bin_s": bin_state["bin_s"],
         "pass2_wall_s": time.perf_counter() - wall0,
+    })
+    return bins_global, stats
+
+
+# ---------------------------------------------------------------------------
+# elastic continuation: seed a new world's binned matrix from a donor engine
+# (zero re-sketch, zero re-stream of surviving shards)
+# ---------------------------------------------------------------------------
+
+
+def plan_stream_reuse(
+    streams: Sequence[ShardStream], donor, max_bin: Optional[int] = None
+) -> Optional[List[Tuple]]:
+    """Map each of this load's shard streams onto ``donor``'s retained
+    binned rows (an elastic shrink/grow of a streamed world).
+
+    Returns a per-shard plan — ``("donor", lo, hi)`` for a shard whose
+    binned rows (and small columns) live in the donor engine at donor-global
+    rows [lo, hi), ``("stream", shard_stream)`` for a shard the donor never
+    streamed (a grow-back onto a NEW replacement actor: that one shard
+    re-streams and bins against the donor's FROZEN cuts) — or ``None`` when
+    the donor cannot seed this load at all (not streamed, different
+    feature count / binning, or no shard overlap), in which case the
+    caller falls through to the full sketch+bin pipeline.
+
+    Shard identity is the stream fingerprint (deterministic in source,
+    rank window, and chunking — the same identity the driver's engine
+    cache keys on), so a matching shard's binned rows are bitwise the rows
+    a re-stream would produce under the donor's cuts.
+    """
+    if donor is None or not getattr(donor, "_streamed", False):
+        return None
+    fps = getattr(donor, "_stream_shard_fps", None)
+    shard_rows = getattr(donor, "_stream_shard_rows", None)
+    cuts_np = getattr(donor, "_stream_cuts_np", None)
+    if not fps or not shard_rows or cuts_np is None:
+        return None
+    if any(s.n_features != donor.n_features for s in streams):
+        return None
+    if max_bin is not None and int(donor.params.max_bin) != int(max_bin):
+        # frozen cuts are only valid at the binning they were sketched for
+        # (unreachable from the elastic driver — params are fixed within a
+        # run — but a direct TpuEngine(stream_donor=) caller could differ)
+        return None
+    offsets = np.concatenate([[0], np.cumsum(shard_rows)])
+    by_fp = {fp: i for i, fp in enumerate(fps)}
+    plan: List[Tuple] = []
+    reused = 0
+    for s in streams:
+        i = by_fp.get(s.fingerprint())
+        if i is None:
+            plan.append(("stream", s))
+        else:
+            plan.append(("donor", int(offsets[i]), int(offsets[i + 1])))
+            reused += 1
+    if reused == 0:
+        return None
+    return plan
+
+
+def prevalidate_reuse_budget(
+    streams: Sequence[ShardStream],
+    plan: Sequence[Tuple],
+    block_rows: int,
+    bin_itemsize: int,
+) -> None:
+    """Budget fail-fast for the reuse path, callable BEFORE any byte of a
+    re-streamed replacement shard moves: the re-stream charges the same
+    chunk+binned+block model as the original ingest, with the donor-fetch
+    slice (one block of already-binned rows) standing in for the sketch
+    term. Zero-restream plans (a pure shrink) still validate the block
+    buffers — the uploader keeps them alive either way."""
+    if not streams:
+        return
+    n_features = streams[0].n_features
+    fetch_bytes = block_rows * n_features * bin_itemsize
+    n_rows = sum(s.n_rows for s in streams)
+    restreamed = [s for s, e in zip(streams, plan) if e[0] == "stream"]
+    for s in streams:
+        chunk = s.chunk_rows if s in restreamed else min(
+            s.chunk_rows, block_rows
+        )
+        s.config.validate_budget(
+            n_rows, n_features, chunk, fetch_bytes,
+            block_rows=block_rows, bin_itemsize=bin_itemsize,
+        )
+
+
+def reuse_columns_pass(
+    streams: Sequence[ShardStream],
+    plan: Sequence[Tuple],
+    donor,
+    max_bin: int,
+    cat_features: Sequence[int] = (),
+) -> PassOneResult:
+    """The reuse path's stand-in for :func:`sketch_pass`: small per-row
+    columns come from donor slices for reused shards, and from ONE chunk
+    iteration for re-streamed shards (no sketch is built — cuts are the
+    donor's frozen ones, which is the whole point). The re-streamed
+    shards' data chunks are read again by :func:`reuse_bin_pass` — a
+    deliberate tradeoff: binning here would have to buffer the whole
+    shard's binned rows on the host until the mesh layout exists (the
+    columns feed the engine's row layout BEFORE the bin assembly runs),
+    breaking the O(chunk + block) memory contract, so the one replacement
+    shard pays the same two-read cost the original ingest pays per shard
+    and host memory stays bounded."""
+    tracer = obs.get_tracer()
+    res = PassOneResult()
+    res.n_features = streams[0].n_features
+    binning.validate_feature_types_count(cat_features, res.n_features)
+    wall0 = time.perf_counter()
+    col_keys = ("label", "weight", "base_margin",
+                "label_lower_bound", "label_upper_bound")
+    donor_cols = getattr(donor, "_stream_cols", None) or {}
+    # per-column, per-shard chunk lists in _concat_optional's shape: a
+    # donor-sourced shard contributes its slice as one "chunk", so the
+    # merge below rides the SAME fill/concat contract sketch_pass uses
+    cols: Dict[str, List[List[Optional[np.ndarray]]]] = {
+        k: [] for k in col_keys
+    }
+    for s, entry in zip(streams, plan):
+        if entry[0] == "donor":
+            _, lo, hi = entry
+            for k in col_keys:
+                col = donor_cols.get(k)
+                cols[k].append([None if col is None else col[lo:hi]])
+            res.shard_rows.append(hi - lo)
+            continue
+        shard_cols: Dict[str, List[Optional[np.ndarray]]] = {
+            k: [] for k in col_keys
+        }
+        rows = 0
+        for chunk in s.chunks():
+            if chunk.get("qid") is not None:
+                raise NotImplementedError(
+                    "streamed ingestion does not support qid/ranking data"
+                )
+            x = np.asarray(chunk["data"], np.float32)
+            binning.validate_categorical_codes(x, cat_features, max_bin)
+            for k in col_keys:
+                shard_cols[k].append(chunk.get(k))
+            rows += x.shape[0]
+            res.chunks += 1
+        if rows != s.n_rows:
+            raise ValueError(
+                f"stream produced {rows} rows but declared {s.n_rows}"
+            )
+        res.shard_rows.append(rows)
+        for k in col_keys:
+            cols[k].append(shard_cols[k])
+    res.n_rows = sum(res.shard_rows)
+    fills = SHARD_COLUMN_FILLS
+    res.label = _concat_optional(
+        cols["label"], res.shard_rows, fill=fills["label"]
+    )
+    res.weight = _concat_optional(
+        cols["weight"], res.shard_rows, fill=fills["weight"]
+    )
+    res.base_margin = _concat_optional(
+        cols["base_margin"], res.shard_rows, fill=fills["base_margin"]
+    )
+    res.lower = _concat_optional(
+        cols["label_lower_bound"], res.shard_rows,
+        fill=fills["label_lower_bound"],
+    )
+    res.upper = _concat_optional(
+        cols["label_upper_bound"], res.shard_rows,
+        fill=fills["label_upper_bound"],
+    )
+    res.wall_s = time.perf_counter() - wall0
+    tracer.event(
+        "data.bin_reuse",
+        attrs={
+            "rows": int(res.n_rows),
+            "reused_shards": sum(1 for e in plan if e[0] == "donor"),
+            "restreamed_shards": sum(1 for e in plan if e[0] == "stream"),
+        },
+    )
+    return res
+
+
+def reuse_bin_pass(
+    engine,
+    streams: Sequence[ShardStream],
+    plan: Sequence[Tuple],
+    donor,
+    cuts_np: np.ndarray,
+) -> Tuple[jnp.ndarray, Dict[str, float]]:
+    """Assemble the new world's [pad_to, F] binned device matrix without
+    re-sketching and without re-streaming surviving shards.
+
+    Donor-resident shards are fetched from the donor's DEVICE binned
+    matrix in block-sized slices (already-binned bytes — no raw f32 ever
+    exists, and peak host stays O(block)); a shard the donor never held
+    (grow-back onto a new replacement actor) re-streams and bins against
+    the donor's frozen cuts, prevalidated against the budget model before
+    its first byte streams. Everything rides the same double-buffered
+    uploader as the original ingest."""
+    tracer = obs.get_tracer()
+    max_bin = engine.params.max_bin
+    dtype = binning.bin_dtype(max_bin)
+    num_features = int(cuts_np.shape[0])
+    block = engine.pad_to // engine.n_devices
+    prefetch = streams[0].config.prefetch
+    itemsize = np.dtype(dtype).itemsize
+    # defensive re-check of the engine's up-front reuse prevalidation (the
+    # mesh layout is authoritative here)
+    prevalidate_reuse_budget(
+        streams, plan, block_rows=block, bin_itemsize=itemsize
+    )
+    wall0 = time.perf_counter()
+    state = {"bin_s": 0.0, "reused_rows": 0, "restreamed_rows": 0}
+    donor_bins = donor.bins
+    donor_f_real = donor.n_features  # donor tiles may be feature-padded
+
+    def batches():
+        for si, (s, entry) in enumerate(zip(streams, plan)):
+            if entry[0] == "donor":
+                _, lo, hi = entry
+                for a in range(lo, hi, block):
+                    b = min(a + block, hi)
+                    with tracer.span(
+                        "data.bin_reuse", rows=int(b - a), shard=si
+                    ):
+                        # device gather + one host read of binned bytes;
+                        # slice away feature padding when the donor ran a
+                        # 2D (feature-sharded) mesh
+                        rows = np.asarray(donor_bins[a:b])[:, :donor_f_real]
+                    state["reused_rows"] += b - a
+                    yield rows
+                continue
+            for chunk in s.chunks():
+                x = np.asarray(chunk["data"], np.float32)
+                t0 = time.perf_counter()
+                with tracer.span(
+                    "data.bin_chunk", rows=int(x.shape[0]), shard=si
+                ):
+                    bins_chunk = binning.bin_matrix_np(x, cuts_np, max_bin)
+                state["bin_s"] += time.perf_counter() - t0
+                state["restreamed_rows"] += x.shape[0]
+                yield bins_chunk
+
+    bins_global, stats = _upload_blocks(
+        engine, batches(), num_features, prefetch
+    )
+    stats.update({
+        "bin_s": state["bin_s"],
+        "pass2_wall_s": time.perf_counter() - wall0,
+        "reused_rows": state["reused_rows"],
+        "restreamed_rows": state["restreamed_rows"],
+        "reused_shards": sum(1 for e in plan if e[0] == "donor"),
+        "restreamed_shards": sum(1 for e in plan if e[0] == "stream"),
     })
     return bins_global, stats

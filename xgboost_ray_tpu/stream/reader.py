@@ -27,6 +27,8 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tupl
 
 import numpy as np
 
+from xgboost_ray_tpu import faults
+
 _MB = 1024 * 1024
 
 #: fraction of the host budget the raw f32 chunk may occupy; the remainder
@@ -179,6 +181,15 @@ class ShardStream:
         from row 0 — the two-pass pipeline reads the stream twice)."""
         for lo in range(0, self.n_rows, self.chunk_rows):
             hi = min(lo + self.chunk_rows, self.n_rows)
+            # chaos site: a scheduled raise/delay here models a failing or
+            # straggling chunk source (disk, object store) at an exact,
+            # reproducible chunk index — the streaming plane's analog of
+            # actor.load_shard
+            faults.fire(
+                "stream.read_chunk",
+                chunk=lo // self.chunk_rows,
+                rows=hi - lo,
+            )
             fields = self._chunk_fn(lo, hi)
             data = fields.get("data")
             if data is None or data.shape[0] != hi - lo:

@@ -22,6 +22,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
+from xgboost_ray_tpu import faults
+
 
 def _device_transfer(array, device):
     """Default transfer: committed device_put, fenced so the recorded span
@@ -66,6 +68,16 @@ class DoubleBufferedUploader:
     def submit(self, key, array, device) -> None:
         """Queue one transfer; blocks while ``depth`` uploads are already
         queued or in flight (the double-buffer backpressure)."""
+        # chaos site: fired on the SUBMITTING (training) thread, before the
+        # hand-off (and before the lock — a plan-injected delay must model a
+        # stalled H2D pipe, not wedge the worker out of the condition), so
+        # an injected raise surfaces exactly where a real upload failure
+        # does (drain() re-raises worker errors there too). The k-th
+        # occurrence IS the k-th submitted transfer.
+        faults.fire(
+            "stream.h2d_upload",
+            bytes=int(getattr(array, "nbytes", 0)),
+        )
         with self._cond:
             while (
                 len(self._pending) + self._inflight >= self.depth
