@@ -110,6 +110,15 @@ LOW_PRECISION_LOGLOSS_TOL = 5e-4
 # steady-round budget: int8 gh may cost at most this factor of f32 per round
 LOW_PRECISION_ROUND_TIME_MAX = 1.05
 
+# vectorized HPO: one vmapped-K=4 program vs 4 sequential trials of the same
+# configs. cost_ratio = vmapped total wall / sequential total wall — the
+# gate is the shipping contract (the lane axis exists to amortize compile
+# and per-round dispatch across candidates, so the packed program must cost
+# well under the sum of its lanes), and the >20% tripwire guards
+# cross-snapshot drift of the ratio itself.
+HPO_COST_RATIO_GATE = 0.6
+HPO_TRIPWIRE_RATIO = 1.2
+
 
 def _load_latest_bench_record(bench_dir):
     """Newest BENCH_*.json result dict (by round number, then mtime).
@@ -1268,6 +1277,159 @@ def run_wide_feature_ablation(actors=8):
     return out
 
 
+def hpo_cost_ratio_tripwire(current_hpo, prev_rec=None, prev_name=None,
+                            backend=None, gate=HPO_COST_RATIO_GATE,
+                            threshold=HPO_TRIPWIRE_RATIO):
+    """Check the vmapped-K-vs-sequential HPO pairing against its gate.
+
+    Like ``obs_overhead_tripwire``, the tracked figure (``cost_ratio`` =
+    vmapped-K=4 total wall over 4 sequential trials) is a within-run
+    pairing, so the tripwire fires on the CURRENT run's own gate violation
+    (cost_ratio >= HPO_COST_RATIO_GATE) — no prior snapshot needed. When
+    the newest recorded bench carries a comparable ``hpo`` section (same
+    backend, same config), the >20% cross-snapshot drift check applies on
+    top. Returns ``{cost_ratio, gate, fired, ...}`` or ``None`` when the
+    current section has no ratio (an arm failed to measure)."""
+    if not isinstance(current_hpo, dict):
+        return None
+    cur = current_hpo.get("cost_ratio")
+    if not cur:
+        return None
+    out = {
+        "cost_ratio": round(float(cur), 4),
+        "gate": gate,
+        "fired": False,
+    }
+    prev_hpo = prev_rec.get("hpo") if isinstance(prev_rec, dict) else None
+    if isinstance(prev_hpo, dict) and prev_hpo.get("cost_ratio"):
+        if backend and prev_rec.get("backend") \
+                and prev_rec["backend"] != backend:
+            prev_hpo = None
+        elif prev_hpo.get("config") != current_hpo.get("config"):
+            out["config_mismatch"] = True
+            prev_hpo = None
+    if isinstance(prev_hpo, dict) and prev_hpo.get("cost_ratio"):
+        out["prev_cost_ratio"] = round(float(prev_hpo["cost_ratio"]), 4)
+        out["prev_record"] = prev_name
+        ratio = float(cur) / float(prev_hpo["cost_ratio"])
+        out["ratio"] = round(ratio, 3)
+        if ratio > threshold:
+            out["fired"] = True
+            print(
+                f"[bench] HPO TRIPWIRE: vmapped-K cost ratio {cur:.3f} is "
+                f"{ratio:.2f}x the newest recorded run "
+                f"({float(prev_hpo['cost_ratio']):.3f} in "
+                f"{prev_name or 'BENCH_*.json'}) — "
+                f">{(threshold - 1) * 100:.0f}% regression of the packed-"
+                f"program win.",
+                file=sys.stderr,
+            )
+    if float(cur) >= gate:
+        out["fired"] = True
+        print(
+            f"[bench] HPO GATE: vmapped-K=4 total wall is {float(cur):.3f}x "
+            f"the 4 sequential trials — over the {gate}x gate. The packed "
+            f"program is no longer amortizing compile/dispatch across "
+            f"lanes; investigate before trusting vectorized sweeps.",
+            file=sys.stderr,
+        )
+    return out
+
+
+def run_hpo_ablation(x, y, base_params, actors):
+    """Paired HPO measurement: 4 sequential trials vs one vmapped-K=4 run.
+
+    Both arms train the SAME four candidate configs (the protocol params
+    with eta swept over 4 values) on the same data. The sequential arm is
+    the status-quo sweep — one engine per trial, each paying its own
+    compile and dispatching its own per-round program. The vmapped arm
+    packs all four candidates as lanes of ONE ``engine.step_vmapped``
+    program (``enable_lanes`` on a ``vectorize_params`` pack): one compile,
+    one dispatch per round, collectives per-lane-batched. Headline figures:
+    trials-per-hour for each arm and ``cost_ratio`` (vmapped wall over
+    sequential wall), gated at HPO_COST_RATIO_GATE. The section also
+    asserts lane parity: each lane's final train logloss must match its
+    sequential twin to 1e-5 (same data, same per-lane params, masks not
+    engaged — the lanes ARE the sequential runs, batched)."""
+    from xgboost_ray_tpu.engine import TpuEngine
+    from xgboost_ray_tpu.params import parse_params, vectorize_params
+
+    k = 4
+    rounds = int(os.environ.get("BENCH_HPO_ROUNDS", "8"))
+    rows = min(int(x.shape[0]), int(os.environ.get("BENCH_HPO_ROWS", "50000")))
+    hx, hy = x[:rows], y[:rows]
+    shards = [{"data": hx, "label": hy}]
+    evals = [(shards, "train")]
+    etas = (0.3, 0.2, 0.1, 0.05)
+    configs = []
+    for eta in etas:
+        cfg = dict(base_params)
+        cfg["learning_rate"] = eta
+        cfg.pop("eta", None)
+        configs.append(cfg)
+
+    def _final_logloss(res):
+        return float(res["train"]["logloss"])
+
+    seq_start = time.time()
+    seq_ll = []
+    for cfg in configs:
+        eng = TpuEngine(shards, parse_params(cfg), num_actors=actors,
+                        evals=evals)
+        for it in range(rounds):
+            res = eng.step(it)
+        seq_ll.append(_final_logloss(res))
+        del eng
+    seq_time = time.time() - seq_start
+
+    vm_start = time.time()
+    lp = vectorize_params(configs)
+    veng = TpuEngine(shards, lp.base, num_actors=actors, evals=evals)
+    veng.enable_lanes(lp)
+    for it in range(rounds):
+        vres = veng.step_vmapped(it)
+    vm_ll = [_final_logloss(r) for r in vres]
+    vm_time = time.time() - vm_start
+
+    ll_delta = max(abs(a - b) for a, b in zip(seq_ll, vm_ll))
+    cost_ratio = vm_time / seq_time if seq_time else None
+    out = {
+        "k": k,
+        "rounds": rounds,
+        "sequential": {
+            "total_s": round(seq_time, 2),
+            "trials_per_hour": round(k / (seq_time / 3600.0), 1),
+            "compiles": k,
+        },
+        "vmapped": {
+            "total_s": round(vm_time, 2),
+            "trials_per_hour": round(k / (vm_time / 3600.0), 1),
+            "compiles": 1,
+        },
+        "cost_ratio": round(cost_ratio, 4) if cost_ratio else None,
+        "gate": HPO_COST_RATIO_GATE,
+        "gate_ok": bool(cost_ratio is not None
+                        and cost_ratio < HPO_COST_RATIO_GATE),
+        # parity judged on the unrounded values (see wide-feature ablation)
+        "logloss_max_delta": round(ll_delta, 7),
+        "logloss_parity_ok": ll_delta <= 1e-5,
+        "config": {
+            "rows": rows, "features": int(x.shape[1]), "rounds": rounds,
+            "actors": actors, "k": k, "etas": list(etas),
+            "max_depth": int(base_params.get("max_depth", 6)),
+        },
+    }
+    if not out["logloss_parity_ok"]:
+        print(
+            f"[bench] HPO LANE PARITY broken: max per-lane final-logloss "
+            f"delta vmapped-vs-sequential is {out['logloss_max_delta']} "
+            f"(> 1e-5).",
+            file=sys.stderr,
+        )
+    print(f"[bench] hpo ablation: {out}", file=sys.stderr)
+    return out
+
+
 def r4_paired_recheck(detail):
     """Close the r4->r5 "52% CPU-bench regression" open item with DATA.
 
@@ -2266,6 +2428,20 @@ def run_measurement():
             if wtrip is not None:
                 wide_section["regression_tripwire"] = wtrip
             detail["wide_feature"] = wide_section
+
+    # vectorized-HPO pairing: 4 sequential trials vs one vmapped-K=4
+    # program (engine.step_vmapped) on the same data — trials-per-hour for
+    # each arm, the cost_ratio gate, and the >20% drift tripwire. Default
+    # on for the CPU mesh; opt-in on TPU via BENCH_HPO=1.
+    hpo_env = os.environ.get("BENCH_HPO")
+    if hpo_env == "1" or (hpo_env is None and not on_tpu):
+        hpo_section = run_hpo_ablation(x, y, params, actors)
+        htrip = hpo_cost_ratio_tripwire(
+            hpo_section, prev_rec, prev_name, backend=backend
+        )
+        if htrip is not None:
+            hpo_section["regression_tripwire"] = htrip
+        detail["hpo"] = hpo_section
 
     # per-phase round-cost breakdown (sample/hist/split/partition/margin),
     # consumed from the runtime trace — shows WHERE sampling saves. Default

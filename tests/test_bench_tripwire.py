@@ -733,3 +733,102 @@ def test_streaming_tripwire_skips_incomparable_records():
         cur, rec_none, "x", backend="cpu") is None
     assert bench.streaming_ingest_tripwire(None, rec_tpu, "x") is None
     assert bench.streaming_ingest_tripwire({}, rec_tpu, "x") is None
+
+
+# ---------------------------------------------------------------------------
+# vectorized-HPO cost-ratio tripwire
+# ---------------------------------------------------------------------------
+
+_HPO_CFG = {
+    "rows": 50000, "features": 28, "rounds": 8, "actors": 8, "k": 4,
+    "etas": [0.3, 0.2, 0.1, 0.05], "max_depth": 6,
+}
+
+
+def _hpo_section(cost_ratio, cfg=None):
+    return {
+        "k": 4,
+        "rounds": 8,
+        "sequential": {"total_s": 100.0, "trials_per_hour": 144.0,
+                       "compiles": 4},
+        "vmapped": {"total_s": 100.0 * cost_ratio,
+                    "trials_per_hour": 144.0 / cost_ratio, "compiles": 1},
+        "cost_ratio": cost_ratio,
+        "gate": bench.HPO_COST_RATIO_GATE,
+        "gate_ok": cost_ratio < bench.HPO_COST_RATIO_GATE,
+        "logloss_max_delta": 0.0,
+        "logloss_parity_ok": True,
+        "config": dict(cfg if cfg is not None else _HPO_CFG),
+    }
+
+
+def test_hpo_tripwire_fires_on_gate_violation(capsys):
+    """The 0.6x gate is absolute: a packed program costing >= 0.6x the
+    sequential sweep fires on the current run's own pairing, prior
+    snapshot or not — the lane axis exists to amortize compile/dispatch,
+    and a ratio at parity means it amortizes nothing."""
+    out = bench.hpo_cost_ratio_tripwire(_hpo_section(0.75))
+    assert out is not None and out["fired"]
+    assert out["cost_ratio"] == 0.75
+    assert out["gate"] == bench.HPO_COST_RATIO_GATE
+    assert "HPO GATE" in capsys.readouterr().err
+
+
+def test_hpo_tripwire_quiet_under_gate(capsys):
+    out = bench.hpo_cost_ratio_tripwire(_hpo_section(0.5))
+    assert out is not None and not out["fired"]
+    err = capsys.readouterr().err
+    assert "HPO GATE" not in err and "HPO TRIPWIRE" not in err
+
+
+def test_hpo_tripwire_fires_on_cross_snapshot_drift(capsys):
+    """Under the gate but >20% worse than the newest snapshot still fires:
+    the drift half guards the packed-program win from eroding one PR at a
+    time."""
+    rec = {"metric": "m", "backend": "cpu", "hpo": _hpo_section(0.4)}
+    out = bench.hpo_cost_ratio_tripwire(
+        _hpo_section(0.55), rec, "BENCH_r15.json", backend="cpu"
+    )
+    assert out is not None and out["fired"]
+    assert out["prev_cost_ratio"] == 0.4
+    assert out["prev_record"] == "BENCH_r15.json"
+    assert out["ratio"] == round(0.55 / 0.4, 3)
+    assert "HPO TRIPWIRE" in capsys.readouterr().err
+
+
+def test_hpo_tripwire_quiet_within_20pct_drift(capsys):
+    rec = {"metric": "m", "backend": "cpu", "hpo": _hpo_section(0.5)}
+    out = bench.hpo_cost_ratio_tripwire(
+        _hpo_section(0.55), rec, "BENCH_r15.json", backend="cpu"
+    )
+    assert out is not None and not out["fired"]
+    assert out["prev_cost_ratio"] == 0.5
+    assert "HPO TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_hpo_tripwire_reports_but_never_fires_on_config_mismatch(capsys):
+    other = dict(_HPO_CFG, rows=1000)
+    rec = {"metric": "m", "backend": "cpu", "hpo": _hpo_section(0.3, other)}
+    out = bench.hpo_cost_ratio_tripwire(
+        _hpo_section(0.5), rec, "x", backend="cpu"
+    )
+    assert out is not None and not out["fired"]
+    assert out["config_mismatch"] is True
+    assert "prev_cost_ratio" not in out
+    assert "HPO TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_hpo_tripwire_skips_incomparable_records_gate_still_runs(capsys):
+    # cross-backend prev dropped, but the within-run gate check still runs
+    rec_tpu = {"metric": "m", "backend": "tpu", "hpo": _hpo_section(0.3)}
+    out = bench.hpo_cost_ratio_tripwire(
+        _hpo_section(0.7), rec_tpu, "x", backend="cpu"
+    )
+    assert out["fired"] and "prev_cost_ratio" not in out
+    assert "HPO GATE" in capsys.readouterr().err
+
+
+def test_hpo_tripwire_none_without_current_ratio():
+    assert bench.hpo_cost_ratio_tripwire(None) is None
+    assert bench.hpo_cost_ratio_tripwire({}) is None
+    assert bench.hpo_cost_ratio_tripwire({"k": 4}) is None

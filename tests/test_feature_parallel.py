@@ -249,6 +249,10 @@ def test_default_schedules_match_pre_refactor_golden():
             # (integer-wire) program; the pre-PR golden pins the DEFAULT
             # float32 path only — which must stay byte-equal
             continue
+        if t.record.meta.get("k"):
+            # vmapped-K HPO rows are lane-batched programs that postdate the
+            # golden; the un-laned default path is still pinned below
+            continue
         key = "%s@world=%s@hq=%s" % (
             t.record.name, t.record.meta.get("world"),
             t.record.meta.get("hist_quant"),

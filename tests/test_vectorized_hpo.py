@@ -1,0 +1,335 @@
+"""Vectorized HPO: vmapped-K lane parity, ASHA equivalence, and guards.
+
+The contract under test (ISSUE 16): K boosters trained as lanes of ONE
+``engine.step_vmapped`` program must be *the same boosters* the sequential
+path would have produced — bitwise when the lane params equal the program
+statics (no masks engaged), <= 1e-5 on eval metrics when depth/subsample
+masks are engaged — and ASHA pruning over the packed lanes must make the
+same decisions as ASHA over sequential trials.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from xgboost_ray_tpu.engine import TpuEngine
+from xgboost_ray_tpu.params import parse_params, vectorize_params
+
+
+def _data(rows=256, feats=6, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(rows, feats).astype(np.float32)
+    y = (x[:, 0] + 0.3 * rng.rand(rows) > 0.6).astype(np.float32)
+    return x, y
+
+
+def _shards(x, y):
+    return [{"data": x, "label": y}]
+
+
+_BASE = {
+    "objective": "binary:logistic",
+    "eval_metric": ["logloss"],
+    "max_depth": 3,
+    "seed": 7,
+}
+
+
+def _sequential_run(shards, cfg, rounds, actors=8):
+    eng = TpuEngine(shards, parse_params(cfg), num_actors=actors,
+                    evals=[(shards, "train")])
+    history = []
+    for it in range(rounds):
+        res = eng.step(it)
+        history.append(float(res["train"]["logloss"]))
+    return history, eng.get_booster()
+
+
+# ---------------------------------------------------------------------------
+# lane-by-lane parity vs sequential
+# ---------------------------------------------------------------------------
+
+
+def test_vmapped_lane_parity_bitwise_unmasked():
+    """eta/lambda-only lanes engage no masks: every lane's round program is
+    the exact FP-op sequence of its sequential twin, so metrics AND final
+    booster predictions must match bitwise, lane by lane."""
+    x, y = _data()
+    shards = _shards(x, y)
+    rounds = 3
+    configs = [
+        dict(_BASE, eta=0.3),
+        dict(_BASE, eta=0.1, reg_lambda=2.0),
+        dict(_BASE, eta=0.05, reg_alpha=0.5, min_child_weight=2.0),
+    ]
+    lp = vectorize_params(configs)
+    eng = TpuEngine(shards, lp.base, num_actors=8,
+                    evals=[(shards, "train")])
+    eng.enable_lanes(lp)
+    vm_hist = [[] for _ in configs]
+    for it in range(rounds):
+        for lane, res in enumerate(eng.step_vmapped(it)):
+            vm_hist[lane].append(float(res["train"]["logloss"]))
+    for lane, cfg in enumerate(configs):
+        seq_hist, seq_booster = _sequential_run(shards, cfg, rounds)
+        assert vm_hist[lane] == seq_hist, f"lane {lane} metric drift"
+        lane_booster = eng.get_booster_lane(lane)
+        np.testing.assert_array_equal(
+            lane_booster.predict(x), seq_booster.predict(x),
+            err_msg=f"lane {lane} forest drift",
+        )
+
+
+def test_vmapped_lane_parity_masked_depth_subsample():
+    """A lane at reduced depth + subsample rides the level/budget masks:
+    metric parity within 1e-5 of its sequential twin (mask arithmetic vs
+    the sequential program's natural shapes), while the full-depth lane
+    stays bitwise."""
+    x, y = _data(seed=1)
+    shards = _shards(x, y)
+    rounds = 3
+    configs = [
+        dict(_BASE, eta=0.3),
+        dict(_BASE, eta=0.1, max_depth=2, subsample=0.8),
+    ]
+    lp = vectorize_params(configs)
+    assert lp.base.max_depth == 3 and float(lp.base.subsample) == 1.0
+    eng = TpuEngine(shards, lp.base, num_actors=8,
+                    evals=[(shards, "train")])
+    eng.enable_lanes(lp)
+    vm_hist = [[] for _ in configs]
+    for it in range(rounds):
+        for lane, res in enumerate(eng.step_vmapped(it)):
+            vm_hist[lane].append(float(res["train"]["logloss"]))
+    seq0, _ = _sequential_run(shards, configs[0], rounds)
+    assert vm_hist[0] == seq0, "full-depth lane must stay bitwise"
+    seq1, _ = _sequential_run(shards, configs[1], rounds)
+    np.testing.assert_allclose(vm_hist[1], seq1, rtol=0, atol=1e-5)
+
+
+def test_repack_then_continue_matches_sequential():
+    """Pruning lanes mid-training must not perturb the survivors: after a
+    repack the continuing lane's rounds still match its sequential twin."""
+    x, y = _data(seed=2)
+    shards = _shards(x, y)
+    configs = [dict(_BASE, eta=0.3), dict(_BASE, eta=0.1)]
+    lp = vectorize_params(configs)
+    eng = TpuEngine(shards, lp.base, num_actors=8,
+                    evals=[(shards, "train")])
+    eng.enable_lanes(lp)
+    hist1 = []
+    for it in range(2):
+        res = eng.step_vmapped(it)
+        hist1.append(float(res[1]["train"]["logloss"]))
+    eng.repack_lanes([1])
+    assert eng.lane_ids() == [1]
+    for it in range(2, 4):
+        res = eng.step_vmapped(it)
+        hist1.append(float(res[0]["train"]["logloss"]))
+    seq1, seq_booster = _sequential_run(shards, configs[1], 4)
+    assert hist1 == seq1
+    np.testing.assert_array_equal(
+        eng.get_booster_lane(0).predict(x), seq_booster.predict(x)
+    )
+
+
+# ---------------------------------------------------------------------------
+# validation: never silently train a wrong lane
+# ---------------------------------------------------------------------------
+
+
+def test_vectorize_params_names_offending_key():
+    with pytest.raises(NotImplementedError, match="'max_bin'"):
+        vectorize_params([dict(_BASE), dict(_BASE, max_bin=64)])
+    with pytest.raises(NotImplementedError, match="'grow_policy'"):
+        vectorize_params([
+            dict(_BASE),
+            dict(_BASE, grow_policy="lossguide", max_leaves=8),
+        ])
+
+
+def test_vectorize_params_lossguide_depth_and_goss_subsample():
+    lg = dict(_BASE, grow_policy="lossguide", max_leaves=8)
+    with pytest.raises(NotImplementedError, match="max_depth"):
+        vectorize_params([dict(lg, max_depth=3), dict(lg, max_depth=2)])
+    goss = dict(_BASE, sampling_method="gradient_based", subsample=0.5)
+    with pytest.raises(NotImplementedError, match="subsample"):
+        vectorize_params([goss, dict(goss, subsample=0.3)])
+    with pytest.raises(NotImplementedError, match="booster"):
+        vectorize_params([dict(_BASE, booster="dart")] * 2)
+
+
+def test_enable_lanes_mode_guards():
+    x, y = _data(seed=3)
+    shards = _shards(x, y)
+    lp = vectorize_params([dict(_BASE, eta=0.3), dict(_BASE, eta=0.1)])
+    eng = TpuEngine(shards, lp.base, num_actors=8)
+    eng.enable_lanes(lp)
+    with pytest.raises(RuntimeError, match="step_vmapped"):
+        eng.step(0)
+    with pytest.raises(RuntimeError, match="step_vmapped"):
+        eng.step_many(0, 2)
+    with pytest.raises(RuntimeError, match="get_booster_lane"):
+        eng.get_booster()
+    with pytest.raises(RuntimeError, match="already"):
+        eng.enable_lanes(lp)
+    # a non-fresh engine cannot be re-armed as a pack
+    eng2 = TpuEngine(shards, parse_params(dict(_BASE)), num_actors=8)
+    eng2.step(0)
+    with pytest.raises(RuntimeError, match="fresh"):
+        eng2.enable_lanes(lp)
+
+
+def test_reset_lanes_requires_sliced_pack_and_reuses_programs():
+    x, y = _data(seed=4)
+    shards = _shards(x, y)
+    configs = [dict(_BASE, eta=0.3), dict(_BASE, eta=0.1)]
+    lp = vectorize_params(configs)
+    eng = TpuEngine(shards, lp.base, num_actors=8,
+                    evals=[(shards, "train")])
+    eng.enable_lanes(lp)
+    first = float(eng.step_vmapped(0)[0]["train"]["logloss"])
+    fns_before = dict(eng._vk_fns)
+    # a foreign pack (different base statics) must be rejected, not traced
+    other = vectorize_params([dict(_BASE, eta=0.3, max_bin=64)])
+    with pytest.raises(ValueError, match="base"):
+        eng.reset_lanes(other)
+    # a sliced pack from the engine's own group resets WITHOUT recompiling
+    lp0 = dataclasses.replace(lp, lanes=(lp.lanes[0],))
+    eng.reset_lanes(lp0)
+    again = float(eng.step_vmapped(0)[0]["train"]["logloss"])
+    assert again == first, "reset lane 0 must replay round 0 bitwise"
+    eng.reset_lanes(lp)
+    assert float(eng.step_vmapped(0)[0]["train"]["logloss"]) == first
+    for k, fn in fns_before.items():
+        assert eng._vk_fns.get(k) is fn, "reset_lanes recompiled a program"
+
+
+# ---------------------------------------------------------------------------
+# ASHA decision equivalence + trace timeline
+# ---------------------------------------------------------------------------
+
+
+def _asha_space_and_trainable(shards, rounds):
+    from xgboost_ray_tpu.tuner import VectorizedTrainable, grid_search
+
+    space = dict(_BASE, eta=grid_search([0.5, 0.3, 0.1, 0.02]))
+    spec = VectorizedTrainable(shards=shards, num_actors=8,
+                               num_boost_round=rounds)
+    return space, spec
+
+
+def test_asha_pruning_decision_equivalence():
+    """The vectorized Tuner's ASHA decisions (which trials stop, at which
+    round) must equal ASHA over fully sequential trials: within a rung the
+    pack reports in trial order — the same arrival order per rung as the
+    sequential sweep — and the lane metrics are the sequential metrics."""
+    from xgboost_ray_tpu.tuner import ASHAScheduler, Tuner
+
+    x, y = _data(seed=5)
+    shards = _shards(x, y)
+    rounds = 6
+    space, spec = _asha_space_and_trainable(shards, rounds)
+    etas = [0.5, 0.3, 0.1, 0.02]
+
+    # sequential reference: each trial trains alone, reporting every round
+    # to its own fresh ASHA instance in trial order
+    seq_sched = ASHAScheduler("train-logloss", mode="min",
+                              grace_rounds=2, eta=2)
+    seq_stop = {}
+    for j, eta in enumerate(etas):
+        eng = TpuEngine(shards, parse_params(dict(_BASE, eta=eta)),
+                        num_actors=8, evals=[(shards, "train")])
+        for it in range(rounds):
+            res = eng.step(it)
+            flat = {"train-logloss": float(res["train"]["logloss"]),
+                    "training_iteration": it + 1}
+            if seq_sched.on_report(f"trial_{j}", it + 1, flat):
+                seq_stop[j] = it + 1
+                break
+
+    tuner = Tuner(
+        spec, space, metric="train-logloss", mode="min",
+        scheduler=ASHAScheduler("train-logloss", mode="min",
+                                grace_rounds=2, eta=2),
+    )
+    res = tuner.fit()
+    assert len(res.trials) == len(etas)
+    vm_stop = {
+        j: len(t.results)
+        for j, t in enumerate(res.trials) if t.stopped_early
+    }
+    assert vm_stop == seq_stop
+    # at least one lane must actually have been pruned for this test to
+    # exercise the repack path at all
+    assert seq_stop, "ASHA never pruned: test configuration is degenerate"
+    best_j = min(
+        range(len(etas)),
+        key=lambda j: res.trials[j].last_result["train-logloss"]
+        if j not in seq_stop else float("inf"),
+    )
+    assert res.best_config["eta"] == etas[best_j]
+
+
+def test_hpo_trace_events_timeline():
+    """hpo.lane_prune / hpo.repack are catalogued trace events, and on a
+    pruning run the timeline shows every prune for a round preceding the
+    repack that commits it (prune events carry the trial/lane/round, the
+    repack carries k_before/k_after)."""
+    from xgboost_ray_tpu import obs
+    from xgboost_ray_tpu.obs.trace import TRACE_NAMES
+    from xgboost_ray_tpu.tuner import ASHAScheduler, Tuner
+
+    assert "hpo.lane_prune" in TRACE_NAMES
+    assert "hpo.repack" in TRACE_NAMES
+    x, y = _data(seed=6)
+    shards = _shards(x, y)
+    space, spec = _asha_space_and_trainable(shards, rounds=6)
+    tracer = obs.Tracer(enabled=True)
+    with obs.use_tracer(tracer):
+        Tuner(
+            spec, space, metric="train-logloss", mode="min",
+            scheduler=ASHAScheduler("train-logloss", mode="min",
+                                    grace_rounds=2, eta=2),
+        ).fit()
+    recs = [r for r in tracer.records()
+            if r["name"].startswith("hpo.")]
+    assert recs, "no hpo.* events on a pruning run"
+    prunes = [r for r in recs if r["name"] == "hpo.lane_prune"]
+    repacks = [r for r in recs if r["name"] == "hpo.repack"]
+    assert prunes and repacks
+    for ev in prunes:
+        assert {"trial", "lane", "round", "metric"} <= set(ev["attrs"])
+    for ev in repacks:
+        a = ev["attrs"]
+        assert a["k_before"] > a["k_after"] >= 1
+        # every prune for this round was emitted before its repack
+        same_round = [p for p in prunes
+                      if p["attrs"]["round"] == a["round"]]
+        assert same_round
+        assert all(p["seq"] < ev["seq"] for p in same_round)
+        assert a["k_before"] - a["k_after"] == len(same_round)
+
+
+def test_sequential_group_dedupe_shares_compile():
+    """vectorized=False routes a lane-compatible trial group through ONE
+    K=1 engine: trial 0 compiles, later trials reset_lanes into the same
+    program — and the results still match per-trial sequential training."""
+    from xgboost_ray_tpu.tuner import Tuner, VectorizedTrainable, grid_search
+
+    x, y = _data(seed=7)
+    shards = _shards(x, y)
+    rounds = 3
+    space = dict(_BASE, eta=grid_search([0.3, 0.1]))
+    spec = VectorizedTrainable(shards=shards, num_actors=8,
+                               num_boost_round=rounds, vectorized=False)
+    tuner = Tuner(spec, space, metric="train-logloss", mode="min")
+    res = tuner.fit()
+    assert len(tuner.engine_cache) == 1
+    (eng,) = tuner.engine_cache.values()
+    assert list(eng._vk_fns) == [1], "group shares one K=1 program"
+    for t, eta in zip(res.trials, [0.3, 0.1]):
+        seq_hist, _ = _sequential_run(shards, dict(_BASE, eta=eta), rounds)
+        got = [r["train-logloss"] for r in t.results]
+        assert got == seq_hist, f"dedupe drifted trial eta={eta}"

@@ -51,6 +51,7 @@ VERIFY_RULES: Dict[str, str] = {
 #: steps that embed quantized_hist_allreduce)
 _HIST_QUANT_PROGRAMS = (
     "engine.step", "engine.step_custom", "engine.step_many", "engine.step_dart",
+    "engine.step_vmapped",
 )
 
 _NARROW = {"int8": "int8", "int16": "int16"}

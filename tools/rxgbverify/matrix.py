@@ -39,6 +39,11 @@ class MatrixEntry:
     # like "world" and certifies the streamed schedule identical to the
     # materialized row's.
     streamed: bool = False
+    # vmapped-K HPO lanes: lanes > 0 enables the lane axis on the engine
+    # (enable_lanes with an eta-varying pack) so the registered round program
+    # is engine.step_vmapped with meta k=lanes. VER001 keys groups on k, so
+    # each K certifies its own cross-world schedule identity.
+    lanes: int = 0
 
 
 #: the full CI matrix: grower x hist_quant(none/int8/int16) x sampling x
@@ -152,6 +157,25 @@ FULL_MATRIX: Tuple[MatrixEntry, ...] = (
         "depthwise-streamed-int8gh", {"gh_precision": "int8"}, (4,),
         streamed=True,
     ),
+    # vmapped-K HPO lanes (engine.step_vmapped): K boosters in one program.
+    # ``k`` registers as a program-meta coordinate, so VER001 groups each K
+    # separately and certifies the per-lane-batched collective schedule
+    # (every collective's rank is +1, the schedule itself is unchanged)
+    # identical across coexisting worlds. Lanes vary eta per slot — the
+    # lane-vectorizable axis — while the program statics stay shared.
+    MatrixEntry("depthwise-k2", {}, (2, 4), lanes=2),
+    MatrixEntry("depthwise-k4", {}, (4,), lanes=4),
+    MatrixEntry(
+        "lossguide-k2",
+        {"grow_policy": "lossguide", "max_leaves": 8},
+        (2,), lanes=2,
+    ),
+    MatrixEntry(
+        # composition: quantized gh plane under the lane vmap — VER004's
+        # narrow-aval and int32-accumulation checks apply to the batched
+        # [K, ...] histogram wire unchanged
+        "depthwise-k2-int8gh", {"gh_precision": "int8"}, (4,), lanes=2,
+    ),
 )
 
 #: tier-1 test subset: the two keystone rows (plain + quantized) at two
@@ -171,6 +195,9 @@ QUICK_MATRIX: Tuple[MatrixEntry, ...] = (
     # streamed world's collective schedule (round steps AND the sketch
     # merge) is identical to the materialized depthwise-f32 rows above
     MatrixEntry("depthwise-streamed", {}, (2, 4), streamed=True),
+    # vmapped-K lanes at the keystone config: certifies the lane-batched
+    # schedule (engine.step_vmapped, meta k=2) across worlds in the fast tier
+    MatrixEntry("depthwise-k2", {}, (2, 4), lanes=2),
 )
 
 _GBLINEAR_WORLDS = (2, 4)
@@ -230,7 +257,19 @@ def trace_matrix(
                     )]
                 else:
                     entry_shards = shards
-                eng = TpuEngine(entry_shards, params, num_actors=world)
+                if entry.lanes:
+                    from xgboost_ray_tpu.params import vectorize_params
+
+                    etas = (0.3, 0.1, 0.05, 0.025)[:entry.lanes]
+                    lp = vectorize_params([
+                        {**_BASE_PARAMS, **entry.overrides,
+                         "learning_rate": eta}
+                        for eta in etas
+                    ])
+                    eng = TpuEngine(entry_shards, lp.base, num_actors=world)
+                    eng.enable_lanes(lp)
+                else:
+                    eng = TpuEngine(entry_shards, params, num_actors=world)
                 eng.build_programs()
                 engines.append(eng)
         if not quick:

@@ -25,6 +25,7 @@ reference's split between actor hot loop and driver control flow.
 """
 
 import contextlib
+import dataclasses
 import logging
 import os
 import time
@@ -63,7 +64,12 @@ from xgboost_ray_tpu.ops.grow import (
     predict_tree_binned_fsharded,
     sample_feature_mask,
 )
-from xgboost_ray_tpu.ops.provider import FeatureShard, default_hist_impl
+from xgboost_ray_tpu.ops.provider import (
+    FeatureShard,
+    default_hist_impl,
+    resolve_hist_provider,
+    vmapped_k_impl,
+)
 from xgboost_ray_tpu.ops import sampling
 from xgboost_ray_tpu.ops.metrics import (
     compute_metric,
@@ -80,7 +86,7 @@ from xgboost_ray_tpu.ops.objectives import (
 from xgboost_ray_tpu.ops.ranking import RankingObjective, build_group_rows
 from xgboost_ray_tpu.ops import predict as predict_ops
 from xgboost_ray_tpu.ops.split import SplitParams
-from xgboost_ray_tpu.params import TrainParams
+from xgboost_ray_tpu.params import LaneParams, TrainParams
 
 logger = logging.getLogger(__name__)
 
@@ -781,6 +787,10 @@ class TpuEngine:
         self._step_fn_custom = None
         self._scan_fn = None
         self._dart_fn = None
+        # vmapped-K HPO state (enable_lanes): 0 means scalar mode — every
+        # existing path traces the exact pre-lanes program
+        self._vk = 0
+        self._vk_spec_override = None
         # programs that have dispatched at least once: RXGB_STRICT's
         # transfer guard only arms for warm (non-compiling) dispatches
         self._warm_programs: set = set()
@@ -1211,6 +1221,13 @@ class TpuEngine:
         # traces the exact pre-sampling program, so default params stay
         # bit-identical to builds that predate the compaction machinery
         samp_spec = sampling.spec_from_params(params)
+        if samp_spec is None and \
+                getattr(self, "_vk_spec_override", None) is not None:
+            # vmapped-K where max(lane subsample) == 1.0 but some lane
+            # samples: the base params alone say "sampling off", yet the
+            # lanes need the budget-mask machinery — trace the full-budget
+            # uniform spec and let per-lane budgets cut it down
+            samp_spec = self._vk_spec_override
 
         # quantize_gh's int32-overflow bound: the global padded row count
         # (trace-time constant; padding rows carry exactly-zero gh but the
@@ -1218,13 +1235,37 @@ class TpuEngine:
         gh_max_rows = int(self.pad_to)
 
         def tree_round(bins, valid, label, weight, margins, group_rows, gh_in,
-                       rng, bounds, eval_bins, eval_margins):
+                       rng, bounds, eval_bins, eval_margins, lane=None):
             """One boosting round; gh_in is None unless a custom objective
             supplied precomputed gradients. Also returns the round's
-            measured tree-path allreduce payload bytes (AllreduceBytes)."""
+            measured tree-path allreduce payload bytes (AllreduceBytes).
+
+            ``lane`` (vmapped-K only) is a dict of TRACED per-lane scalars:
+            the lane-vectorizable split params, plus optionally
+            ``depth_limit`` (level mask) and ``budget`` (sampling slot
+            mask). ``None`` traces the exact scalar program."""
             # fresh per trace: counts the ring-model wire bytes of every
             # tree-path allreduce (histograms + small exact reductions)
             counter = AllreduceBytes(n_actors)
+            cfg_t = cfg
+            depth_limit = lane_budget = None
+            if lane is not None:
+                # the growers consume SplitParams arithmetically, so a
+                # tracer-carrying replace works; max_delta_step stays the
+                # static base value (leaf_weight branches on it in Python)
+                cfg_t = dataclasses.replace(
+                    cfg,
+                    split=dataclasses.replace(
+                        cfg.split,
+                        learning_rate=lane["learning_rate"],
+                        reg_lambda=lane["reg_lambda"],
+                        reg_alpha=lane["reg_alpha"],
+                        gamma=lane["gamma"],
+                        min_child_weight=lane["min_child_weight"],
+                    ),
+                )
+                depth_limit = lane.get("depth_limit")
+                lane_budget = lane.get("budget")
             tree_psum = counting_psum(AXIS_ACTORS, counter)
             fshard = None
             counter_f = None
@@ -1311,7 +1352,8 @@ class TpuEngine:
                             jax.lax.axis_index(AXIS_ACTORS),
                         )
                         rows_sel, ghk = sampling.sample_rows(
-                            ghk, valid, skey, samp_spec, scale=ghk_scale
+                            ghk, valid, skey, samp_spec, scale=ghk_scale,
+                            lane_budget=lane_budget,
                         )
                         bins_t = bins[rows_sel]
                     fmask = None
@@ -1336,7 +1378,8 @@ class TpuEngine:
                         bins_t,
                         ghk,
                         cuts_grow,
-                        cfg,
+                        cfg_t,
+                        depth_limit=depth_limit,
                         feature_mask=fmask,
                         level_rng=key if need_level_rng else None,
                         colsample_bylevel=params.colsample_bylevel,
@@ -1440,12 +1483,15 @@ class TpuEngine:
         return tuple(out)
 
     def _eval_arr_specs(self) -> tuple:
+        # vmapped-K: eval margins carry a leading (replicated) lane axis;
+        # every other eval member is lane-shared
+        m_spec = P(None, AXIS_ACTORS) if self._vk else P(AXIS_ACTORS)
         specs = []
         for es in self.evals:
             if es.is_train:
                 continue
             specs.append(_EvalArrs(
-                self._bins_spec(), P(AXIS_ACTORS), P(AXIS_ACTORS), P(AXIS_ACTORS), P(AXIS_ACTORS),
+                self._bins_spec(), P(AXIS_ACTORS), P(AXIS_ACTORS), P(AXIS_ACTORS), m_spec,
                 P(AXIS_ACTORS) if es.group_rows_dev is not None else P(),
                 P(AXIS_ACTORS) if es.margins_static is not None else P(),
                 (P(AXIS_ACTORS), P(AXIS_ACTORS)) if es.bounds_dev is not None else P(),
@@ -1461,10 +1507,13 @@ class TpuEngine:
         cross-world schedule-identity check compares records that agree on
         everything here except ``world``."""
         samp = sampling.spec_from_params(self.params)
+        if samp is None and \
+                getattr(self, "_vk_spec_override", None) is not None:
+            samp = self._vk_spec_override
         # derived from params, not self.dart: the sketch program registers
         # during __init__ before the dart attribute exists
         is_dart = self.params.booster == "dart"
-        return {
+        meta = {
             "world": int(self.n_devices),
             "grower": "dart" if is_dart else self.params.grow_policy,
             "hist_quant": self.cfg.hist_quant,
@@ -1492,6 +1541,13 @@ class TpuEngine:
             "ingest": "streamed" if getattr(self, "_streamed", False)
             else "materialized",
         }
+        if getattr(self, "_vk", 0):
+            # candidate-lane extent: a K-lane program's collectives carry a
+            # leading lane axis (rank grows by one, schedule identical), so
+            # K is a program-shape coordinate — k=2 and k=4 must not share
+            # a cross-world identity group
+            meta["k"] = int(self._vk)
+        return meta
 
     def _default_group_rows(self):
         """The ``group_rows`` dispatch argument (scalar sentinel when the
@@ -1548,6 +1604,10 @@ class TpuEngine:
         dispatch (without compiling or executing any of them — ``jax.jit``
         is lazy). Under :func:`progreg.capture` this is how the verifier
         populates the registry for a config without running a round."""
+        if self._vk:
+            if self._vk not in self._vk_fns:
+                self._vk_fns[self._vk] = self._make_vmapped_step(self._vk)
+            return
         if self.dart:
             if self._dart_fn is None:
                 self._dart_fn = self._make_dart_step()
@@ -1709,6 +1769,10 @@ class TpuEngine:
         size (the driver uses ENV.SCAN_MAX_CHUNK, clamped to checkpoint
         boundaries) to avoid recompiles.
         """
+        if self._vk:
+            raise RuntimeError(
+                "engine is in vmapped-K mode; use step_vmapped()"
+            )
         if not self.can_batch_rounds():
             raise RuntimeError("host-side metrics require per-round stepping")
         span_ts, span_t0 = time.time(), time.perf_counter()
@@ -1794,6 +1858,10 @@ class TpuEngine:
 
     def step(self, iteration: int, gh_custom=None) -> Dict[str, Dict[str, float]]:
         """Run one boosting round; returns {eval_name: {metric: value}}."""
+        if self._vk:
+            raise RuntimeError(
+                "engine is in vmapped-K mode; use step_vmapped()"
+            )
         if self.dart:
             if gh_custom is not None:
                 raise ValueError("custom objectives are not supported with dart")
@@ -2061,6 +2129,10 @@ class TpuEngine:
         )
 
     def get_booster(self) -> RayXGBoostBooster:
+        if self._vk:
+            raise RuntimeError(
+                "engine is in vmapped-K mode; use get_booster_lane(lane)"
+            )
         forest = self._stacked_forest()
         tree_weights = None
         if self.dart:
@@ -2073,6 +2145,487 @@ class TpuEngine:
             feature_names=self.feature_names,
             feature_types=self.feature_types,
             tree_weights=tree_weights,
+        )
+        booster._has_node_stats = self._init_has_stats
+        booster.categories = self.categories
+        return booster
+
+    # ------------------------------------------------------------------
+    # Vmapped-K HPO: train K candidate boosters in ONE XLA program.
+    #
+    # ``enable_lanes`` switches a freshly-built engine into lane mode: the
+    # whole boosting round (objective -> sampling -> histogram build ->
+    # allreduce -> split election -> partition) is vmapped over a leading
+    # candidate axis on the SAME binned data, with each lane's params
+    # carried as traced scalars. Collectives batch under vmap — every
+    # psum/pmax payload gains a leading K axis but the schedule (count,
+    # order, reduction op) is identical to the scalar program, which is
+    # exactly the property rxgbverify's VER001 certifies via the ``k``
+    # program-meta coordinate. One compile covers all K candidates; ASHA
+    # pruning re-packs survivors into a smaller K' program (one more
+    # compile per distinct K', cached in ``_vk_fns``).
+    # ------------------------------------------------------------------
+
+    def enable_lanes(
+        self, lane_params: LaneParams, *, force_masks: bool = False
+    ) -> None:
+        """Switch this engine into vmapped-K mode for ``lane_params.k``
+        candidate lanes. The engine must have been constructed with
+        ``lane_params.base`` (the trace-shape config: max depth, max
+        subsample rate) and must be fresh — no rounds stepped yet.
+
+        ``force_masks`` traces the per-lane depth and subsample planes even
+        when this pack's lanes don't vary them — the sequential-HPO dedupe
+        mode: a later ``reset_lanes`` pack may then vary depth/subsample
+        (within the base caps) without retracing.
+
+        Raises ``NotImplementedError`` for configurations whose round
+        program cannot ride a lane axis; never silently degrades a lane.
+        """
+        if self._vk:
+            raise RuntimeError("lanes already enabled on this engine")
+        if self.trees or self._trees_dev or self.iteration_offset:
+            raise RuntimeError(
+                "enable_lanes requires a fresh engine (no boosted rounds)"
+            )
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "vmapped-K HPO is single-process only (the multi-host "
+                "dispatch path does not carry the lane axis)"
+            )
+        if self.dart:
+            raise NotImplementedError(
+                "booster='dart' is not supported on the vmapped-K path"
+            )
+        if self._streamed:
+            raise NotImplementedError(
+                "streamed ingestion is not supported on the vmapped-K "
+                "path; materialize the matrix for vectorized HPO"
+            )
+        if self.feature_parallel > 1:
+            raise NotImplementedError(
+                "feature_parallel > 1 is not supported on the vmapped-K "
+                "path (2D-mesh programs are per-trial compiles)"
+            )
+        if self._host_metrics:
+            raise NotImplementedError(
+                "host-side eval metrics "
+                f"({', '.join(self._host_metrics)}) need per-round host "
+                "margins and cannot ride the vmapped-K path; use device "
+                "metrics (or sequential trials)"
+            )
+        if self._init_trees:
+            raise NotImplementedError(
+                "warm-starting from an init booster is not supported on "
+                "the vmapped-K path (lanes share no forest)"
+            )
+        lanes = lane_params.lanes
+        k = lane_params.k
+        lane_depth_max = max(p.max_depth for p in lanes)
+        if lane_depth_max > self.cfg.max_depth or (
+            lane_depth_max != self.cfg.max_depth and not force_masks
+        ):
+            # without the depth plane the program's level count IS the lane
+            # depth; with force_masks any depth <= the traced cap is fine
+            raise ValueError(
+                "engine was not built with lane_params.base: lane depths "
+                f"{[p.max_depth for p in lanes]} vs cfg.max_depth="
+                f"{self.cfg.max_depth}"
+            )
+        # histogram-provider seam: the lane build must go through an
+        # order-free provider (presorted-row-order providers carry state
+        # the lane axis cannot batch) — route cfg.hist_impl through the
+        # registry's vmapped_k wrapper, which validates and delegates
+        base_impl = self.cfg.hist_impl
+        prov = resolve_hist_provider(
+            base_impl, self.cfg.hist_precision, self.cfg.hist_chunk
+        )
+        if prov.wants_order:
+            if self.params.hist_impl == "auto":
+                # auto resolves per backend; under lanes the order-free
+                # scatter build is the auto choice
+                base_impl = "scatter"
+            else:
+                raise NotImplementedError(
+                    f"hist_impl {self.params.hist_impl!r} maintains a "
+                    f"presorted row order and cannot back the vmapped-K "
+                    f"build; use hist_impl='auto' or an order-free "
+                    f"implementation (scatter, onehot)"
+                )
+        self.cfg = dataclasses.replace(
+            self.cfg, hist_impl=vmapped_k_impl(base_impl)
+        )
+        # per-lane param planes: f32 split params always; depth/budget
+        # masks only when they actually vary (uniform lanes keep the
+        # scalar program's exact arithmetic — the bitwise-parity contract)
+        # or when force_masks pre-arms them for later reset_lanes packs
+        planes = ["learning_rate", "reg_lambda", "reg_alpha", "gamma",
+                  "min_child_weight"]
+        if lane_params.depth_varied or force_masks:
+            planes.append("depth_limit")
+        if lane_params.subsample_varied or force_masks:
+            planes.append("budget")
+            if sampling.spec_from_params(self.params) is None:
+                # base (max) rate is 1.0 yet some lane samples (or
+                # force_masks pre-arms sampling): trace the full-budget
+                # uniform machinery and let lane budgets mask
+                self._vk_spec_override = sampling.SamplingSpec(
+                    "uniform", rate=1.0
+                )
+        self._vk_plane_names = tuple(planes)
+        arrs = self._vk_build_planes(lanes)
+        # K-stack the margin state: [K, rows, n_outputs], lane axis
+        # replicated across the mesh, row axis sharded as before. The
+        # pristine pre-stack margins are stashed so reset_lanes can re-arm
+        # the engine for a fresh pack without rebuilding.
+        self._vk_sharding = NamedSharding(self.mesh, P(None, AXIS_ACTORS))
+        self._vk_margins0 = np.asarray(self.margins)
+        self._vk_eval_margins0 = [
+            np.asarray(es.margins) for es in self.evals if not es.is_train
+        ]
+        self.margins = self._vk_stack(self._vk_margins0, k)
+        ei = 0
+        for es in self.evals:
+            if not es.is_train:
+                es.margins = self._vk_stack(self._vk_eval_margins0[ei], k)
+                ei += 1
+        self._vk = k
+        self._vk_lane_params = list(lanes)
+        self._vk_lane_ids = list(range(k))
+        self._vk_seeds = [int(p.seed) for p in lanes]
+        self._vk_lane_np = arrs
+        self._vk_lane_arrays = {
+            name: jnp.asarray(v) for name, v in arrs.items()
+        }
+        self._vk_fns: Dict[int, Any] = {}
+        self._vk_trees: List[List[Tree]] = [[] for _ in range(k)]
+        self._vk_trees_dev: List[Tree] = []
+        self._obs_round_attrs = dict(self._obs_round_attrs, k=k)
+
+    def _vk_stack(self, arr_np: np.ndarray, k: int):
+        return jax.device_put(
+            np.broadcast_to(arr_np, (k,) + arr_np.shape).copy(),
+            self._vk_sharding,
+        )
+
+    def _vk_build_planes(self, lanes) -> Dict[str, np.ndarray]:
+        """The per-lane param planes of ``self._vk_plane_names`` for a lane
+        pack (shared by enable_lanes / reset_lanes / repack slicing)."""
+        arrs: Dict[str, np.ndarray] = {
+            "learning_rate": np.array(
+                [p.learning_rate for p in lanes], np.float32
+            ),
+            "reg_lambda": np.array([p.reg_lambda for p in lanes], np.float32),
+            "reg_alpha": np.array([p.reg_alpha for p in lanes], np.float32),
+            "gamma": np.array([p.gamma for p in lanes], np.float32),
+            "min_child_weight": np.array(
+                [p.min_child_weight for p in lanes], np.float32
+            ),
+        }
+        if "depth_limit" in self._vk_plane_names:
+            arrs["depth_limit"] = np.array(
+                [p.max_depth for p in lanes], np.int32
+            )
+        if "budget" in self._vk_plane_names:
+            block = self.pad_to // self.n_devices
+            arrs["budget"] = np.array(
+                [
+                    sampling.row_budget(
+                        block,
+                        sampling.SamplingSpec(
+                            "uniform", rate=float(p.subsample)
+                        ),
+                    )
+                    for p in lanes
+                ],
+                np.int32,
+            )
+        return arrs
+
+    def reset_lanes(self, lane_params: LaneParams) -> None:
+        """Re-arm a lane-enabled engine for a fresh candidate pack WITHOUT
+        retracing: margin state rewinds to the pristine pre-training
+        margins, per-lane planes and seeds are replaced, and the compiled
+        K-lane programs in ``_vk_fns`` are reused when the pack's K was
+        dispatched before (a new K compiles lazily).
+
+        This is the sequential-HPO compile-dedupe primitive: the Tuner
+        routes same-shaped trials through ONE engine, resetting between
+        trials, so trials differing only in lane-vectorizable params share
+        a single compile. The pack must be sliced from the SAME group pack
+        the engine was built with (``lane_params.base == self.params``) so
+        every static coordinate — padded shapes, max depth cap, max
+        subsample budget — is already covered by the traced program.
+        """
+        if not self._vk:
+            raise RuntimeError("enable_lanes() first")
+        if lane_params.base != self.params:
+            raise ValueError(
+                "reset_lanes pack was built against different base params; "
+                "slice the pack from the engine's own group LaneParams"
+            )
+        lanes = lane_params.lanes
+        k = lane_params.k
+        if "depth_limit" not in self._vk_plane_names and any(
+            p.max_depth != self.cfg.max_depth for p in lanes
+        ):
+            raise NotImplementedError(
+                "param 'max_depth' varies in this pack but the engine's "
+                "lane programs traced no depth plane; enable_lanes with "
+                "force_masks=True to pre-arm it"
+            )
+        if "budget" not in self._vk_plane_names and any(
+            float(p.subsample) != float(self.params.subsample) for p in lanes
+        ):
+            raise NotImplementedError(
+                "param 'subsample' varies in this pack but the engine's "
+                "lane programs traced no budget plane; enable_lanes with "
+                "force_masks=True to pre-arm it"
+            )
+        self._vk_trees_dev.clear()
+        self.margins = self._vk_stack(self._vk_margins0, k)
+        ei = 0
+        for es in self.evals:
+            if not es.is_train:
+                es.margins = self._vk_stack(self._vk_eval_margins0[ei], k)
+                ei += 1
+        self._vk = k
+        self._vk_lane_params = list(lanes)
+        self._vk_lane_ids = list(range(k))
+        self._vk_seeds = [int(p.seed) for p in lanes]
+        self._vk_lane_np = self._vk_build_planes(lanes)
+        self._vk_lane_arrays = {
+            name: jnp.asarray(v) for name, v in self._vk_lane_np.items()
+        }
+        self._vk_trees = [[] for _ in range(k)]
+        self._obs_round_attrs = dict(self._obs_round_attrs, k=k)
+
+    def _make_vmapped_step(self, k: int):
+        """The K-lane round program: ``jax.vmap`` of the shared round body
+        over the lane axis, inside one shard_map. Per-round collectives
+        stay per-lane-batched — payload rank grows by one, the collective
+        schedule is identical to the scalar step."""
+        tree_round, metric_contribs = self._round_closures()
+
+        def step(bins, valid, label, weight, margins_k, group_rows,
+                 lane_arrs, rngs, bounds, eval_data):
+            eval_bins = tuple(d.bins for d in eval_data)
+            eval_margins_k = tuple(d.margins for d in eval_data)
+
+            def one_lane(margins, eval_margins, lane, rng):
+                new_margins, new_eval_margins, forest, ar_bytes = tree_round(
+                    bins, valid, label, weight, margins, group_rows, None,
+                    rng, bounds, eval_bins, eval_margins, lane=lane,
+                )
+                contribs = metric_contribs(
+                    new_margins, new_eval_margins, label,
+                    weight * valid.astype(jnp.float32), group_rows,
+                    eval_data, bounds=bounds,
+                )
+                return new_margins, new_eval_margins, forest, contribs, ar_bytes
+
+            return jax.vmap(one_lane, in_axes=(0, 0, 0, 0))(
+                margins_k, eval_margins_k, lane_arrs, rngs
+            )
+
+        eval_specs = self._eval_arr_specs()
+        mapped = shard_map(
+            step,
+            mesh=self.mesh,
+            in_specs=(
+                self._bins_spec(),  # bins (lane-shared)
+                P(AXIS_ACTORS),  # valid
+                P(AXIS_ACTORS),  # label
+                P(AXIS_ACTORS),  # weight
+                P(None, AXIS_ACTORS),  # margins [K, rows, n_out]
+                P(AXIS_ACTORS) if self.group_rows is not None else P(),
+                {name: P() for name in self._vk_lane_arrays},  # lane planes
+                P(),  # per-lane rng keys [K, 2]
+                (P(AXIS_ACTORS), P(AXIS_ACTORS))
+                if self.bounds_dev is not None else P(),
+                eval_specs,
+            ),
+            out_specs=(
+                P(None, AXIS_ACTORS),
+                tuple(P(None, AXIS_ACTORS) for _ in eval_specs),
+                P(),  # forests [K, T, heap]
+                tuple(
+                    tuple((P(), P()) for _ in self._device_metrics)
+                    for _ in self.evals
+                ),
+                P(),  # allreduce payload bytes [K]
+            ),
+        )
+        return progreg.register_jit(
+            "engine.step_vmapped",
+            mapped,
+            donate_argnums=(4,),
+            example_args=lambda: self._vmapped_example_args(),
+            meta=self._program_meta(),
+        )
+
+    def _vmapped_example_args(self) -> tuple:
+        group_rows = self._default_group_rows()
+        bounds = self._default_bounds()
+        return (self.bins, self.valid, self.label_dev, self.weight_dev,
+                self.margins, group_rows, self._vk_lane_arrays,
+                self._vk_rngs(0), bounds, self._eval_arrs())
+
+    def _vk_rngs(self, iteration: int) -> jnp.ndarray:
+        """[K, 2] per-lane round keys: each lane folds ITS OWN seed with
+        the global round index, so a lane whose seed equals a sequential
+        trial's seed replays that trial's exact PRNG stream."""
+        it = self.iteration_offset + iteration
+        return jnp.stack([
+            jax.random.fold_in(jax.random.PRNGKey(lane_seed), it)
+            for lane_seed in self._vk_seeds
+        ])
+
+    def step_vmapped(self, iteration: int) -> List[Dict[str, Dict[str, float]]]:
+        """Run one boosting round for ALL live lanes; returns a per-lane
+        list of ``{eval_name: {metric: value}}`` (index = live-lane slot;
+        map through ``lane_ids()`` for original candidate identity)."""
+        if not self._vk:
+            raise RuntimeError("enable_lanes() first")
+        span_ts, span_t0 = time.time(), time.perf_counter()
+        k = self._vk
+        fn = self._vk_fns.get(k)
+        if fn is None:
+            fn = self._vk_fns[k] = self._make_vmapped_step(k)
+        eval_data = self._eval_arrs()
+        group_rows = self._default_group_rows()
+        bounds = self._default_bounds()
+        rngs = self._vk_rngs(iteration)
+        prog = ("vmapped", k)
+        with strict_transfer_guard(active=prog in self._warm_programs):
+            new_margins, new_eval_margins, forests, contribs, ar_bytes = fn(
+                self.bins,
+                self.valid,
+                self.label_dev,
+                self.weight_dev,
+                self.margins,
+                group_rows,
+                self._vk_lane_arrays,
+                rngs,
+                bounds,
+                eval_data,
+            )
+        self._warm_programs.add(prog)
+        self._ar_bytes_dev = ar_bytes[0]
+        self.margins = new_margins
+        ei = 0
+        for es in self.evals:
+            if not es.is_train:
+                es.margins = new_eval_margins[ei]
+                ei += 1
+        # defer the [K, T, heap] forest transfer like the scalar path
+        self._vk_trees_dev.append(forests)
+
+        # metrics: one stacked [2*n_metrics*n_evals, K] transfer
+        flat_scalars = [
+            c
+            for si in range(len(self.evals))
+            for mi in range(len(self._device_metrics))
+            for c in contribs[si][mi]
+        ]
+        flat_vals = (
+            np.asarray(jnp.stack(flat_scalars))
+            if flat_scalars else np.zeros((0, k))
+        )
+        results: List[Dict[str, Dict[str, float]]] = []
+        for j in range(k):
+            lane_res: Dict[str, Dict[str, float]] = {}
+            fi = 0
+            for si, es in enumerate(self.evals):
+                row: Dict[str, float] = {}
+                for mi, name in enumerate(self._device_metrics):
+                    num = float(flat_vals[fi][j])
+                    den = float(flat_vals[fi + 1][j])
+                    fi += 2
+                    val = num / max(den, 1e-12)
+                    base, _ = parse_metric_name(name)
+                    row[name] = (
+                        float(np.sqrt(val)) if base in ("rmse", "rmsle")
+                        else val
+                    )
+                lane_res[es.name] = row
+            results.append(lane_res)
+        self._emit_round_spans(
+            span_ts, span_t0, self.iteration_offset + iteration
+        )
+        return results
+
+    def lane_ids(self) -> List[int]:
+        """Original candidate index of each live lane slot."""
+        return list(self._vk_lane_ids)
+
+    def _vk_flush(self) -> None:
+        """Transfer pending [K, T, heap] device forests to per-lane host
+        tree lists. All pending entries share the CURRENT lane packing
+        (``repack_lanes`` flushes before slicing)."""
+        entries = self._vk_trees_dev
+        if not entries:
+            return
+        for entry in entries:
+            ent = jax.tree.map(np.asarray, entry)
+            for j in range(len(self._vk_trees)):
+                self._vk_trees[j].append(
+                    jax.tree.map(lambda a, _j=j: a[_j], ent)
+                )
+        self._vk_trees_dev.clear()
+
+    def repack_lanes(self, keep: Sequence[int]) -> None:
+        """Drop pruned lanes and re-pack survivors into a K' = len(keep)
+        program (ASHA's successive-halving primitive). Margin state and
+        lane planes are sliced on host and re-placed; the K' round program
+        compiles lazily at the next ``step_vmapped`` (cached per K', so a
+        later group pruning to the same K' reuses it)."""
+        keep = list(keep)
+        if not keep:
+            raise ValueError("repack_lanes needs at least one survivor")
+        if sorted(set(keep)) != sorted(keep) or \
+                not all(0 <= j < self._vk for j in keep):
+            raise ValueError(f"invalid lane indices {keep!r}")
+        self._vk_flush()
+        idx = np.asarray(keep, np.int64)
+
+        def take(arr):
+            return jax.device_put(np.asarray(arr)[idx], self._vk_sharding)
+
+        self.margins = take(self.margins)
+        for es in self.evals:
+            if not es.is_train:
+                es.margins = take(es.margins)
+        self._vk_lane_np = {
+            name: v[idx] for name, v in self._vk_lane_np.items()
+        }
+        self._vk_lane_arrays = {
+            name: jnp.asarray(v) for name, v in self._vk_lane_np.items()
+        }
+        self._vk_seeds = [self._vk_seeds[j] for j in keep]
+        self._vk_lane_params = [self._vk_lane_params[j] for j in keep]
+        self._vk_trees = [self._vk_trees[j] for j in keep]
+        self._vk_lane_ids = [self._vk_lane_ids[j] for j in keep]
+        self._vk = len(keep)
+        self._obs_round_attrs = dict(self._obs_round_attrs, k=self._vk)
+
+    def get_booster_lane(self, lane: int) -> RayXGBoostBooster:
+        """The finished booster of live-lane slot ``lane``, carrying that
+        lane's OWN parsed params (eta, lambda, depth, ...) — not the
+        widened base config the program traced with."""
+        if not self._vk:
+            raise RuntimeError("enable_lanes() first")
+        self._vk_flush()
+        if not self._vk_trees[lane]:
+            raise ValueError("empty forest")
+        forest = stack_trees(self._vk_trees[lane])
+        booster = RayXGBoostBooster(
+            forest,
+            np.asarray(self.cuts),
+            self._vk_lane_params[lane],
+            self.base_score,
+            feature_names=self.feature_names,
+            feature_types=self.feature_types,
         )
         booster._has_node_stats = self._init_has_stats
         booster.categories = self.categories

@@ -83,6 +83,11 @@ TRACE_NAMES = frozenset({
     "checkpoint.load",
     # fault injection (faults.py)
     "fault.injected",
+    # vectorized HPO (tuner.py): ASHA lane pruning inside a vmapped-K
+    # program — one lane_prune event per pruned lane (original candidate
+    # id + the rung metric that lost), one repack event per successive-
+    # halving re-pack (k_before -> k_after)
+    "hpo.lane_prune", "hpo.repack",
 })
 
 

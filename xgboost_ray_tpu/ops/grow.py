@@ -281,6 +281,9 @@ def build_tree(
     fshard=None,  # ops.provider.FeatureShard on a 2D row x feature mesh
     gh_scale: Optional[jnp.ndarray] = None,  # [2] f32 per-channel scales of a
     #   quantized integer gh buffer (gh_precision; None = f32 legacy path)
+    depth_limit: Optional[jnp.ndarray] = None,  # traced int32 scalar: levels
+    #   >= depth_limit force still-active nodes to leaves (vmapped-K HPO's
+    #   per-lane max_depth; the program still traces cfg.max_depth levels)
 ):
     """Grow one tree. Returns (Tree, row_value[N]) — row_value is the leaf
     value each row receives (learning-rate scaled), used to update margins
@@ -309,6 +312,14 @@ def build_tree(
     column is owner-broadcast so row routing stays O(rows)."""
     hist_ar = hist_allreduce if hist_allreduce is not None else allreduce
     if cfg.grow_policy == "lossguide":
+        if depth_limit is not None:
+            # lossguide's frontier scan has no per-level structure to mask;
+            # vmapped-K lanes must share max_depth under lossguide (the
+            # engine/params validation names the key before tracing)
+            raise NotImplementedError(
+                "depth_limit (per-lane max_depth) is not supported with "
+                "grow_policy='lossguide'"
+            )
         from xgboost_ray_tpu.ops.grow_lossguide import build_tree_lossguide
 
         # engine validation guarantees the unsupported-combination params
@@ -631,6 +642,14 @@ def build_tree(
                 fshard.axis, counter=fshard.counter,
             )
         valid_split = sp.valid & active
+        if depth_limit is not None:
+            # per-lane depth ceiling: a lane whose limit is this level keeps
+            # its active nodes but may not split them — they fall through to
+            # is_new_leaf below with node values from the histogram readout
+            # (vs the final-level exact psum, so a depth-masked lane matches
+            # its sequential twin to f32 rounding, bitwise only when its
+            # limit equals cfg.max_depth and this mask is never engaged)
+            valid_split = valid_split & (d < depth_limit)
         if mono_on:
             node_value = lr * bounded_weight(
                 node_gh[:, 0], node_gh[:, 1], cfg.split, lower, upper

@@ -9,6 +9,16 @@ Each objective is a small pure-function bundle; grad/hess are computed on
 device inside the jitted round step (closed-form, not autodiff — these are
 classic second-order formulas and closed-form is both faster and matches
 xgboost semantics exactly). Ranking objectives live in ``ranking.py``.
+
+Vmapped-K HPO (``engine.step_vmapped``) maps the whole round — including
+``grad_hess`` and the ``quantize_gh`` source quantization — over a leading
+lane axis: margins arrive as ``[K, N, out]`` and every formula here batches
+element-wise with no change (nothing in an objective may branch on a traced
+per-lane param, which is why the lane-vectorizable set in ``params.py``
+only contains split-arithmetic scalars; ``scale_pos_weight`` et al. stay
+static per program). Per-lane gradients therefore differ only through the
+lane's own margins/PRNG stream, keeping each lane's gh bitwise-identical
+to its sequential twin's.
 """
 
 import dataclasses

@@ -164,10 +164,73 @@ class MixedHistogram(HistogramProvider):
                               precision=self.precision)
 
 
+@dataclasses.dataclass(frozen=True)
+class VmappedKProvider(HistogramProvider):
+    """Histogram build seam for the vmapped-K (multi-candidate HPO) round.
+
+    Under ``jax.vmap`` over the lane axis the delegate build's scatter/
+    matmul primitives batch mechanically — each lane accumulates its own
+    ``[n_nodes, F, nbt, 2]`` histogram from its own (sampled) gh — so the
+    default implementation simply delegates to a base provider and lets
+    vmap's batching rules do the stacking. The point of routing through the
+    registry anyway is the seam: a TPU kernel that folds the K axis into
+    one scatter (lane-major node index ``k * n_nodes + pos``) registers a
+    subclass here and every grower picks it up through ``cfg.hist_provider``
+    with zero grower changes, exactly like any other ``hist_impl``.
+
+    ``base`` must name a gather-based provider (``wants_order`` False):
+    the presorted-partition layouts maintain ONE row order per tree, but
+    vmapped lanes sample and route rows independently, so a shared order
+    table would be wrong for every lane but one.
+    """
+
+    base: str = "scatter"
+
+    name = "vmapped_k"
+    wants_order = False
+
+    def delegate(self) -> HistogramProvider:
+        prov = resolve_hist_provider(self.base, self.precision, self.chunk)
+        if prov.wants_order:
+            raise NotImplementedError(
+                f"hist_impl {self.base!r} maintains a presorted row order "
+                "and cannot back the vmapped-K build (per-lane row "
+                "routing diverges); use a gather-based provider"
+            )
+        return prov
+
+    def build(self, bins, gh, pos, n_nodes, n_bins_total, *, order=None,
+              counts=None, rows_sel=None):
+        return self.delegate().build(
+            bins, gh, pos, n_nodes, n_bins_total,
+            order=order, counts=counts, rows_sel=rows_sel,
+        )
+
+
+def vmapped_k_impl(base: str) -> str:
+    """Return (registering on first use) the ``hist_impl`` name of the
+    vmapped-K provider delegating to ``base`` — e.g. ``vmapped_k[scatter]``.
+    The engine's vmapped path resolves its configured impl through this so
+    the lane-batched build is a first-class registry citizen."""
+    if base == "auto":
+        base = default_hist_impl()
+    name = f"vmapped_k[{base}]"
+    if name not in _PROVIDERS:
+        cls = dataclasses.make_dataclass(
+            f"VmappedK_{base}",
+            [("base", str, dataclasses.field(default=base))],
+            bases=(VmappedKProvider,),
+            frozen=True,
+        )
+        cls.name = name
+        register_histogram_provider(name, cls)
+    return name
+
+
 _PROVIDERS = {
     cls.name: cls
     for cls in (ScatterHistogram, OnehotHistogram, PartitionHistogram,
-                MixedHistogram)
+                MixedHistogram, VmappedKProvider)
 }
 
 

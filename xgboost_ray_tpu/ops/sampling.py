@@ -110,6 +110,9 @@ def sample_rows(
     spec: SamplingSpec,
     scale: Optional[jnp.ndarray] = None,  # [2] f32 dequantization scales of
     #   a quantized gh buffer (required for gradient_based over int gh)
+    lane_budget: Optional[jnp.ndarray] = None,  # traced int32 scalar: keep
+    #   only the first ``lane_budget`` of the M selected slots (vmapped-K
+    #   HPO's per-lane subsample rate; uniform policy only)
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Select the round's row budget. Returns ``(rows, gh_sel)``:
 
@@ -156,7 +159,18 @@ def sample_rows(
         u = jax.random.uniform(key, (n,))
         _, rows = jax.lax.top_k(u, m)
         ok = valid[rows][:, None].astype(gh.dtype)
+        if lane_budget is not None:
+            # top_k sorts descending, so slots [0, lane_budget) ARE the
+            # lane's own exact top-k selection; the surplus slots keep
+            # their row ids (shape stays the vmapped program's shared M)
+            # but contribute zero gh downstream
+            ok = ok * (jnp.arange(m) < lane_budget)[:, None].astype(gh.dtype)
         return rows.astype(jnp.int32), gh[rows] * ok
+    if lane_budget is not None:
+        raise NotImplementedError(
+            "per-lane budgets (vmapped-K subsample) are only supported for "
+            "the 'uniform' sampling policy"
+        )
     if spec.policy != "gradient_based":
         raise ValueError(f"unknown sampling policy {spec.policy!r}")
 

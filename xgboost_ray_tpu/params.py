@@ -563,3 +563,116 @@ def parse_params(params: Optional[Dict[str, Any]]) -> TrainParams:
         if out.sample_type not in ("uniform", "weighted"):
             raise ValueError("sample_type must be 'uniform' or 'weighted'")
     return out
+
+
+# --- vmapped-K (vectorized HPO) lane parameters ------------------------------
+# A vmapped-K engine traces ONE round program and runs K hyperparameter
+# candidates ("lanes") through it under jax.vmap. A param can ride the lane
+# axis only if the round body consumes it ARITHMETICALLY (a traced scalar
+# works) — anything that changes trace-time structure (shapes, loop extents,
+# provider choice, objective kernel) forces a separate compile and is NOT
+# lane-vectorizable. The split is enforced loudly here (the repo's
+# no-silent-fallback invariant: a lane must never silently train with a
+# neighbor's params).
+
+#: Params that may differ per lane inside one vmapped-K program.
+#: ``max_depth`` rides as a traced level mask (the program traces
+#: ``max(depths)`` levels); ``subsample`` as a traced slot budget over the
+#: max-rate buffer; ``seed`` as a per-lane PRNG key fed in at dispatch.
+LANE_VECTORIZABLE_KEYS = (
+    "learning_rate",
+    "reg_lambda",
+    "reg_alpha",
+    "gamma",
+    "min_child_weight",
+    "subsample",
+    "max_depth",
+    "seed",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LaneParams:
+    """K parsed candidate configs packed for one vmapped-K program.
+
+    ``base`` is the trace-time config: lane 0's params with the shape-
+    determining fields widened to cover every lane (``max_depth`` = max,
+    ``subsample`` = max rate). ``lanes`` keeps each candidate's own parsed
+    params for per-lane PRNG seeds, depth/budget arrays, and the per-lane
+    boosters' metadata.
+    """
+
+    base: TrainParams
+    lanes: tuple  # Tuple[TrainParams, ...]
+
+    @property
+    def k(self) -> int:
+        return len(self.lanes)
+
+    def values(self, name: str) -> list:
+        return [getattr(p, name) for p in self.lanes]
+
+    @property
+    def depth_varied(self) -> bool:
+        return len({p.max_depth for p in self.lanes}) > 1
+
+    @property
+    def subsample_varied(self) -> bool:
+        return len({float(p.subsample) for p in self.lanes}) > 1
+
+
+def vectorize_params(configs: Sequence[Dict[str, Any]]) -> LaneParams:
+    """Parse K candidate param dicts into a :class:`LaneParams`, or raise
+    ``NotImplementedError`` NAMING the first param that cannot ride the
+    lane axis (differs across lanes but is not in
+    :data:`LANE_VECTORIZABLE_KEYS`)."""
+    if not configs:
+        raise ValueError("vectorize_params needs at least one config")
+    parsed = [parse_params(c) for c in configs]
+    base0 = parsed[0]
+    for f in dataclasses.fields(TrainParams):
+        if f.name in LANE_VECTORIZABLE_KEYS:
+            continue
+        reprs = {repr(getattr(p, f.name)) for p in parsed}
+        if len(reprs) > 1:
+            hint = ""
+            if f.name in ("top_rate", "other_rate"):
+                hint = (
+                    " (GOSS budgets are trace-time row counts; under "
+                    "sampling_method='gradient_based' every lane must use "
+                    "the same rates)"
+                )
+            raise NotImplementedError(
+                f"param {f.name!r} differs across vmapped-K lanes but is "
+                f"not lane-vectorizable{hint}; lane-vectorizable params: "
+                f"{', '.join(LANE_VECTORIZABLE_KEYS)}. Split these trials "
+                f"into separate (sequential) programs instead."
+            )
+    if base0.booster != "gbtree":
+        raise NotImplementedError(
+            f"booster={base0.booster!r} is not supported on the vmapped-K "
+            f"path (dart re-walks a lane-dependent forest per round; "
+            f"gblinear has no round program to vmap). Use booster='gbtree' "
+            f"or sequential trials."
+        )
+    if base0.grow_policy == "lossguide" and \
+            len({p.max_depth for p in parsed}) > 1:
+        raise NotImplementedError(
+            "param 'max_depth' cannot vary across vmapped-K lanes with "
+            "grow_policy='lossguide' (the frontier scan has no per-level "
+            "structure to mask); use equal depths or sequential trials."
+        )
+    if base0.sampling_method == "gradient_based" and \
+            len({float(p.subsample) for p in parsed}) > 1:
+        raise NotImplementedError(
+            "param 'subsample' cannot vary across vmapped-K lanes with "
+            "sampling_method='gradient_based' (GOSS budgets are trace-time "
+            "row counts); use equal rates or sequential trials."
+        )
+    base = dataclasses.replace(
+        base0,
+        max_depth=max(p.max_depth for p in parsed),
+        subsample=max(float(p.subsample) for p in parsed),
+        eval_metric=list(base0.eval_metric),
+    )
+    return LaneParams(base=base, lanes=tuple(parsed))

@@ -202,6 +202,48 @@ class MedianStoppingRule:
         return best > med
 
 
+# --- vectorized (vmapped-K) trials -------------------------------------------
+
+
+@dataclasses.dataclass
+class VectorizedTrainable:
+    """Declarative training spec for the on-mesh vectorized HPO path.
+
+    The callback trainable is opaque user code, so every trial is its own
+    mesh program (and its own XLA compile). This spec instead names the
+    data and round budget declaratively, letting ``Tuner.fit``:
+
+    * ``vectorized=True`` — pack lane-compatible trials as LANES of ONE
+      vmapped-K XLA program (engine.enable_lanes): one compile trains up
+      to ``max_lanes`` candidates simultaneously on the same binned data,
+      and an attached ``ASHAScheduler`` prunes losing lanes at round
+      boundaries (``engine.repack_lanes`` re-packs survivors into a
+      smaller K' program).
+    * ``vectorized=False`` — run trials sequentially, but route each
+      group of same-shaped trials through ONE lane-enabled engine held in
+      the tuner's engine cache (``engine.reset_lanes`` between trials), so
+      trials differing only in lane-vectorizable params share a single
+      compile instead of retracing per trial.
+
+    Trials whose params cannot ride the lane axis raise
+    ``NotImplementedError`` naming the offending key (params.
+    vectorize_params) — a lane never silently trains with the wrong
+    config. Params that always force separate compiles (``max_bin``,
+    ``grow_policy``, ``hist_impl``, ``feature_parallel``, objectives, ...)
+    simply land in separate groups/programs.
+    """
+
+    shards: List[Any]
+    num_actors: int
+    num_boost_round: int = 10
+    evals: List[Any] = dataclasses.field(default_factory=list)
+    devices: Optional[List[Any]] = None
+    vectorized: bool = True
+    # lane cap per program: each lane carries a margin plane per data set,
+    # so K is a memory knob as much as a throughput one
+    max_lanes: int = 8
+
+
 # --- trial execution ---------------------------------------------------------
 
 
@@ -286,6 +328,12 @@ class Tuner:
         # ASHAScheduler / MedianStoppingRule (or any on_report duck type):
         # early-terminates unpromising trials — the Ray Tune scheduler role
         self.scheduler = scheduler
+        # vectorized-HPO engine cache (VectorizedTrainable sequential mode):
+        # one lane-enabled engine per same-shaped trial group, reused across
+        # trials via reset_lanes — the tuner-level analog of the driver's
+        # elastic engine_cache, bounded the same way (entries pin device
+        # arrays)
+        self.engine_cache: Dict[Any, Any] = {}
 
     def _run_trial(self, i: int, config: Dict[str, Any], devices=None) -> Trial:
         trial_id = f"trial_{i:05d}"
@@ -316,6 +364,209 @@ class Tuner:
             )
         return trial
 
+    # --- vectorized (vmapped-K) execution --------------------------------
+
+    @staticmethod
+    def _lane_groups(configs: List[Dict[str, Any]]) -> List[List[int]]:
+        """Partition trial indices into lane-compatible groups: trials in a
+        group agree on every non-lane-vectorizable parsed param (those are
+        trace-shape coordinates — separate compiles by construction), and
+        on the params the grower cannot mask per lane (depth under
+        lossguide, subsample under GOSS)."""
+        from xgboost_ray_tpu.params import (
+            LANE_VECTORIZABLE_KEYS, TrainParams, parse_params,
+        )
+
+        groups: Dict[tuple, List[int]] = {}
+        for i, config in enumerate(configs):
+            p = parse_params(config)
+            key = [
+                repr(getattr(p, f.name))
+                for f in dataclasses.fields(TrainParams)
+                if f.name not in LANE_VECTORIZABLE_KEYS
+            ]
+            # params vectorize_params would reject as lane-varying for this
+            # config shape become group-key coordinates instead, so every
+            # group it receives is vectorizable by construction
+            if p.grow_policy == "lossguide":
+                key.append(("max_depth", p.max_depth))
+            if p.sampling_method == "gradient_based":
+                key.append(("subsample", float(p.subsample)))
+            groups.setdefault(tuple(key), []).append(i)
+        return list(groups.values())
+
+    def _new_trial(self, i: int, config: Dict[str, Any]) -> Trial:
+        trial_id = f"trial_{i:05d}"
+        trial_dir = os.path.join(self.experiment_dir, trial_id)
+        os.makedirs(trial_dir, exist_ok=True)
+        return Trial(trial_id=trial_id, config=config, trial_dir=trial_dir)
+
+    @staticmethod
+    def _flatten_lane_result(lane_res: Dict[str, Dict[str, float]],
+                             iteration: int) -> Dict[str, Any]:
+        flat: Dict[str, Any] = {
+            f"{ename}-{m}": v
+            for ename, row in lane_res.items()
+            for m, v in row.items()
+        }
+        flat["training_iteration"] = iteration
+        return flat
+
+    @staticmethod
+    def _save_lane_checkpoint(engine, slot: int, trial: Trial) -> None:
+        booster = engine.get_booster_lane(slot)
+        ckpt_dir = os.path.join(trial.trial_dir, "checkpoint_final")
+        os.makedirs(ckpt_dir, exist_ok=True)
+        path = os.path.join(ckpt_dir, "checkpoint.json")
+        booster.save_model(path)
+        trial.checkpoint_path = ckpt_dir
+
+    def _fit_vectorized(self, configs: List[Dict[str, Any]]) -> ExperimentResult:
+        """VectorizedTrainable execution: lane-packed vmapped-K programs
+        (``vectorized=True``) or compile-deduped sequential trials
+        (``vectorized=False``)."""
+        spec = self.trainable
+        trials = [self._new_trial(i, c) for i, c in enumerate(configs)]
+        groups = self._lane_groups(configs)
+        for idxs in groups:
+            if spec.vectorized:
+                cap = max(1, int(spec.max_lanes))
+                for pos in range(0, len(idxs), cap):
+                    self._train_pack(spec, idxs[pos : pos + cap], trials)
+            else:
+                self._train_group_sequential(spec, idxs, trials)
+        return ExperimentResult(
+            trials=trials, metric=self.metric, mode=self.mode
+        )
+
+    def _lane_engine(self, spec: VectorizedTrainable, lane_params,
+                     group_key, force_masks: bool):
+        """Build (or revive from the tuner engine cache) a lane-enabled
+        engine for one trial group. A cache hit re-arms the engine via
+        ``reset_lanes`` — the compiled K-lane programs carry over."""
+        from xgboost_ray_tpu.engine import TpuEngine
+
+        cached = self.engine_cache.pop(group_key, None)
+        if cached is not None and cached.params == lane_params.base:
+            cached.reset_lanes(lane_params)
+            return cached
+        evals = list(spec.evals) or [(spec.shards, "train")]
+        eng = TpuEngine(
+            spec.shards,
+            lane_params.base,
+            num_actors=spec.num_actors,
+            evals=evals,
+            devices=spec.devices,
+            total_rounds=spec.num_boost_round,
+        )
+        eng.enable_lanes(lane_params, force_masks=force_masks)
+        return eng
+
+    def _cache_engine(self, group_key, engine) -> None:
+        self.engine_cache[group_key] = engine
+        while len(self.engine_cache) > 2:
+            self.engine_cache.pop(next(iter(self.engine_cache)))
+
+    def _train_pack(self, spec: VectorizedTrainable, idxs: List[int],
+                    trials: List[Trial]) -> None:
+        """Train one pack of lane-compatible trials as a vmapped-K program,
+        with ASHA successive halving at round boundaries: pruned lanes are
+        finalized (booster + checkpoint) and the survivors re-packed into a
+        smaller K' program."""
+        from xgboost_ray_tpu import obs
+        from xgboost_ray_tpu.params import vectorize_params
+
+        lp = vectorize_params([trials[i].config for i in idxs])
+        group_key = ("pack",) + tuple(idxs)
+        eng = self._lane_engine(spec, lp, group_key, force_masks=False)
+        tracer = obs.get_tracer()
+        try:
+            for it in range(spec.num_boost_round):
+                results = eng.step_vmapped(it)
+                lane_ids = eng.lane_ids()
+                stop_slots = []
+                for slot, lane_res in enumerate(results):
+                    trial = trials[idxs[lane_ids[slot]]]
+                    flat = self._flatten_lane_result(lane_res, it + 1)
+                    trial.results.append(flat)
+                    trial.last_result = flat
+                    if self.scheduler is not None and self.scheduler.on_report(
+                        trial.trial_id, it + 1, flat
+                    ):
+                        trial.stopped_early = True
+                        stop_slots.append(slot)
+                last_round = it + 1 == spec.num_boost_round
+                if stop_slots and not last_round:
+                    for slot in stop_slots:
+                        trial = trials[idxs[lane_ids[slot]]]
+                        self._save_lane_checkpoint(eng, slot, trial)
+                        tracer.event("hpo.lane_prune", attrs={
+                            "trial": trial.trial_id,
+                            "lane": lane_ids[slot],
+                            "round": it + 1,
+                            "metric": getattr(
+                                self.scheduler, "metric", None
+                            ),
+                            "value": (trial.last_result or {}).get(
+                                getattr(self.scheduler, "metric", None)
+                            ),
+                        })
+                    keep = [
+                        s for s in range(len(results)) if s not in stop_slots
+                    ]
+                    if not keep:
+                        return
+                    tracer.event("hpo.repack", attrs={
+                        "k_before": len(results),
+                        "k_after": len(keep),
+                        "round": it + 1,
+                    })
+                    eng.repack_lanes(keep)
+            for slot, lane_id in enumerate(eng.lane_ids()):
+                self._save_lane_checkpoint(eng, slot, trials[idxs[lane_id]])
+        finally:
+            if eng.lane_ids():
+                self._cache_engine(group_key, eng)
+
+    def _train_group_sequential(self, spec: VectorizedTrainable,
+                                idxs: List[int],
+                                trials: List[Trial]) -> None:
+        """Sequential trials of one lane-compatible group through ONE
+        engine: trial j reuses trial 0's compiled K=1 program via
+        ``reset_lanes`` (per-lane params are runtime inputs, so only the
+        group's trace-shape signature compiles)."""
+        import dataclasses as _dc
+
+        from xgboost_ray_tpu.params import vectorize_params
+
+        group_lp = vectorize_params([trials[i].config for i in idxs])
+        group_key = ("group",) + tuple(idxs)
+        eng = None
+        for j, i in enumerate(idxs):
+            trial = trials[i]
+            lp_j = _dc.replace(group_lp, lanes=(group_lp.lanes[j],))
+            if eng is None:
+                # force_masks: later trials in the group may vary depth /
+                # subsample — pre-arm the masks so they share the compile
+                eng = self._lane_engine(
+                    spec, lp_j, group_key, force_masks=True
+                )
+            else:
+                eng.reset_lanes(lp_j)
+            for it in range(spec.num_boost_round):
+                lane_res = eng.step_vmapped(it)[0]
+                flat = self._flatten_lane_result(lane_res, it + 1)
+                trial.results.append(flat)
+                trial.last_result = flat
+                if self.scheduler is not None and self.scheduler.on_report(
+                    trial.trial_id, it + 1, flat
+                ):
+                    trial.stopped_early = True
+                    break
+            self._save_lane_checkpoint(eng, 0, trial)
+        if eng is not None:
+            self._cache_engine(group_key, eng)
+
     def fit(self) -> ExperimentResult:
         """Run all trials. With ``max_concurrent_trials > 1``, trials run in
         a thread pool and the local device mesh is partitioned into disjoint
@@ -323,6 +574,13 @@ class Tuner:
         trials-on-separate-TPU-slices task parallelism (SURVEY §2.3; the
         reference gets this from Ray Tune's scheduler, ``tune.py:107-126``)."""
         configs = _expand_space(self.param_space, self.num_samples, self.seed)
+        if isinstance(self.trainable, VectorizedTrainable):
+            if self.max_concurrent_trials != 1:
+                raise ValueError(
+                    "VectorizedTrainable owns the whole mesh (trials are "
+                    "lanes of one program); max_concurrent_trials must be 1"
+                )
+            return self._fit_vectorized(configs)
         if self.max_concurrent_trials == 1:
             trials = [self._run_trial(i, c) for i, c in enumerate(configs)]
             return ExperimentResult(trials=trials, metric=self.metric, mode=self.mode)
