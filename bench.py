@@ -66,6 +66,13 @@ TRIPWIRE_RATIO = 1.2
 # in the p99), so 1.2x would fire on environmental noise alone
 SERVE_TRIPWIRE_RATIO = 1.5
 
+# paired heap-vs-node-array serving arms run back-to-back in ONE process
+# under an identical closed-loop config, so same-environment variance is
+# bounded and the band can be the tight 20%: fire when the node-array
+# arm's p99 exceeds 1.2x the heap arm's (the FIL-style layout's p99 cut
+# regressed)
+SERVE_LAYOUT_TRIPWIRE_RATIO = 1.2
+
 # chaos recovery: flag >20% time-to-recover regressions across snapshots
 CHAOS_TRIPWIRE_RATIO = 1.2
 
@@ -223,13 +230,15 @@ def round_time_tripwire(current_s, prev_rec, prev_name=None, backend=None,
 
 
 def serve_latency_tripwire(current_serve, prev_rec, prev_name=None,
-                           backend=None, threshold=SERVE_TRIPWIRE_RATIO):
+                           backend=None, threshold=SERVE_TRIPWIRE_RATIO,
+                           section="serve"):
     """Compare this run's serve p99 against the newest recorded bench.
 
     The serving analog of ``round_time_tripwire``: returns
     ``{prev_p99_ms, prev_record, ratio, fired}`` or None when no comparable
-    record exists (different backend, no recorded ``serve`` section). Only
-    fires like-for-like — when the recorded run used a different closed-loop
+    record exists (different backend, no recorded ``section`` — "serve" by
+    default, "serve_node_array" for the paired layout arm). Only fires
+    like-for-like — when the recorded run used a different closed-loop
     config (clients / max_batch / deadline / request profile), the
     comparison is still reported with ``config_mismatch`` set and ``fired``
     False, since a p99 under different load is not a regression signal."""
@@ -240,7 +249,7 @@ def serve_latency_tripwire(current_serve, prev_rec, prev_name=None,
         return None
     if backend and prev_rec.get("backend") and prev_rec["backend"] != backend:
         return None
-    prev_serve = prev_rec.get("serve")
+    prev_serve = prev_rec.get(section)
     if not isinstance(prev_serve, dict):
         return None
     prev = prev_serve.get("latency_p99_ms")
@@ -264,6 +273,54 @@ def serve_latency_tripwire(current_serve, prev_rec, prev_name=None,
             f"{prev_name or 'BENCH_*.json'}) — >{(threshold - 1) * 100:.0f}% "
             f"regression. Investigate before trusting this build's serving "
             f"tail.",
+            file=sys.stderr,
+        )
+    return out
+
+
+def serve_layout_tripwire(heap_serve, na_serve,
+                          threshold=SERVE_LAYOUT_TRIPWIRE_RATIO):
+    """Paired-arm tripwire: heap vs node-array p99 from the SAME process.
+
+    Both arms serve the same model under the identical closed-loop config,
+    back to back, so this is the low-variance comparison: returns
+    ``{heap_p99_ms, node_array_p99_ms, ratio, fired}`` (ratio =
+    node_array / heap) or None when either arm is missing its p99. Fires
+    when the node-array arm's p99 exceeds ``threshold``x the heap arm's —
+    the FIL-style layout's measured tail-latency cut has regressed >20%.
+    A config difference between the arms (everything but the ``layout``
+    key) is reported with ``config_mismatch`` and never fires."""
+    if not isinstance(heap_serve, dict) or not isinstance(na_serve, dict):
+        return None
+    heap_p99 = heap_serve.get("latency_p99_ms")
+    na_p99 = na_serve.get("latency_p99_ms")
+    if not heap_p99 or not na_p99:
+        return None
+    ratio = float(na_p99) / float(heap_p99)
+    out = {
+        "heap_p99_ms": round(float(heap_p99), 4),
+        "node_array_p99_ms": round(float(na_p99), 4),
+        "ratio": round(ratio, 3),
+        "fired": False,
+    }
+
+    def _cfg(section):
+        cfg = section.get("config")
+        if not isinstance(cfg, dict):
+            return None
+        return {k: v for k, v in cfg.items() if k != "layout"}
+
+    if _cfg(heap_serve) != _cfg(na_serve):
+        out["config_mismatch"] = True
+        return out
+    if ratio > threshold:
+        out["fired"] = True
+        print(
+            f"[bench] SERVE LAYOUT TRIPWIRE: node-array p99 "
+            f"{float(na_p99):.2f}ms is {ratio:.2f}x the paired heap arm's "
+            f"({float(heap_p99):.2f}ms) — the FIL-style layout's p99 cut "
+            f"regressed >{(threshold - 1) * 100:.0f}%. Investigate before "
+            f"trusting this build's node-array serving path.",
             file=sys.stderr,
         )
     return out
@@ -1986,18 +2043,40 @@ def _paired_continue_vs_restart(label, params, make_dmatrix, x, rounds,
     return arm
 
 
-def run_serve_measurement():
-    """Closed-loop serving benchmark: train a small model, serve it over
-    loopback HTTP on the ambient mesh, drive it with concurrent clients,
-    and return the endpoint's /metrics snapshot (plus the loop config) as
-    the ``serve`` section of the bench record."""
+def _train_serve_model():
+    """Train the small served model once; shared by the paired heap and
+    node-array serving arms so both serve the IDENTICAL forest."""
+    import jax
+
+    from xgboost_ray_tpu import RayDMatrix, RayParams, train
+
+    n_rows = int(os.environ.get("BENCH_SERVE_TRAIN_ROWS", 20_000))
+    rounds = int(os.environ.get("BENCH_SERVE_TRAIN_ROUNDS", 5))
+    n_feat = 28
+    x, y = make_higgs_like(n_rows, n_feat, seed=1)
+    bst = train(
+        {"objective": "binary:logistic", "max_depth": 6, "eta": 0.1,
+         "max_bin": 256, "tree_method": "tpu_hist"},
+        RayDMatrix(x, y), num_boost_round=rounds,
+        ray_params=RayParams(num_actors=max(1, len(jax.devices())),
+                             checkpoint_frequency=0),
+    )
+    return bst, x
+
+
+def run_serve_measurement(layout="heap", trained=None):
+    """Closed-loop serving benchmark: train a small model (or reuse
+    ``trained`` — the ``_train_serve_model()`` result — for a paired arm),
+    serve it over loopback HTTP on the ambient mesh with the requested
+    forest ``layout``, drive it with concurrent clients, and return the
+    endpoint's /metrics snapshot (plus the loop config) as the ``serve`` /
+    ``serve_node_array`` section of the bench record."""
     import json as json_mod
     import threading
     import urllib.request
 
     import jax
 
-    from xgboost_ray_tpu import RayDMatrix, RayParams, train
     from xgboost_ray_tpu import serve as serve_mod
 
     n_rows = int(os.environ.get("BENCH_SERVE_TRAIN_ROWS", 20_000))
@@ -2008,23 +2087,18 @@ def run_serve_measurement():
     req_rows_max = int(os.environ.get("BENCH_SERVE_REQ_ROWS", 32))
     duration_s = float(os.environ.get("BENCH_SERVE_SECONDS", 6.0))
     warm_s = float(os.environ.get("BENCH_SERVE_WARM_SECONDS", 1.5))
-    n_feat = 28
 
-    x, y = make_higgs_like(n_rows, n_feat, seed=1)
-    bst = train(
-        {"objective": "binary:logistic", "max_depth": 6, "eta": 0.1,
-         "max_bin": 256, "tree_method": "tpu_hist"},
-        RayDMatrix(x, y), num_boost_round=rounds,
-        ray_params=RayParams(num_actors=max(1, len(jax.devices())),
-                             checkpoint_frequency=0),
-    )
+    if trained is None:
+        trained = _train_serve_model()
+    bst, x = trained
     handle = serve_mod.create_server(
         bst, devices=jax.devices(), max_batch=max_batch,
-        max_delay_ms=max_delay_ms,
+        max_delay_ms=max_delay_ms, layout=layout,
     )
     print(f"[bench] serve endpoint up at {handle.url} "
           f"(devices={len(jax.devices())} max_batch={max_batch} "
-          f"max_delay_ms={max_delay_ms} clients={clients})", file=sys.stderr)
+          f"max_delay_ms={max_delay_ms} clients={clients} "
+          f"layout={layout})", file=sys.stderr)
 
     stop = threading.Event()
     errors = []
@@ -2089,6 +2163,7 @@ def run_serve_measurement():
         "train_rows": n_rows,
         "train_rounds": rounds,
         "max_depth": 6,
+        "layout": layout,
     }
     print(f"[bench] serve closed-loop: {section}", file=sys.stderr)
     return section
@@ -2505,13 +2580,33 @@ def run_measurement():
     # serving traffic).
     serve_env = os.environ.get("BENCH_SERVE")
     if serve_env == "1" or (serve_env is None and not on_tpu):
-        serve_section = run_serve_measurement()
+        serve_trained = _train_serve_model()
+        serve_section = run_serve_measurement(trained=serve_trained)
         strip = serve_latency_tripwire(
             serve_section, prev_rec, prev_name, backend=backend
         )
         if strip is not None:
             serve_section["regression_tripwire"] = strip
         detail["serve"] = serve_section
+        # paired arm: the identical model + closed loop on the FIL-style
+        # node-array layout; its p99 is gated against BOTH the recorded
+        # history and (tightly) the in-process heap arm
+        na_section = run_serve_measurement(
+            layout="node_array", trained=serve_trained
+        )
+        natrip = serve_latency_tripwire(
+            na_section, prev_rec, prev_name, backend=backend,
+            section="serve_node_array",
+        )
+        if natrip is not None:
+            na_section["regression_tripwire"] = natrip
+        ltrip = serve_layout_tripwire(serve_section, na_section)
+        if ltrip is not None:
+            na_section["layout_tripwire"] = ltrip
+            na_section["p99_speedup_vs_heap"] = round(
+                1.0 / ltrip["ratio"], 3
+            ) if ltrip["ratio"] else None
+        detail["serve_node_array"] = na_section
 
     # deterministic chaos soak (the recovery counterpart of the protocol
     # run). Default on for the CPU mesh so every recorded BENCH_*.json
@@ -2725,7 +2820,9 @@ def serve_only_main():
     import jax
 
     backend = jax.default_backend()
-    section = run_serve_measurement()
+    trained = _train_serve_model()
+    section = run_serve_measurement(trained=trained)
+    na_section = run_serve_measurement(layout="node_array", trained=trained)
     prev_rec, prev_name = _load_latest_bench_record(
         os.path.dirname(os.path.abspath(__file__))
     )
@@ -2733,6 +2830,17 @@ def serve_only_main():
                                   backend=backend)
     if trip is not None:
         section["regression_tripwire"] = trip
+    natrip = serve_latency_tripwire(na_section, prev_rec, prev_name,
+                                    backend=backend,
+                                    section="serve_node_array")
+    if natrip is not None:
+        na_section["regression_tripwire"] = natrip
+    ltrip = serve_layout_tripwire(section, na_section)
+    if ltrip is not None:
+        na_section["layout_tripwire"] = ltrip
+        na_section["p99_speedup_vs_heap"] = round(
+            1.0 / ltrip["ratio"], 3
+        ) if ltrip["ratio"] else None
     print(
         json.dumps(
             {
@@ -2741,6 +2849,7 @@ def serve_only_main():
                 "unit": "req/s",
                 "backend": backend,
                 "serve": section,
+                "serve_node_array": na_section,
             }
         )
     )

@@ -21,7 +21,7 @@ for ex in readme.py readme_sklearn_api.py simple.py simple_predict.py \
           simple_gblinear.py simple_constraints.py \
           simple_serve.py elastic_continuation.py \
           trace_run.py vectorized_hpo.py \
-          custom_objective_metric.py; do
+          custom_objective_metric.py replicated_serve.py; do
   echo "================= Running $ex ================="
   python "$ex"
   ran=$((ran+1))

@@ -33,6 +33,7 @@ python -m pytest tests/test_gh_precision.py -v -x
 python -m pytest tests/test_streaming.py -v -x
 python -m pytest tests/test_bench_tripwire.py -v -x
 python -m pytest tests/test_obs.py -v -x
+python -m pytest tests/test_serve_pool.py -v -x
 python -m pytest tests/test_end_to_end.py -v -x
 python -m pytest tests/test_fault_tolerance.py -v -x
 python -m pytest tests/test_faults.py -v -x

@@ -113,6 +113,71 @@ def test_serve_tripwire_skips_cross_backend_and_missing_section():
     assert bench.serve_latency_tripwire({}, rec_tpu, "x") is None
 
 
+def test_serve_tripwire_section_param_reads_node_array_history(capsys):
+    """The node-array arm compares against the recorded serve_node_array
+    section, never the heap serve section."""
+    rec = {"metric": "m", "backend": "cpu",
+           "serve": _serve_section(1.0),  # would be a 200x "regression"
+           "serve_node_array": _serve_section(100.0)}
+    out = bench.serve_latency_tripwire(
+        _serve_section(200.0), rec, "x", backend="cpu",
+        section="serve_node_array",
+    )
+    assert out is not None and out["fired"] and out["prev_p99_ms"] == 100.0
+    # a record predating the paired arm has no section to compare against
+    rec_old = {"metric": "m", "backend": "cpu", "serve": _serve_section(1.0)}
+    assert bench.serve_latency_tripwire(
+        _serve_section(200.0), rec_old, "x", backend="cpu",
+        section="serve_node_array",
+    ) is None
+    capsys.readouterr()
+
+
+def _layout_section(p99, layout):
+    return _serve_section(p99, dict(_SERVE_CFG, layout=layout))
+
+
+def test_serve_layout_tripwire_fires_on_paired_regression(capsys):
+    out = bench.serve_layout_tripwire(
+        _layout_section(100.0, "heap"), _layout_section(130.0, "node_array")
+    )
+    assert out is not None and out["fired"]
+    assert out["ratio"] == 1.3
+    assert out["heap_p99_ms"] == 100.0
+    assert out["node_array_p99_ms"] == 130.0
+    assert "SERVE LAYOUT TRIPWIRE" in capsys.readouterr().err
+
+
+def test_serve_layout_tripwire_quiet_when_node_array_faster(capsys):
+    out = bench.serve_layout_tripwire(
+        _layout_section(100.0, "heap"), _layout_section(60.0, "node_array")
+    )
+    assert out is not None and not out["fired"]
+    assert out["ratio"] == 0.6
+    assert "SERVE LAYOUT TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_serve_layout_tripwire_config_gate_ignores_layout_key(capsys):
+    """The layout key itself differs between the arms by construction; any
+    OTHER config difference makes the pair incomparable — reported, never
+    fired."""
+    skewed = dict(_SERVE_CFG, clients=4, layout="node_array")
+    out = bench.serve_layout_tripwire(
+        _layout_section(100.0, "heap"), _serve_section(500.0, skewed)
+    )
+    assert out is not None and not out["fired"]
+    assert out["config_mismatch"] is True
+    assert "SERVE LAYOUT TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_serve_layout_tripwire_skips_incomparable_arms():
+    assert bench.serve_layout_tripwire(None, _layout_section(1.0, "a")) is None
+    assert bench.serve_layout_tripwire(_layout_section(1.0, "a"), {}) is None
+    assert bench.serve_layout_tripwire(
+        {"latency_p99_ms": 0.0}, _layout_section(1.0, "a")
+    ) is None
+
+
 _CHAOS_CFG = {"rows": 20000, "rounds": 12, "actors": 8, "kill_round": 5,
               "straggle_round": 8, "straggle_s": 0.25, "max_depth": 6}
 

@@ -319,6 +319,79 @@ def test_hot_swap_under_load_no_dropped_or_mixed(binary_model, binary_model_b):
                               ref[v].astype(np.float32)), v
 
 
+def test_canary_rollback_then_promote_under_load(binary_model):
+    """Satellite acceptance (serving scale-out PR): the canary gate in the
+    hot-swap-hammer loop. A regressing candidate publish rolls back
+    automatically — the old version keeps serving BIT-IDENTICALLY for
+    every concurrent request — then a passing warm-start refresh flips
+    with zero dropped requests."""
+    bst, x = binary_model
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float32)
+    rng = np.random.RandomState(7)
+    bad = train(  # trained on shuffled labels: must fail the logloss gate
+        {"objective": "binary:logistic", "max_depth": 3, "eta": 0.3,
+         "seed": 7},
+        RayDMatrix(x, rng.permutation(y)), 4, ray_params=RP,
+    )
+    good = serve.refresh(  # 2 more rounds warm-started from the live model
+        bst, {"objective": "binary:logistic", "max_depth": 3, "eta": 0.3,
+              "seed": 0},
+        RayDMatrix(x, y), 2, ray_params=RP,
+    )
+    q = x[:4]
+    ref = {1: bst.predict(q), 2: good.predict(q)}
+    h = serve.create_server(bst, max_batch=32, max_delay_ms=1.0)
+    ctl = serve.CanaryController(h.registry, metrics=h.metrics)
+    errors, responses = [], []
+    resp_lock = threading.Lock()
+    stop = threading.Event()
+
+    def client():
+        while not stop.is_set():
+            try:
+                status, r = _post(h.url, "/predict", {"data": q.tolist()})
+                with resp_lock:
+                    responses.append((status, r["model_version"],
+                                      np.asarray(r["predictions"])))
+            except Exception as exc:  # noqa: BLE001 - recorded as failure
+                with resp_lock:
+                    errors.append(repr(exc))
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        verdict = ctl.publish(bad, x[:100], y[:100], shadow_x=x[:16])
+        assert verdict["promoted"] is False
+        assert verdict["reason"] == "metric_regression"
+        assert h.registry.version == 1  # rollback = the flip never happened
+        time.sleep(0.3)
+        with resp_lock:
+            n_before_promote = len(responses)
+        verdict = ctl.publish(good, x[:100], y[:100])
+        assert verdict["promoted"] is True and verdict["version"] == 2
+        time.sleep(0.3)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(30.0)
+        h.shutdown()
+    assert not errors, errors[:3]  # zero drops through both publishes
+    assert len(responses) > n_before_promote > 10
+    # every response between the rollback and the promote was version 1 —
+    # the bad candidate never served a single request
+    versions = [v for _, v, _ in responses]
+    assert set(versions) <= {1, 2} and 2 in versions
+    assert set(versions[:n_before_promote]) == {1}
+    for status, v, pred in responses:  # bitwise per reported version
+        assert status == 200
+        assert np.array_equal(pred.astype(np.float32),
+                              ref[v].astype(np.float32)), v
+    snap = h.metrics.snapshot()
+    assert snap["canary_rollbacks"] == 1 and snap["canary_promotions"] == 1
+
+
 def test_http_handlers_concurrent_with_hot_swap(binary_model, binary_model_b):
     """Satellite acceptance (rxgbrace PR): /predict, /metrics and /healthz
     all running concurrently with registry hot-swaps — no request may ever

@@ -103,7 +103,7 @@ class _StubPredictor:
     tag so a half-swapped (booster from v2, predictor from v1) entry is
     detectable."""
 
-    def __init__(self, booster, devices=None, min_bucket=8):
+    def __init__(self, booster, devices=None, min_bucket=8, layout="heap"):
         self.booster = booster
         self.tag = booster.tag
 
@@ -529,6 +529,126 @@ def _uploader_invariant(ctx):
 
 
 # ---------------------------------------------------------------------------
+# 9. router: dispatch vs replica kill — shed requests re-dispatch
+# ---------------------------------------------------------------------------
+
+
+class _StubReplicaBatcher:
+    """Flusher-free MicroBatcher stand-in: the batcher's own condition
+    dance has its own scenario (batcher_flush_shutdown_shed); here the unit
+    under test is the ROUTER's table/kill/re-dispatch logic, so submit
+    executes synchronously through the replica view's lease while keeping
+    the exact ShuttingDownError surface the router consumes. A kill landing
+    between the closed-check and the lease executes anyway — the shipped
+    semantics (mid-execution batches complete on the dying replica)."""
+
+    def __init__(self, view, **kwargs):
+        self._view = view
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def submit(self, x, kind="value", timeout=None):
+        from xgboost_ray_tpu.serve.batcher import ShuttingDownError
+
+        with self._lock:
+            if self._closed:
+                raise ShuttingDownError("replica batcher is shut down")
+        with self._view.lease() as entry:
+            out, _ = entry.predictor.predict_with_bucket(x, kind)
+            return out, entry.version
+
+    def queue_depth(self):
+        return 0
+
+    def queued_rows(self):
+        return 0
+
+    def executing_batches(self):
+        return 0
+
+    def consecutive_failures(self):
+        return 0
+
+    @property
+    def breaker_open(self):
+        return False
+
+    def drain(self, timeout=5.0):
+        return True
+
+    def shutdown(self, timeout=5.0):
+        with self._lock:
+            self._closed = True
+
+
+def _router_setup(ctx):
+    from xgboost_ray_tpu.serve import pool as poolmod
+    from xgboost_ray_tpu.serve import registry as regmod
+
+    _patch(ctx, regmod, "CompiledPredictor", _StubPredictor)
+    _patch(ctx, regmod, "coerce_model", lambda m: m)
+    # the replica views build their own predictors through pool's import
+    _patch(ctx, poolmod, "CompiledPredictor", _StubPredictor)
+    _patch(ctx, poolmod, "MicroBatcher", _StubReplicaBatcher)
+
+
+def _router_teardown(ctx):
+    from xgboost_ray_tpu import obs
+
+    obs.set_default_tracer(None)
+
+
+def _router_body(ctx):
+    import numpy as np
+
+    from xgboost_ray_tpu import obs
+    from xgboost_ray_tpu.serve.pool import Router
+    from xgboost_ray_tpu.serve.registry import ModelRegistry
+
+    # fresh tracer created INSIDE the scenario so its lock is instrumented
+    ctx.tracer = obs.Tracer(capacity=64, enabled=True, trace_dir="", rank=0)
+    obs.set_default_tracer(ctx.tracer)
+    reg = ModelRegistry(warm_kinds=())
+    reg.load(_StubBooster(1), warm=False)
+    router = ctx.router = Router(reg, n_replicas=2)
+
+    def client():
+        x = np.zeros((1, 3), np.float32)
+        try:
+            out, version = router.submit(x, "value", timeout=None)
+            ctx.client = ("ok", float(out[0]), version)
+        except BaseException as exc:  # noqa: BLE001 - outcome recorded
+            ctx.client = ("err", type(exc).__name__)
+
+    t = threading.Thread(target=client, name="client")
+    t.start()
+    # main IS the killer (one thread fewer keeps exploration exhaustive):
+    # the hard replica loss races the dispatch — if the request was queued
+    # on slot 0 it fails internally and MUST re-dispatch to slot 1
+    router.kill(0)
+    t.join()
+    ctx.live_after = router.live_replicas()
+    # timeout=None = unbounded flusher joins, keeping the schedule space
+    # exhaustively explorable (same trade as the batcher scenario)
+    router.shutdown(timeout=None)
+
+
+def _router_invariant(ctx):
+    router = ctx.router
+    out = getattr(ctx, "client", None)
+    assert out is not None, "client never completed (lost request)"
+    # capacity degrades, availability never: slot 1 outlives the kill, so
+    # the request must succeed — wholly on model v1
+    assert out == ("ok", 1.0, 1), f"request failed or torn: {out}"
+    assert ctx.live_after == 1, f"live {ctx.live_after} != 1 after kill"
+    assert router._closed, "shutdown did not latch closed"
+    assert not router._replicas, "replica table leaked"
+    assert router.queue_depth() == 0 and router.queued_rows() == 0
+    names = [r.get("name") for r in ctx.tracer.records()]
+    assert "serve.replica_down" in names, f"kill left no timeline event: {names}"
+
+
+# ---------------------------------------------------------------------------
 # the suite
 # ---------------------------------------------------------------------------
 
@@ -581,6 +701,15 @@ SCENARIOS: Tuple[Scenario, ...] = (
                     "drain vs drain/close: no transfer lost or reordered, "
                     "accounting returns to zero",
         body=_uploader_body, invariant=_uploader_invariant,
+    ),
+    Scenario(
+        name="router_dispatch_vs_kill",
+        description="Router least-queue dispatch vs a hard replica kill: "
+                    "the shed request re-dispatches to the survivor, no "
+                    "request lost, membership events on the timeline",
+        body=_router_body, invariant=_router_invariant,
+        setup=_router_setup, teardown=_router_teardown,
+        max_steps=8000,
     ),
     Scenario(
         name="elastic_pending_load_vs_poll",

@@ -287,5 +287,14 @@ def trace_matrix(
             )
             pred.register_programs(kinds=("margin", "leaf", "contribs"))
             engines.append(pred)
+            # the FIL-style breadth-first layout compiles its own margin and
+            # leaf programs (meta layout=node_array → distinct verify
+            # groups); contribs routes to the heap program registered above
+            pred_na = CompiledPredictor(
+                booster, devices=jax.devices()[:_SERVE_WORLD],
+                layout="node_array",
+            )
+            pred_na.register_programs(kinds=("margin", "leaf"))
+            engines.append(pred_na)
         traced = [walker.trace_record(r) for r in progreg.records()]
     return traced

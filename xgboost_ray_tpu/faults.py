@@ -26,6 +26,10 @@ checkpoint.load       ``launcher.load_round_checkpoint``          ``path``
 launcher.worker       ``_launcher_worker`` bootstrap              ``process_id,
                                                                   attempt``
 serve.predict         ``MicroBatcher._execute``                   ``kind, rows``
+serve.route           ``Router.submit`` (serve/pool.py), per      ``replica, kind,
+                      dispatch to a replica                       rows``
+serve.canary          ``CanaryController.publish``                ``live_version,
+                      (serve/canary.py), before the verdict       rows``
 registry.swap         ``ModelRegistry.load``                      ``version``
 stream.read_chunk     ``ShardStream.chunks`` (stream/reader.py)   ``chunk, rows``
 stream.h2d_upload     ``DoubleBufferedUploader.submit``           ``bytes`` (the
@@ -81,6 +85,8 @@ SITES = (
     "checkpoint.load",
     "launcher.worker",
     "serve.predict",
+    "serve.route",
+    "serve.canary",
     "registry.swap",
     "stream.read_chunk",
     "stream.h2d_upload",

@@ -88,6 +88,19 @@ TRACE_NAMES = frozenset({
     # id + the rung metric that lost), one repack event per successive-
     # halving re-pack (k_before -> k_after)
     "hpo.lane_prune", "hpo.repack",
+    # serving scale-out (serve/pool.py, serve/autoscale.py,
+    # serve/canary.py): one route event per replica dispatch, one
+    # replica_up/replica_down per pool membership change (reason:
+    # scale_up | scale_down | rejoin | killed | shutdown), one scale
+    # event per autoscaler decision (direction + from/to replica counts
+    # + the p99/queue evidence), and the canary verdict pair — shadow
+    # (candidate-vs-live divergence on mirrored traffic) then either
+    # rollback (gate regression, old model keeps serving) or promote
+    # (drain-then-flip committed). A scale-up -> scale-down cycle and a
+    # replica-loss chaos run are each reconstructible from these alone
+    # (asserted by tests/test_serve_pool.py).
+    "serve.route", "serve.replica_up", "serve.replica_down", "serve.scale",
+    "serve.shadow", "serve.rollback", "serve.promote",
 })
 
 

@@ -5,7 +5,13 @@ this subsystem serves *online* traffic: a compiled-predictor cache with
 power-of-two padded batch buckets (zero recompiles in steady state), a
 microbatching queue coalescing concurrent requests under a latency
 deadline, a model registry with drain-then-flip hot-swap, and a threaded
-stdlib HTTP front-end with /predict, /healthz and /metrics.
+stdlib HTTP front-end with /predict, /healthz and /metrics. On top of the
+single-batcher path: a replica pool behind a least-queue router with
+admission control (``Router``, ``n_replicas=`` on ``create_server``), a
+p99/queue-driven ``AutoScaler`` with hysteresis, an optional FIL-style
+breadth-first ``node_array`` forest layout (``layout=``), and the
+train → refresh → serve loop (``refresh`` + ``CanaryController`` —
+shadow traffic, canary gate, automatic rollback).
 
 Typical use::
 
@@ -24,15 +30,19 @@ or publish straight from training::
     train(params, dtrain, ray_params=rp, serve_registry=reg)
 """
 
+from xgboost_ray_tpu.serve.autoscale import AutoScaler
 from xgboost_ray_tpu.serve.batcher import (
     MicroBatcher,
     OverloadedError,
     ShuttingDownError,
 )
+from xgboost_ray_tpu.serve.canary import CanaryController, refresh
 from xgboost_ray_tpu.serve.http import ServeHandle, create_server
 from xgboost_ray_tpu.serve.metrics import ServeMetrics
+from xgboost_ray_tpu.serve.pool import NoReplicasError, Replica, Router
 from xgboost_ray_tpu.serve.predictor import (
     KINDS,
+    LAYOUTS,
     CompiledPredictor,
     bucket_rows,
     compile_count,
@@ -45,11 +55,17 @@ from xgboost_ray_tpu.serve.registry import (
 
 __all__ = [
     "KINDS",
+    "LAYOUTS",
+    "AutoScaler",
+    "CanaryController",
     "CompiledPredictor",
     "MicroBatcher",
     "ModelRegistry",
     "NoModelError",
+    "NoReplicasError",
     "OverloadedError",
+    "Replica",
+    "Router",
     "ServeHandle",
     "ShuttingDownError",
     "ServeMetrics",
@@ -57,4 +73,5 @@ __all__ = [
     "coerce_model",
     "compile_count",
     "create_server",
+    "refresh",
 ]

@@ -1,0 +1,177 @@
+"""The train → refresh → serve loop: warm-start refresh, shadow + canary
+publish, automatic rollback.
+
+Closes the loop between the trainer and the serving plane:
+
+* :func:`refresh` — continual refresh: boost additional rounds on fresh
+  data *warm-started from the live booster* (``train(xgb_model=live)``).
+  With a streamed ``RayDMatrix`` the ingestion plane's mergeable quantile
+  sketch (``stream/sketch.py``) folds the fresh chunks' summaries onto the
+  existing cut structure, so refreshing is an incremental pass over the
+  new data, not a re-read of history.
+* :class:`CanaryController` — gated publish on top of the registry's
+  drain-then-flip hot-swap. The candidate is evaluated *before* the flip:
+
+  1. **shadow traffic** — the candidate predicts the mirrored request
+     sample next to the live model; the divergence is recorded as a
+     ``serve.shadow`` event (evidence, not a gate);
+  2. **canary gate** — candidate vs live metric (default: binary logloss)
+     on a labeled canary set, through each model's compiled predictor;
+  3. **verdict** — a regression past the gate emits ``serve.rollback``
+     and leaves the registry untouched: the old version never stops
+     serving, bit-identically, for even one request (the rollback is
+     automatic because the bad model is never flipped in). A pass runs
+     ``registry.load`` — full warm (all four kinds), drain, flip — and
+     emits ``serve.promote``.
+
+Every publish fires the ``serve.canary`` fault site before the verdict,
+so chaos plans can fail the evaluation itself; ``tests/test_serve.py``
+hammers the gate under concurrent load and ``tests/test_serve_pool.py``
+runs the refresh → publish loop end-to-end.
+"""
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from xgboost_ray_tpu import faults, obs
+from xgboost_ray_tpu.serve.predictor import CompiledPredictor
+from xgboost_ray_tpu.serve.registry import ModelRegistry, coerce_model
+
+
+def binary_logloss(y_true: np.ndarray, y_prob: np.ndarray) -> float:
+    """Mean negative log-likelihood of binary labels under predicted
+    probabilities (the default canary metric; lower is better)."""
+    y = np.asarray(y_true, np.float64).reshape(-1)
+    p = np.clip(np.asarray(y_prob, np.float64).reshape(-1), 1e-7, 1 - 1e-7)
+    return float(-np.mean(y * np.log(p) + (1.0 - y) * np.log(1.0 - p)))
+
+
+def refresh(live_booster, params: Dict, dtrain, num_boost_round: int,
+            ray_params=None, **train_kwargs):
+    """Warm-start ``num_boost_round`` additional rounds from the live
+    booster on fresh data; returns the refreshed booster (publish it with
+    :meth:`CanaryController.publish`)."""
+    from xgboost_ray_tpu.main import train  # lazy: main imports serve
+
+    return train(
+        params, dtrain, num_boost_round,
+        ray_params=ray_params, xgb_model=live_booster, **train_kwargs,
+    )
+
+
+class CanaryController:
+    """Shadow + canary gate in front of a registry's hot-swap."""
+
+    def __init__(
+        self,
+        registry: ModelRegistry,
+        metric_fn: Callable[[np.ndarray, np.ndarray], float] = binary_logloss,
+        rel_tol: float = 0.02,
+        abs_tol: float = 1e-6,
+        metrics=None,
+    ):
+        self.registry = registry
+        self.metric_fn = metric_fn
+        # gate: candidate_metric <= live_metric * (1 + rel_tol) + abs_tol
+        self.rel_tol = float(rel_tol)
+        self.abs_tol = float(abs_tol)
+        self.metrics = metrics
+
+    def _candidate_predictor(self, booster) -> CompiledPredictor:
+        return CompiledPredictor(
+            booster,
+            devices=self.registry.devices,
+            min_bucket=self.registry.min_bucket,
+            layout=getattr(self.registry, "layout", "heap"),
+        )
+
+    def publish(
+        self,
+        candidate: Any,
+        canary_x: np.ndarray,
+        canary_y: np.ndarray,
+        shadow_x: Optional[np.ndarray] = None,
+        name: str = "",
+    ) -> Dict[str, Any]:
+        """Evaluate ``candidate`` against the live model and flip only on a
+        pass. Returns the verdict dict (``promoted``, both metric values,
+        the serving version, and the shadow divergence when measured)."""
+        booster = coerce_model(candidate)
+        canary_x = np.asarray(canary_x, np.float32)
+        canary_y = np.asarray(canary_y)
+        if not self.registry.has_model:
+            # cold start: nothing to canary against — publish directly
+            version = self.registry.load(booster, name=name)
+            obs.get_tracer().event(
+                "serve.promote", version=version, reason="cold_start",
+            )
+            if self.metrics is not None:
+                self.metrics.observe_canary(promoted=True)
+            return {"promoted": True, "version": version,
+                    "reason": "cold_start"}
+
+        cand_pred = self._candidate_predictor(booster)
+        with self.registry.lease() as live:
+            live_version = live.version
+            shadow_delta = None
+            if shadow_x is not None:
+                shadow_x = np.asarray(shadow_x, np.float32)
+                live_out = live.predictor.predict(shadow_x, "value")
+                cand_out = cand_pred.predict(shadow_x, "value")
+                shadow_delta = float(
+                    np.mean(np.abs(
+                        np.asarray(cand_out, np.float64)
+                        - np.asarray(live_out, np.float64)
+                    ))
+                )
+                obs.get_tracer().event(
+                    "serve.shadow",
+                    live_version=live_version,
+                    rows=int(shadow_x.shape[0]),
+                    mean_abs_delta=round(shadow_delta, 6),
+                )
+            faults.fire(
+                "serve.canary",
+                live_version=live_version, rows=int(canary_x.shape[0]),
+            )
+            live_metric = self.metric_fn(
+                canary_y, live.predictor.predict(canary_x, "value")
+            )
+        cand_metric = self.metric_fn(
+            canary_y, cand_pred.predict(canary_x, "value")
+        )
+        gate = live_metric * (1.0 + self.rel_tol) + self.abs_tol
+        verdict: Dict[str, Any] = {
+            "live_version": live_version,
+            "live_metric": live_metric,
+            "candidate_metric": cand_metric,
+            "gate": gate,
+        }
+        if shadow_delta is not None:
+            verdict["shadow_mean_abs_delta"] = shadow_delta
+        if cand_metric > gate:
+            # regression: never flip — the live version keeps serving
+            # bit-identically; this IS the automatic rollback
+            obs.get_tracer().event(
+                "serve.rollback",
+                live_version=live_version,
+                live_metric=round(live_metric, 6),
+                candidate_metric=round(cand_metric, 6),
+            )
+            if self.metrics is not None:
+                self.metrics.observe_canary(promoted=False)
+            verdict.update(promoted=False, version=live_version,
+                           reason="metric_regression")
+            return verdict
+        version = self.registry.load(booster, name=name)
+        obs.get_tracer().event(
+            "serve.promote",
+            version=version,
+            live_metric=round(live_metric, 6),
+            candidate_metric=round(cand_metric, 6),
+        )
+        if self.metrics is not None:
+            self.metrics.observe_canary(promoted=True)
+        verdict.update(promoted=True, version=version, reason="gate_pass")
+        return verdict

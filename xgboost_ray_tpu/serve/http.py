@@ -37,7 +37,8 @@ from xgboost_ray_tpu.serve.batcher import (
     ShuttingDownError,
 )
 from xgboost_ray_tpu.serve.metrics import ServeMetrics
-from xgboost_ray_tpu.serve.predictor import compile_count
+from xgboost_ray_tpu.serve.pool import NoReplicasError, Router
+from xgboost_ray_tpu.serve.predictor import KINDS, compile_count
 from xgboost_ray_tpu.serve.registry import ModelRegistry, NoModelError
 
 
@@ -150,7 +151,7 @@ class _Handler(BaseHTTPRequestHandler):
             # shed counted once, in the batcher, when the cap rejected it
             self._reply(429, {"error": str(exc)})
             return
-        except (NoModelError, ShuttingDownError) as exc:
+        except (NoModelError, NoReplicasError, ShuttingDownError) as exc:
             self._reply(503, {"error": str(exc)})
             return
         except (ValueError, TypeError) as exc:
@@ -206,15 +207,18 @@ class ServeHandle:
         max_batch: int = 256,
         max_delay_ms: float = 2.0,
         min_bucket: int = 8,
-        warm_kinds: tuple = ("value",),
+        warm_kinds: tuple = KINDS,
         max_queue_rows: int = 0,
         breaker_threshold: int = 5,
+        n_replicas: int = 1,
+        layout: str = "heap",
     ):
         self._draining = False
         self.metrics = ServeMetrics(recompile_count_fn=compile_count)
         self.registry = ModelRegistry(
             devices=devices,
             min_bucket=min_bucket,
+            layout=layout,
             warm_kinds=warm_kinds,
             warm_max_batch=max_batch,
             metrics=self.metrics,
@@ -229,14 +233,32 @@ class ServeHandle:
         try:
             if model is not None:
                 self.registry.load(model)
-            self.batcher = MicroBatcher(
-                self.registry,
-                max_batch=max_batch,
-                max_delay_ms=max_delay_ms,
-                metrics=self.metrics,
-                max_queue_rows=max_queue_rows,
-                breaker_threshold=breaker_threshold,
-            )
+            if n_replicas > 1:
+                # Router duck-types the batcher surface (submit/drain/
+                # shutdown/queue_depth/breaker_open), so everything below
+                # — and every handler — is replica-count agnostic
+                self.batcher = Router(
+                    self.registry,
+                    n_replicas=n_replicas,
+                    metrics=self.metrics,
+                    max_batch=max_batch,
+                    max_delay_ms=max_delay_ms,
+                    max_queue_rows=max_queue_rows,
+                    breaker_threshold=breaker_threshold,
+                    layout=layout,
+                    devices=devices,
+                    min_bucket=min_bucket,
+                )
+                self.metrics.replica_count_fn = self.batcher.live_replicas
+            else:
+                self.batcher = MicroBatcher(
+                    self.registry,
+                    max_batch=max_batch,
+                    max_delay_ms=max_delay_ms,
+                    metrics=self.metrics,
+                    max_queue_rows=max_queue_rows,
+                    breaker_threshold=breaker_threshold,
+                )
         except BaseException:
             self._httpd.server_close()
             raise

@@ -47,6 +47,9 @@ _COUNTER_NAMES = (
     "batch_rows",
     "padded_rows",
     "model_swaps",
+    "admission_rejects",
+    "canary_promotions",
+    "canary_rollbacks",
 )
 
 
@@ -65,6 +68,7 @@ class ServeMetrics:
         queue_depth_fn: Optional[Callable[[], int]] = None,
         recompile_count_fn: Optional[Callable[[], int]] = None,
         breaker_fn: Optional[Callable[[], Dict[str, int]]] = None,
+        replica_count_fn: Optional[Callable[[], int]] = None,
         registry: Optional[MetricsRegistry] = None,
     ):
         self.registry = registry if registry is not None else MetricsRegistry()
@@ -87,6 +91,8 @@ class ServeMetrics:
         # injected by the front-end: live degradation-breaker state
         # {"breaker_open": 0|1, "consecutive_predictor_failures": n}
         self.breaker_fn = breaker_fn
+        # injected by the router: live replica count (None = unreplicated)
+        self.replica_count_fn = replica_count_fn
         # the compile counter is process-global (the program cache is shared
         # so hot-swaps reuse programs); report compiles SINCE this endpoint
         # came up (re-baselined by reset()), not the process total
@@ -107,6 +113,12 @@ class ServeMetrics:
             fn=lambda: int((self.breaker_fn() or {}).get("breaker_open", 0))
             if self.breaker_fn
             else 0,
+        )
+        self.registry.gauge(
+            "rxgb_serve_replicas",
+            fn=lambda: (
+                int(self.replica_count_fn()) if self.replica_count_fn else 1
+            ),
         )
         self.registry.gauge(
             "rxgb_serve_recompile_count",
@@ -150,6 +162,18 @@ class ServeMetrics:
     def model_swaps(self) -> int:
         return self._c["model_swaps"].value
 
+    @property
+    def admission_rejects(self) -> int:
+        return self._c["admission_rejects"].value
+
+    @property
+    def canary_promotions(self) -> int:
+        return self._c["canary_promotions"].value
+
+    @property
+    def canary_rollbacks(self) -> int:
+        return self._c["canary_rollbacks"].value
+
     def reset(self) -> None:
         """Zero every counter and restart the clock — used by the closed-loop
         bench to exclude its warmup traffic from the measured window."""
@@ -182,6 +206,19 @@ class ServeMetrics:
     def observe_swap(self) -> None:
         self._c["model_swaps"].inc()
 
+    def observe_admission_reject(self) -> None:
+        """The router refused a request at the door (per-model admission
+        control): the pool's queued rows would exceed the configured cap."""
+        self._c["admission_rejects"].inc()
+
+    def observe_canary(self, promoted: bool) -> None:
+        """A canary publish concluded: the candidate was promoted (flip)
+        or rolled back (old version kept serving)."""
+        if promoted:
+            self._c["canary_promotions"].inc()
+        else:
+            self._c["canary_rollbacks"].inc()
+
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             elapsed = max(time.monotonic() - self._started, 1e-9)
@@ -211,9 +248,14 @@ class ServeMetrics:
             "latency_p99_ms": round(hist["p99_ms"], 4),
             "latency_mean_ms": round(hist["mean_ms"], 4),
             "model_swaps": self.model_swaps,
+            "admission_rejects": self.admission_rejects,
+            "canary_promotions": self.canary_promotions,
+            "canary_rollbacks": self.canary_rollbacks,
         }
         if self.queue_depth_fn is not None:
             snap["queue_depth"] = int(self.queue_depth_fn())
+        if self.replica_count_fn is not None:
+            snap["replicas"] = int(self.replica_count_fn())
         if self.breaker_fn is not None:
             snap.update(self.breaker_fn())
         if self.recompile_count_fn is not None:

@@ -32,12 +32,19 @@ import jax.numpy as jnp
 
 from xgboost_ray_tpu import progreg
 from xgboost_ray_tpu.constants import AXIS_ACTORS
+from xgboost_ray_tpu.ops import node_array as node_array_ops
 from xgboost_ray_tpu.ops import predict as predict_ops
 from xgboost_ray_tpu.ops.grow import Tree
 
 #: output kinds this layer can serve, mapped to the batch-path flag they
 #: must stay bit-identical to
 KINDS = ("value", "margin", "leaf", "contribs")
+
+#: forest layouts the predictor can walk: the padded heap (per-tree
+#: depth-first walk, the batch path's layout) and the FIL-style breadth-
+#: first node-array (level-synchronous gathers; see ops/node_array.py).
+#: Both serve bitwise-identical outputs; node_array targets lower p99.
+LAYOUTS = ("heap", "node_array")
 
 _lock = threading.Lock()
 _COMPILE_COUNT = 0
@@ -101,7 +108,8 @@ class CompiledPredictor:
     rows back out.
     """
 
-    def __init__(self, booster, devices=None, min_bucket: int = 8):
+    def __init__(self, booster, devices=None, min_bucket: int = 8,
+                 layout: str = "heap"):
         sig = getattr(booster, "signature", None)
         if sig is None:
             raise TypeError(
@@ -109,9 +117,14 @@ class CompiledPredictor:
                 f"{type(booster).__name__} — gblinear models have no padded "
                 f"forest walk to compile."
             )
+        if layout not in LAYOUTS:
+            raise ValueError(
+                f"unknown forest layout {layout!r}; one of {LAYOUTS}"
+            )
         self.booster = booster
         self.devices = list(devices) if devices else [jax.devices()[0]]
         self.min_bucket = int(min_bucket)
+        self.layout = layout
         self.signature = booster.signature()
         self._key_base = (
             self.signature,
@@ -130,6 +143,15 @@ class CompiledPredictor:
             dev = self.devices[0]
             put = lambda a: jax.device_put(a, dev)  # noqa: E731
         self.forest_dev = Tree(*[put(np.asarray(f)) for f in booster.forest])
+        if layout == "node_array":
+            # the level-major permutation of the same heap; forest_dev is
+            # kept alongside because contribs stays on the heap program
+            na_host = node_array_ops.forest_to_node_array(
+                booster.forest, booster.max_depth
+            )
+            self.na_dev = node_array_ops.NodeForest(*[put(f) for f in na_host])
+        else:
+            self.na_dev = None
         self.has_tw = booster.tree_weights is not None
         self.tw_dev = put(
             np.asarray(booster.tree_weights, np.float32)
@@ -148,11 +170,23 @@ class CompiledPredictor:
             cat_features=b.cat_features,
         )
 
+    def _uses_node_array(self, kind: str) -> bool:
+        # contribs needs base_weight/cover path statistics the node array
+        # does not carry — it routes to the (shared) heap program, so a
+        # node-array predictor's contribs hit the same cache entry a heap
+        # predictor's do and stay trivially bitwise-identical
+        return self.layout == "node_array" and kind != "contribs"
+
     def _program(self, kind: str):
         # "value" and "margin" trace the identical program (they differ only
         # in host-side _finalize) — share one cache entry so warming either
         # warms both and neither ever compiles twice
         prog_kind = "margin" if kind == "value" else kind
+        if self._uses_node_array(kind):
+            key = self._key_base + (prog_kind, "node_array")
+            return _cached_program(
+                key, lambda: self._build_program_na(prog_kind)
+            )
         key = self._key_base + (prog_kind,)
         return _cached_program(key, lambda: self._build_program(prog_kind))
 
@@ -210,6 +244,52 @@ class CompiledPredictor:
 
         raise ValueError(f"unknown serve output kind {kind!r}; one of {KINDS}")
 
+    def _build_program_na(self, kind: str):
+        """Node-array twin of :meth:`_build_program`: same calling
+        convention (model, tw, x, base) with the flat :class:`NodeForest`
+        in the model slot, same sharding story as the heap programs."""
+        kw = self._kernel_kwargs()
+        has_tw = self.has_tw
+        n_dev = len(self.devices)
+
+        if kind == "margin":
+            def body(na, tw, x, base):
+                _count_trace()
+                return node_array_ops.predict_margin_na(
+                    na, x, base, tree_weights=tw if has_tw else None, **kw
+                )
+
+            if n_dev > 1:
+                from jax.sharding import PartitionSpec as P
+
+                from xgboost_ray_tpu.compat import shard_map_compat as shard_map
+
+                return jax.jit(
+                    shard_map(
+                        body, mesh=self._mesh,
+                        in_specs=(P(), P(), P(AXIS_ACTORS), P(AXIS_ACTORS)),
+                        out_specs=P(AXIS_ACTORS),
+                    )
+                )
+            return jax.jit(body)
+
+        if kind == "leaf":
+            max_depth = kw["max_depth"]
+            cat_features = kw["cat_features"]
+
+            def body(na, tw, x, base):
+                _count_trace()
+                return node_array_ops.predict_leaf_index_na(
+                    na, x, max_depth, cat_features=cat_features
+                )
+
+            return jax.jit(body)
+
+        raise ValueError(
+            f"no node-array program for kind {kind!r} (contribs routes to "
+            f"the heap program)"
+        )
+
     # -- execution ---------------------------------------------------------
 
     def predict(self, x: np.ndarray, kind: str = "value") -> np.ndarray:
@@ -245,7 +325,10 @@ class CompiledPredictor:
             base_dev = jax.device_put(base, self.devices[0])
         prog = self._program(kind)
         self._note_program(kind, bucket, prog, (xb_dev, base_dev))
-        res = prog(self.forest_dev, self.tw_dev, xb_dev, base_dev)
+        model_dev = (
+            self.na_dev if self._uses_node_array(kind) else self.forest_dev
+        )
+        res = prog(model_dev, self.tw_dev, xb_dev, base_dev)
         out = np.asarray(res)[:n]
         return self._finalize(out, kind), bucket
 
@@ -257,17 +340,26 @@ class CompiledPredictor:
         if not progreg.enabled():
             return
         prog_kind = "margin" if kind == "value" else kind
+        meta = {
+            "world": len(self.devices),
+            "bucket": int(bucket),
+            "grower": "serve",
+            "hist_quant": "none",
+            "sampling": "none",
+        }
+        if self._uses_node_array(kind):
+            # own meta coordinate: node-array programs form their own
+            # verify identity groups instead of colliding with the heap
+            # walk's (same name, different jaxpr)
+            meta["layout"] = "node_array"
+            model_dev = self.na_dev
+        else:
+            model_dev = self.forest_dev
         progreg.note_jit_call(
             f"serve.predict_{prog_kind}",
             prog,
-            (self.forest_dev, self.tw_dev) + tuple(row_args),
-            meta={
-                "world": len(self.devices),
-                "bucket": int(bucket),
-                "grower": "serve",
-                "hist_quant": "none",
-                "sampling": "none",
-            },
+            (model_dev, self.tw_dev) + tuple(row_args),
+            meta=meta,
         )
 
     def register_programs(self, kinds=KINDS, batch: int = 8) -> None:

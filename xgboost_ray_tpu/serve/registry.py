@@ -82,8 +82,12 @@ class ModelRegistry:
 
     devices: Optional[list] = None
     min_bucket: int = 8
-    #: kinds precompiled on load (before the swap becomes visible)
-    warm_kinds: tuple = ("value",)
+    #: forest layout compiled into the predictor ("heap" or "node_array")
+    layout: str = "heap"
+    #: kinds precompiled on load (before the swap becomes visible); all
+    #: four by default so the first request of ANY kind — value, margin,
+    #: leaf, contribs — hits a warm program after a publish
+    warm_kinds: tuple = KINDS
     #: largest batch the warmup covers; align with the batcher's max_batch
     warm_max_batch: int = 256
     metrics: Optional[Any] = None  # ServeMetrics, for the swap counter
@@ -119,10 +123,17 @@ class ModelRegistry:
             faults.fire("registry.swap", version=next_version)
             booster = coerce_model(model)
             predictor = CompiledPredictor(
-                booster, devices=self.devices, min_bucket=self.min_bucket
+                booster,
+                devices=self.devices,
+                min_bucket=self.min_bucket,
+                layout=self.layout,
             )
             if warm and self.warm_kinds:
                 kinds = [k for k in self.warm_kinds if k in KINDS]
+                if not getattr(booster, "_has_node_stats", True):
+                    # imported-JSON boosters without per-node stats cannot
+                    # run exact TreeSHAP; warming contribs would raise
+                    kinds = [k for k in kinds if k != "contribs"]
                 predictor.warmup(kinds=kinds, max_batch=self.warm_max_batch)
             with self._cond:
                 # serialize vs the drain; leases block only during the flip
