@@ -383,7 +383,8 @@ def elastic_recovery_tripwire(current_chaos, prev_rec, prev_name=None,
     better, < 1 means continuation keeps its edge), compared for the base
     pairing AND the per-config pairings (``elastic_2d`` /
     ``elastic_streamed`` — the 2D-mesh and streamed arms that used to be
-    fallback cases). Returns ``{prev_ratio, prev_record, ratio, fired[,
+    fallback cases — and ``elastic_domain``, the correlated host-loss
+    arm). Returns ``{prev_ratio, prev_record, ratio, fired[,
     arms]}`` or None when no comparable record exists (different backend,
     no recorded base pairing); ``fired`` is True when ANY arm regresses
     past the threshold. Like-for-like only: a different chaos config is
@@ -433,7 +434,7 @@ def elastic_recovery_tripwire(current_chaos, prev_rec, prev_name=None,
     if base_config_matches and ratio > threshold:
         _fire("base", float(cur), float(prev), ratio)
     arms = {}
-    for key in ("elastic_2d", "elastic_streamed"):
+    for key in ("elastic_2d", "elastic_streamed", "elastic_domain"):
         cur_arm = current_chaos.get(key) or {}
         prev_arm = prev_chaos.get(key) or {}
         c = (cur_arm.get("continue_vs_restart") or {}).get("ratio")
@@ -1644,7 +1645,8 @@ def _timeline_fault_events(timeline):
     records instead of a prose description of what the soak did."""
     names = {
         "fault.injected", "failure.detected", "world.shrink", "world.grow",
-        "world.restart", "recovered", "backoff",
+        "world.restart", "recovered", "backoff", "world.domain_down",
+        "world.domain_up", "world.deaths_coalesced",
     }
     out = []
     for rec in timeline or []:
@@ -1654,7 +1656,8 @@ def _timeline_fault_events(timeline):
         if "round" in rec:
             row["round"] = rec["round"]
         attrs = rec.get("attrs") or {}
-        for k in ("world", "ranks", "site", "action", "orphaned_rows"):
+        for k in ("world", "ranks", "site", "action", "orphaned_rows",
+                  "domain", "extra"):
             if k in attrs:
                 row[k] = attrs[k]
         out.append(row)
@@ -1954,19 +1957,62 @@ def run_chaos_measurement():
                     "chunk_rows": chunk_rows, "kill_round": arm_kill,
                     "max_depth": 6},
         )
+        # correlated host loss: a whole fault domain (2 of 4 ranks under
+        # RXGB_FAULT_DOMAINS=2) dies at once — the continue arm must fold
+        # both deaths into ONE shrink (or one immediate reintegration),
+        # never two sequential recompile cycles
+        actors_dom = 4
+        if actors_dom <= len(jax.devices()):
+            section["elastic_domain"] = _paired_continue_vs_restart(
+                label="domain",
+                params=params,
+                make_dmatrix=lambda: RayDMatrix(ax, ay),
+                x=ax,
+                rounds=arm_rounds, actors=actors_dom, kill_round=arm_kill,
+                config={"rows": arm_rows, "rounds": arm_rounds,
+                        "actors": actors_dom, "fault_domains": 2,
+                        "kill_round": arm_kill, "max_depth": 6},
+                kill_rule={"site": "actor.train_round",
+                           "action": "domain_kill", "domain": 1,
+                           "ranks": [actors_dom - 1],
+                           "match": {"round": arm_kill},
+                           "message": "chaos: correlated domain kill"},
+                extra_env={"RXGB_FAULT_DOMAINS": "2"},
+            )
     print(f"[bench] chaos section: {section}", file=sys.stderr)
     return section
 
 
 def _paired_continue_vs_restart(label, params, make_dmatrix, x, rounds,
-                                actors, kill_round, config):
+                                actors, kill_round, config,
+                                kill_rule=None, extra_env=None):
     """One restart-vs-continue pairing for a specific training config: the
     same deterministic kill, once under the restart-from-checkpoint policy
     and once under elastic in-flight continuation (immediate
     reintegration). Returns the arm dict with both recoveries, the
     continue arm's zero-replay/identity verdicts, and the
-    ``continue_vs_restart`` ratio the elastic tripwire tracks."""
+    ``continue_vs_restart`` ratio the elastic tripwire tracks.
+
+    ``kill_rule`` overrides the default single-rank kill (the
+    ``elastic_domain`` arm injects a correlated ``domain_kill`` instead);
+    ``extra_env`` sets env vars for BOTH chaos runs (e.g.
+    ``RXGB_FAULT_DOMAINS``) so the pairing stays like-for-like."""
     from xgboost_ray_tpu import RayParams, faults, train
+
+    @contextlib.contextmanager
+    def _arm_env():
+        saved = {}
+        for k, v in (extra_env or {}).items():
+            saved[k] = os.environ.get(k)
+            os.environ[k] = v
+        try:
+            yield
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
 
     noop = faults.FaultPlan(rules=[{
         "site": "actor.train_round", "action": "raise",
@@ -1979,7 +2025,7 @@ def _paired_continue_vs_restart(label, params, make_dmatrix, x, rounds,
     ref_margin = ref.predict(x, output_margin=True)
 
     def kill_plan():
-        return faults.FaultPlan(rules=[{
+        return faults.FaultPlan(rules=[dict(kill_rule) if kill_rule else {
             "site": "actor.train_round", "action": "raise",
             "match": {"round": kill_round}, "ranks": [actors - 1],
             "message": f"chaos: scheduled rank kill ({label})",
@@ -1987,7 +2033,7 @@ def _paired_continue_vs_restart(label, params, make_dmatrix, x, rounds,
 
     # restart-from-checkpoint policy
     res_r = {}
-    with faults.active_plan(kill_plan()):
+    with _arm_env(), faults.active_plan(kill_plan()):
         bst_r = train(params, make_dmatrix(), rounds, additional_results=res_r,
                       ray_params=RayParams(num_actors=actors,
                                            checkpoint_frequency=2,
@@ -2000,7 +2046,7 @@ def _paired_continue_vs_restart(label, params, make_dmatrix, x, rounds,
 
     # elastic in-flight continuation, immediate reintegration
     res_c = {}
-    with _immediate_reintegration_env():
+    with _arm_env(), _immediate_reintegration_env():
         with faults.active_plan(kill_plan()):
             bst_c = train(params, make_dmatrix(), rounds,
                           additional_results=res_c,
@@ -2029,6 +2075,8 @@ def _paired_continue_vs_restart(label, params, make_dmatrix, x, rounds,
             "rounds_replayed": rob_c.get("rounds_replayed", 0),
             "shrinks": rob_c.get("shrinks", 0),
             "grows": rob_c.get("grows", 0),
+            "domains_lost": rob_c.get("domains_lost", 0),
+            "deaths_coalesced": rob_c.get("deaths_coalesced", 0),
             "model_matches": bool(np.allclose(
                 bst_c.predict(x, output_margin=True), ref_margin, atol=1e-5
             )),
@@ -2783,7 +2831,7 @@ def chaos_only_main():
             ok = ok and cvr["continue_faster"]
     # the per-config pairings carry the same contract: zero replay,
     # uninterrupted-model identity, continuation strictly faster
-    for key in ("elastic_2d", "elastic_streamed"):
+    for key in ("elastic_2d", "elastic_streamed", "elastic_domain"):
         arm = section.get(key)
         if arm is None:
             continue

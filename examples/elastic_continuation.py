@@ -14,10 +14,16 @@ CI smoke (kill + reintegrate via env):
         "action": "raise", "ranks": [1], "match": {"round": 3}}]}' \
     python examples/elastic_continuation.py
 
-Config knobs (the CI smokes run the 2D-mesh and streamed variants through
-the same script — every shipped gbtree configuration continues in-flight):
+Config knobs (the CI smokes run the 2D-mesh, streamed, and domain-kill
+variants through the same script — every shipped gbtree configuration
+continues in-flight):
     RXGB_SMOKE_FEATURE_PARALLEL=2   # train on the 2D (R, C) mesh
     RXGB_SMOKE_STREAM=1             # streamed (out-of-core) ingestion
+    RXGB_SMOKE_ACTORS=4             # world size (the domain smoke needs a
+                                    # multi-rank fault domain)
+    RXGB_FAULT_DOMAINS=2            # partition ranks into fault domains so
+                                    # a domain_kill plan takes out a whole
+                                    # "host" at once
 """
 
 import os
@@ -47,14 +53,16 @@ def main():
     else:
         dtrain = RayDMatrix(x, y)
 
+    actors = int(os.environ.get("RXGB_SMOKE_ACTORS", "2"))
     res = {}
     bst = train(
         params,
         dtrain,
         8,
         additional_results=res,
-        ray_params=RayParams(num_actors=2, elastic_training=True,
-                             max_failed_actors=1, max_actor_restarts=2,
+        ray_params=RayParams(num_actors=actors, elastic_training=True,
+                             max_failed_actors=actors - 1,
+                             max_actor_restarts=2,
                              checkpoint_frequency=2),
     )
     rob = res["robustness"]
@@ -69,6 +77,11 @@ def main():
         assert rob["restarts"] == 0, rob
         assert rob["shrinks"] + rob["grows"] >= 1, rob
         assert res["total_n"] == len(x), res["total_n"]
+        if os.environ.get("RXGB_FAULT_DOMAINS"):
+            # the domain smoke's correlated kill must read as ONE incident:
+            # a lost domain, its extra deaths folded into the same recovery
+            assert rob["domains_lost"] >= 1, rob
+            assert rob["deaths_coalesced"] >= 1, rob
         print("elastic continuation smoke OK (zero replay, world restored)")
 
 

@@ -65,3 +65,13 @@ PYTHONPATH=".:$PYTHONPATH" \
 RXGB_SMOKE_STREAM=1 \
 RXGB_FAULT_PLAN='{"rules": [{"site": "actor.train_round", "action": "raise", "ranks": [1], "match": {"round": 3}}]}' \
     python examples/elastic_continuation.py
+echo "========= Running domain-kill elastic-continuation chaos smoke ========="
+# correlated host loss: RXGB_FAULT_DOMAINS=2 partitions the 4 ranks into 2
+# fault domains and the plan kills ALL of domain 1 (ranks 2+3) at once —
+# the deaths must coalesce into ONE recovery (zero replay, no restart,
+# domains_lost/deaths_coalesced reported) and the world must be restored
+PYTHONPATH=".:$PYTHONPATH" \
+RXGB_SMOKE_ACTORS=4 \
+RXGB_FAULT_DOMAINS=2 \
+RXGB_FAULT_PLAN='{"rules": [{"site": "actor.train_round", "action": "domain_kill", "domain": 1, "ranks": [2], "match": {"round": 3}}]}' \
+    python examples/elastic_continuation.py

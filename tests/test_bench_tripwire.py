@@ -319,13 +319,22 @@ def _arm(ratio, cfg):
     }
 
 
+_ARM_CFG_DOMAIN = {"rows": 8000, "rounds": 12, "actors": 4,
+                   "fault_domains": 2, "kill_round": 5, "max_depth": 6}
+
+
 def _full_elastic_section(base_ratio, ratio_2d, ratio_streamed,
-                          cfg_2d=None, cfg_streamed=None):
+                          cfg_2d=None, cfg_streamed=None,
+                          ratio_domain=None, cfg_domain=None):
     sec = _elastic_chaos_section(base_ratio)
     sec["elastic_2d"] = _arm(ratio_2d, cfg_2d or _ARM_CFG_2D)
     sec["elastic_streamed"] = _arm(
         ratio_streamed, cfg_streamed or _ARM_CFG_STREAMED
     )
+    if ratio_domain is not None:
+        sec["elastic_domain"] = _arm(
+            ratio_domain, cfg_domain or _ARM_CFG_DOMAIN
+        )
     return sec
 
 
@@ -404,6 +413,42 @@ def test_elastic_tripwire_tolerates_records_without_arms(capsys):
     )
     assert out is not None and not out["fired"]
     assert "arms" not in out
+    assert "ELASTIC TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_elastic_tripwire_fires_on_domain_arm_regression(capsys):
+    """The correlated host-loss arm is tripwired like the others: the base
+    pairing and the single-rank arms holding steady must not mask a
+    regression of the coalesced-shrink recovery (0.2 -> 0.45 on
+    elastic_domain alone fires, tagged per arm)."""
+    rec = {"metric": "m", "backend": "cpu",
+           "chaos": _full_elastic_section(0.2, 0.2, 0.2, ratio_domain=0.2)}
+    out = bench.elastic_recovery_tripwire(
+        _full_elastic_section(0.2, 0.2, 0.2, ratio_domain=0.45), rec,
+        "BENCH_r18.json", backend="cpu",
+    )
+    assert out is not None and out["fired"]
+    assert out["ratio"] == 1.0  # base steady
+    assert out["arms"]["elastic_domain"]["fired"]
+    assert out["arms"]["elastic_domain"]["ratio"] == 2.25
+    assert not out["arms"]["elastic_2d"]["fired"]
+    assert "ELASTIC TRIPWIRE [elastic_domain]" in capsys.readouterr().err
+
+
+def test_elastic_tripwire_domain_arm_config_mismatch_quiet(capsys):
+    """Changing the domain layout (fault_domains 2 -> 4) is a different
+    experiment: the arm reports config_mismatch and never fires, however
+    bad the ratio looks."""
+    prev = _full_elastic_section(0.2, 0.2, 0.2, ratio_domain=0.2)
+    cur = _full_elastic_section(
+        0.2, 0.2, 0.2, ratio_domain=0.9,
+        cfg_domain=dict(_ARM_CFG_DOMAIN, fault_domains=4),
+    )
+    rec = {"metric": "m", "backend": "cpu", "chaos": prev}
+    out = bench.elastic_recovery_tripwire(cur, rec, "x", backend="cpu")
+    assert out is not None and not out["fired"]
+    assert out["arms"]["elastic_domain"]["config_mismatch"] is True
+    assert not out["arms"]["elastic_domain"]["fired"]
     assert "ELASTIC TRIPWIRE" not in capsys.readouterr().err
 
 

@@ -1,0 +1,131 @@
+"""Unit tests for the fault-domain plane (``xgboost_ray_tpu.domains``).
+
+The domain map is the static rank -> failure-unit assignment the elastic
+driver derives once per attempt; these tests pin the three-tier derivation
+order (env partition > multi-host process_index > per-rank default) and the
+DeathCoalescer mailbox semantics the coalesced-shrink path depends on.
+"""
+
+import threading
+
+from xgboost_ray_tpu.domains import (
+    DeathCoalescer,
+    DomainMap,
+    derive_domain_map,
+    logical_domain_of,
+)
+
+
+class _Dev:
+    """Minimal stand-in for a jax device: only process_index is consulted."""
+
+    def __init__(self, process_index):
+        self.process_index = process_index
+
+
+def test_logical_partition_is_contiguous_and_clamped():
+    # H=2 over 4 ranks: two contiguous halves
+    assert [logical_domain_of(r, 4, 2) for r in range(4)] == [0, 0, 1, 1]
+    # H=3 over 8 ranks: contiguous groups, sizes as even as floor-div allows
+    assert [logical_domain_of(r, 8, 3) for r in range(8)] == \
+        [0, 0, 0, 1, 1, 1, 2, 2]
+    # more domains than ranks clamps to per-rank
+    assert [logical_domain_of(r, 4, 8) for r in range(4)] == [0, 1, 2, 3]
+    # H<=1 degenerates to a single domain
+    assert [logical_domain_of(r, 4, 1) for r in range(4)] == [0, 0, 0, 0]
+
+
+def test_domain_map_api():
+    dm = DomainMap({0: 0, 1: 0, 2: 1})
+    assert dm.domain_of(1) == 0 and dm.domain_of(2) == 1
+    assert dm.ranks_of(0) == (0, 1)
+    assert dm.ranks_of(1) == (2,)
+    assert dm.ranks_of(99) == ()  # unknown domain: empty, not KeyError
+    assert dm.domains() == [0, 1]
+    assert dm.domains_of([1, 2]) == [0, 1]
+    assert dm.domains_of([2, 7]) == [1]  # unknown ranks are ignored
+    assert dm.num_ranks == 3 and dm.num_domains == 2
+
+
+def test_derive_env_partition_wins_over_devices():
+    """Tier 1: an explicit RXGB_FAULT_DOMAINS partition overrides whatever
+    the device layout says — that's what makes host-loss behavior testable
+    on the single-process CI mesh."""
+    devices = [_Dev(0)] * 2 + [_Dev(1)] * 2
+    dm = derive_domain_map(4, devices=devices, logical_domains=2)
+    assert [dm.domain_of(r) for r in range(4)] == [0, 0, 1, 1]
+    dm3 = derive_domain_map(4, devices=devices, logical_domains=4)
+    assert [dm3.domain_of(r) for r in range(4)] == [0, 1, 2, 3]
+
+
+def test_derive_process_index_grouping():
+    """Tier 2: on a real multi-host mesh (distinct process_index values),
+    ranks inherit the host of their first backing device."""
+    devices = [_Dev(0)] * 4 + [_Dev(1)] * 4  # 4 actors x 2 devices each
+    dm = derive_domain_map(4, devices=devices, logical_domains=0)
+    assert [dm.domain_of(r) for r in range(4)] == [0, 0, 1, 1]
+    assert dm.ranks_of(1) == (2, 3)
+
+
+def test_derive_default_is_per_rank():
+    """Tier 3: single process, no override — every rank is its own domain,
+    preserving pre-domain per-rank elastic semantics exactly."""
+    for devices in (None, [], [_Dev(0)] * 4):
+        dm = derive_domain_map(4, devices=devices, logical_domains=0)
+        assert [dm.domain_of(r) for r in range(4)] == [0, 1, 2, 3]
+        assert dm.num_domains == 4
+
+
+def test_death_coalescer_note_drain():
+    co = DeathCoalescer()
+    assert not co.pending
+    co.note(2, domain=1)
+    co.note(3, domain=1)
+    co.note(2, domain=7)  # idempotent: first note's attribution wins
+    assert co.pending
+    assert co.drain() == {2: 1, 3: 1}
+    assert not co.pending
+    assert co.drain() == {}  # drain clears
+
+
+def test_death_coalescer_concurrent_notes_land_once():
+    """Ranks noted from many threads land in exactly one drained batch."""
+    co = DeathCoalescer()
+    drained = {}
+    stop = threading.Event()
+
+    def drainer():
+        while not stop.is_set():
+            drained.update(co.drain())
+        drained.update(co.drain())
+
+    t = threading.Thread(target=drainer)
+    t.start()
+    noters = [
+        threading.Thread(target=co.note, args=(r,), kwargs={"domain": r % 2})
+        for r in range(32)
+    ]
+    for n in noters:
+        n.start()
+    for n in noters:
+        n.join()
+    stop.set()
+    t.join()
+    assert sorted(drained) == list(range(32))
+    assert all(drained[r] == r % 2 for r in drained)
+
+
+def test_launcher_process_domain(monkeypatch):
+    """The launcher attributes cross-process failures with the same
+    contiguous RXGB_FAULT_DOMAINS layout the elastic plane uses; unset or
+    unparseable partitions attribute nothing (None, never a guess)."""
+    from xgboost_ray_tpu.launcher import _process_domain
+
+    monkeypatch.delenv("RXGB_FAULT_DOMAINS", raising=False)
+    assert _process_domain(1, 4) is None
+    monkeypatch.setenv("RXGB_FAULT_DOMAINS", "2")
+    assert [_process_domain(p, 4) for p in range(4)] == [0, 0, 1, 1]
+    monkeypatch.setenv("RXGB_FAULT_DOMAINS", "bogus")
+    assert _process_domain(1, 4) is None
+    monkeypatch.setenv("RXGB_FAULT_DOMAINS", "0")
+    assert _process_domain(1, 4) is None

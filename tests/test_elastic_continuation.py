@@ -161,6 +161,9 @@ def test_shrink_run_is_deterministic(monkeypatch):
     assert robs[0] == robs[1] == {
         "restarts": 0, "elastic_restarts": 0, "rounds_replayed": 0,
         "shrinks": 1, "grows": 0, "orphaned_rows": len(x) // 2,
+        # per-rank default domains: one dead rank IS one lost domain, and a
+        # single death folds nothing
+        "domains_lost": 1, "deaths_coalesced": 0,
     }
 
 
@@ -450,22 +453,166 @@ def test_2d_int8gh_shrink_composition(monkeypatch):
     assert np.array_equal(outs[0], outs[1])
 
 
-def test_gblinear_elastic_falls_back_to_restart(monkeypatch):
-    """gblinear is the one remaining restart-only booster (``LinearEngine``
-    has no ``can_reshard``; the driver's probe defaults to False) — an
-    elastic kill must still take the legacy restart-from-checkpoint path
-    instead of failing."""
+def test_gblinear_elastic_continues_in_flight(monkeypatch):
+    """gblinear lost its restart-only asterisk: ``LinearEngine`` carries
+    ``can_reshard``/``reset_from_booster`` now, so an elastic kill shrinks
+    the world in place — zero rounds replayed, no restart — and a rerun of
+    the same plan is bitwise identical (chaos-vs-chaos)."""
     monkeypatch.setenv("RXGB_ELASTIC_RESTART_DISABLED", "1")
     x, y = _data(128)
     params = dict(_PARAMS, booster="gblinear")
+    outs = []
+    for _ in range(2):
+        res = {}
+        with faults.active_plan(_kill_plan(3, [1])):
+            bst = train(params, RayDMatrix(x, y), 6, additional_results=res,
+                        ray_params=RayParams(num_actors=2,
+                                             elastic_training=True,
+                                             max_failed_actors=1,
+                                             max_actor_restarts=2,
+                                             checkpoint_frequency=2))
+        assert bst.num_boosted_rounds() == 6
+        rob = res["robustness"]
+        assert rob["rounds_replayed"] == 0
+        assert rob["restarts"] == 0 and rob["elastic_restarts"] == 0
+        assert rob["shrinks"] == 1 and rob["grows"] == 0
+        assert res["total_n"] == len(x) // 2
+        outs.append(bst.predict(x, output_margin=True))
+    assert np.array_equal(outs[0], outs[1])
+
+
+def test_gblinear_shrink_then_boundary_growback(monkeypatch):
+    """gblinear in the full elastic matrix: shrink in flight (the
+    replacement's reload is delayed past the scheduler's fast path), then
+    grow back at a round boundary — the grow revives the CACHED
+    ``LinearEngine`` via ``reset_from_booster`` (same world signature), so
+    the full world's rows are restored with zero replay and no restart."""
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_RESOURCE_CHECK_S", "0")
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_GRACE_PERIOD_S", "0")
+    x, y = _data(256)
+    params = dict(_PARAMS, booster="gblinear")
+    plan = faults.FaultPlan(rules=[
+        {"site": "actor.train_round", "action": "raise", "ranks": [1],
+         "match": {"round": 3}},
+        {"site": "actor.load_shard", "action": "delay", "delay_s": 2.0,
+         "match": {"rank": 1}, "at": 2},
+        # linear rounds are sub-millisecond once compiled (no per-world tree
+        # retrace to dwarf the reload delay), so pace the survivor: without
+        # this the 16 rounds finish before the replacement's reload does and
+        # the grow never gets its boundary
+        {"site": "actor.train_round", "action": "delay", "delay_s": 0.3,
+         "ranks": [0], "times": 0},
+    ])
     res = {}
-    with faults.active_plan(_kill_plan(3, [1])):
-        bst = train(params, RayDMatrix(x, y), 6, additional_results=res,
+    with faults.active_plan(plan):
+        bst = train(params, RayDMatrix(x, y), 16, additional_results=res,
                     ray_params=RayParams(num_actors=2, elastic_training=True,
                                          max_failed_actors=1,
                                          max_actor_restarts=2,
-                                         checkpoint_frequency=2))
-    assert bst.num_boosted_rounds() == 6
+                                         checkpoint_frequency=4))
+    assert bst.num_boosted_rounds() == 16
     rob = res["robustness"]
-    assert rob["shrinks"] == 0 and rob["grows"] == 0
-    assert rob["restarts"] == 1  # legacy elastic restart path took over
+    assert rob["rounds_replayed"] == 0
+    assert rob["restarts"] == 0 and rob["elastic_restarts"] == 0
+    assert rob["shrinks"] == 1
+    assert rob["grows"] == 1
+    assert res["total_n"] == len(x)  # the boundary grow restored the world
+
+
+def test_domain_kill_coalesces_to_one_shrink(monkeypatch):
+    """The tentpole acceptance: a correlated host loss (``domain_kill`` takes
+    out BOTH ranks of fault domain 1 at once) produces exactly ONE shrink —
+    one retrace, zero replay — with the extra death folded into
+    ``deaths_coalesced`` and the incident visible as ``world.domain_down`` /
+    ``world.deaths_coalesced`` in the timeline.  Chaos-vs-chaos reruns are
+    bitwise identical."""
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_DISABLED", "1")
+    monkeypatch.setenv("RXGB_FAULT_DOMAINS", "2")
+    x, y = _data(512)
+    outs = []
+    for _ in range(2):
+        # fresh plan per run: rule occurrence counters live with the plan
+        plan = faults.FaultPlan(rules=[{
+            "site": "actor.train_round", "action": "domain_kill", "domain": 1,
+            "ranks": [2], "match": {"round": 3}}])
+        res = {}
+        with faults.active_plan(plan):
+            bst = train(_PARAMS, RayDMatrix(x, y), 6, additional_results=res,
+                        ray_params=RayParams(num_actors=4,
+                                             elastic_training=True,
+                                             max_failed_actors=2,
+                                             max_actor_restarts=2,
+                                             checkpoint_frequency=2))
+        assert bst.num_boosted_rounds() == 6
+        rob = res["robustness"]
+        assert rob["rounds_replayed"] == 0
+        assert rob["restarts"] == 0 and rob["elastic_restarts"] == 0
+        # two simultaneous deaths, ONE shrink: the second death is folded
+        assert rob["shrinks"] == 1 and rob["grows"] == 0
+        assert rob["deaths_coalesced"] == 1
+        assert rob["domains_lost"] == 1
+        assert res["total_n"] == len(x) // 2  # domain 1's rows orphaned
+
+        by_name = {}
+        for e in res["obs"]["events"]:
+            by_name.setdefault(e["name"], []).append(e)
+        # one fault.injected per rank of the domain, sharing the domain attr
+        injected = by_name["fault.injected"]
+        assert sorted(e["attrs"]["rank"] for e in injected) == [2, 3]
+        assert {e["attrs"]["domain"] for e in injected} == {1}
+        (down,) = by_name["world.domain_down"]
+        assert down["attrs"]["domain"] == 1
+        assert down["attrs"]["ranks"] == [2, 3]
+        (fold,) = by_name["world.deaths_coalesced"]
+        assert fold["attrs"]["ranks"] == [2, 3]
+        assert fold["attrs"]["extra"] == 1
+        (shrink,) = by_name["world.shrink"]
+        assert shrink["attrs"]["world"] == 2
+        outs.append(bst.predict(x, output_margin=True))
+    assert np.array_equal(outs[0], outs[1])
+
+
+def test_domain_growback_is_atomic(monkeypatch):
+    """Atomic domain grow-back: after a domain kill, the two replacements
+    become ready at DIFFERENT times (staggered reload delays) — the world
+    must wait for the whole domain and re-admit it as a unit in one grow,
+    never half-grow on the first ready rank."""
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_RESOURCE_CHECK_S", "0")
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_GRACE_PERIOD_S", "0")
+    monkeypatch.setenv("RXGB_FAULT_DOMAINS", "2")
+    x, y = _data(512)
+    plan = faults.FaultPlan(rules=[
+        {"site": "actor.train_round", "action": "domain_kill", "domain": 1,
+         "ranks": [2], "match": {"round": 3}},
+        # stagger the two replacements' reloads so the domain is HALF ready
+        # for a while: an atomic grow must not admit rank 2 alone
+        {"site": "actor.load_shard", "action": "delay", "delay_s": 2.0,
+         "match": {"rank": 2}, "at": 2},
+        {"site": "actor.load_shard", "action": "delay", "delay_s": 3.5,
+         "match": {"rank": 3}, "at": 2},
+    ])
+    res = {}
+    with faults.active_plan(plan):
+        bst = train(_PARAMS, RayDMatrix(x, y), 16, additional_results=res,
+                    ray_params=RayParams(num_actors=4, elastic_training=True,
+                                         max_failed_actors=2,
+                                         max_actor_restarts=2,
+                                         checkpoint_frequency=4))
+    assert bst.num_boosted_rounds() == 16
+    rob = res["robustness"]
+    assert rob["rounds_replayed"] == 0
+    assert rob["restarts"] == 0 and rob["elastic_restarts"] == 0
+    assert rob["shrinks"] == 1
+    assert rob["grows"] == 1  # ONE grow: both ranks re-admitted together
+    assert rob["domains_lost"] == 1
+    assert res["total_n"] == len(x)
+
+    by_name = {}
+    for e in res["obs"]["events"]:
+        by_name.setdefault(e["name"], []).append(e)
+    (grow,) = by_name["world.grow"]
+    assert grow["attrs"]["world"] == 4  # straight 2 -> 4, no 3-world step
+    (up,) = by_name["world.domain_up"]
+    assert up["attrs"]["domain"] == 1
+    assert up["attrs"]["ranks"] == [2, 3]
+    assert up["seq"] > by_name["world.domain_down"][0]["seq"]

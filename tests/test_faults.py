@@ -301,6 +301,7 @@ def test_clean_run_reports_zero_robustness():
         "restarts": 0, "elastic_restarts": 0, "rounds_replayed": 0,
         "time_to_recover_s": 0.0, "backoff_s": 0.0,
         "shrinks": 0, "grows": 0, "orphaned_rows": 0, "recompile_s": 0.0,
+        "domains_lost": 0, "deaths_coalesced": 0,
     }
 
 
@@ -779,3 +780,69 @@ def test_registry_swap_fault_site(serve_model):
         with pytest.raises(ValueError):
             reg.load(bst)
         assert reg.load(bst) == 1  # rule exhausted; swap proceeds
+
+
+# ---------------------------------------------------------------------------
+# correlated failure: the domain_kill action
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def _clear_resolver():
+    yield
+    faults.set_domain_resolver(None)
+
+
+def test_domain_kill_requires_domain():
+    with pytest.raises(ValueError, match="domain"):
+        faults.FaultRule(site="actor.train_round", action="domain_kill")
+
+
+def test_domain_kill_json_roundtrip():
+    plan = faults.FaultPlan(rules=[{
+        "site": "actor.train_round", "action": "domain_kill", "domain": 1,
+        "ranks": [2], "match": {"round": 3}}])
+    clone = faults.FaultPlan.from_json(plan.to_json())
+    rule = clone.rules[0]
+    assert rule.action == "domain_kill" and rule.domain == 1
+    assert rule.ranks == [2] and rule.match == {"round": 3}
+
+
+def test_domain_kill_resolver_blames_whole_domain(_clear_resolver):
+    """With the driver's resolver installed, one rule occurrence raises a
+    single RayActorError blaming EVERY alive rank of the domain — that is
+    what lets the recovery coalesce a host loss into one shrink."""
+    faults.set_domain_resolver(lambda d: (3, 2) if d == 1 else ())
+    plan = faults.FaultPlan(rules=[{
+        "site": "actor.train_round", "action": "domain_kill", "domain": 1,
+        "ranks": [2]}])
+    with pytest.raises(RayActorError) as ei:
+        plan.fire("actor.train_round", rank=2, round=0)
+    assert ei.value.ranks == [2, 3]  # sorted, both ranks in ONE exception
+
+
+def test_domain_kill_dead_domain_is_noop(_clear_resolver):
+    """A domain whose ranks are all gone resolves to no targets: the rule
+    passes instead of raising (nothing left to kill)."""
+    faults.set_domain_resolver(lambda d: ())
+    plan = faults.FaultPlan(rules=[{
+        "site": "actor.train_round", "action": "domain_kill", "domain": 0,
+        "times": 0}])
+    plan.fire("actor.train_round", rank=0, round=0)  # does not raise
+
+
+def test_domain_kill_fallback_ranks_without_resolver(_clear_resolver):
+    """Outside a training run (no resolver) the rule's explicit `ranks`
+    list is the target set; with neither, the misconfiguration is loud."""
+    faults.set_domain_resolver(None)
+    plan = faults.FaultPlan(rules=[{
+        "site": "actor.train_round", "action": "domain_kill", "domain": 5,
+        "ranks": [4, 1]}])
+    with pytest.raises(RayActorError) as ei:
+        plan.fire("actor.train_round", rank=1)
+    assert ei.value.ranks == [1, 4]
+
+    bare = faults.FaultPlan(rules=[{
+        "site": "actor.train_round", "action": "domain_kill", "domain": 5}])
+    with pytest.raises(RuntimeError, match="no domain resolver"):
+        bare.fire("actor.train_round", rank=0)

@@ -401,6 +401,8 @@ _FAST_SCENARIOS = (
     "faultplan_fire_vs_reset",
     "metrics_record_vs_render",
     "elastic_pending_load_vs_poll",
+    # ~60 s to explore exhaustively (3 threads); listed in slow_tests.txt
+    "domain_death_coalesce_vs_grow_poll",
 )
 
 

@@ -649,6 +649,83 @@ def _router_invariant(ctx):
 
 
 # ---------------------------------------------------------------------------
+# 10. fault-domain death coalescing vs the driver's drain + grow polls
+# ---------------------------------------------------------------------------
+
+
+def _domain_body(ctx):
+    from xgboost_ray_tpu import obs
+    from xgboost_ray_tpu.domains import DeathCoalescer, DomainMap
+    from xgboost_ray_tpu.elastic import (
+        PendingActor,
+        _update_scheduled_actor_states,
+    )
+
+    obs.set_default_tracer(
+        obs.Tracer(capacity=64, enabled=True, trace_dir="", rank=0)
+    )
+    co = ctx.co = DeathCoalescer()
+    p2 = ctx.p2 = PendingActor(actor=object(), created_at=time.time())
+    p3 = ctx.p3 = PendingActor(actor=object(), created_at=time.time())
+    # ranks 2+3 form fault domain 1; both died and both replacements are
+    # staged but not yet loaded
+    state = SimpleNamespace(
+        pending_actors={2: p2, 3: p3},
+        restart_training_at=None,
+        domain_map=DomainMap({0: 0, 1: 0, 2: 1, 3: 1}),
+        elastic_dead_ranks={2, 3},
+    )
+    batches = ctx.batches = []
+
+    def killer(rank, pending):
+        # one rank's lifecycle during a correlated host loss: the
+        # out-of-band death notification, then the replacement's background
+        # load completing
+        co.note(rank, domain=1)
+        pending.mark_ready()
+
+    def driver():
+        outs = []
+        for _ in range(3):
+            batch = co.drain()
+            if batch:
+                batches.append(batch)
+            ok = _update_scheduled_actor_states(state, raise_on_ready=False)
+            outs.append((ok, tuple(getattr(state, "domains_due", ()) or ())))
+        ctx.outs = outs
+
+    t1 = threading.Thread(target=killer, args=(2, p2), name="killer-rank-2")
+    t2 = threading.Thread(target=killer, args=(3, p3), name="killer-rank-3")
+    t3 = threading.Thread(target=driver, name="driver")
+    for t in (t1, t2, t3):
+        t.start()
+    for t in (t1, t2, t3):
+        t.join()
+
+
+def _domain_invariant(ctx):
+    leftover = ctx.co.drain()
+    if leftover:
+        ctx.batches.append(leftover)
+    assert not ctx.co.pending, "mailbox not empty after final drain"
+    seen = []
+    for batch in ctx.batches:
+        for rank, dom in batch.items():
+            assert dom == 1, f"domain attribution torn: {batch}"
+            seen.append(rank)
+    # every noted rank lands in exactly one drained batch — never dropped,
+    # never double-blamed (double-blame = two shrinks for one host loss)
+    assert sorted(seen) == [2, 3], f"ranks drained {seen}, want [2, 3]"
+    grows = [o for o in ctx.outs if o[0]]
+    assert len(grows) <= 1, f"double grow signal: {ctx.outs}"
+    for _ok, due in grows:
+        # the grow signal names the WHOLE domain, only once both
+        # replacements finished loading — a half-staged domain must wait
+        assert due == (1,), f"grow due set {due}, want (1,)"
+        assert ctx.p2.ready and ctx.p3.ready, "grew on a half-ready domain"
+
+
+# ---------------------------------------------------------------------------
 # the suite
 # ---------------------------------------------------------------------------
 
@@ -717,6 +794,15 @@ SCENARIOS: Tuple[Scenario, ...] = (
                     "reintegration poll (the slow-load path): ready/error "
                     "never tear (regression pin for the PendingActor lock)",
         body=_elastic_body, invariant=_elastic_invariant,
+        setup=_elastic_setup, teardown=_elastic_teardown,
+    ),
+    Scenario(
+        name="domain_death_coalesce_vs_grow_poll",
+        description="DeathCoalescer concurrent domain death notes vs the "
+                    "driver's drain + atomic domain grow poll: every rank "
+                    "drained exactly once, at most one grow signal, and "
+                    "only for the complete domain",
+        body=_domain_body, invariant=_domain_invariant,
         setup=_elastic_setup, teardown=_elastic_teardown,
     ),
 )

@@ -1,0 +1,138 @@
+"""Fault domains: host-granular failure grouping for the elastic plane.
+
+ROADMAP item 4 states the realistic production failure plainly: a lost HOST
+is the unit of loss, not a lost actor. The placement layer already groups
+mesh devices by ``process_index`` (``main._select_mesh_devices``'s SPREAD
+strategy); this module keeps that structure alive at failure time as a
+:class:`DomainMap` — a static rank -> domain-id assignment derived once per
+training attempt — so the driver can coalesce a whole domain's
+near-simultaneous deaths into ONE shrink, run the reintegration grace clock
+per domain, and re-admit a replacement domain atomically.
+
+Domain derivation (``derive_domain_map``), in priority order:
+
+1. ``RXGB_FAULT_DOMAINS=H`` (``ENV.FAULT_DOMAINS``) — a logical partition of
+   the rank space into ``H`` contiguous groups, so every domain behavior is
+   exercised on the single-process CPU CI mesh.
+2. A real multi-host mesh — each rank's domain is the ``process_index`` of
+   the device backing it (ranks colocated on one host share a domain and
+   die together when that host is lost).
+3. Single process, no override — every rank is its own domain (an actor IS
+   the failure unit on one host), which preserves the pre-domain per-rank
+   elastic semantics exactly.
+
+This module must stay import-light (no jax/numpy): ``faults`` resolves
+``domain_kill`` targets through it and launcher workers import ``faults``
+before any jax-touching import.
+"""
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["DomainMap", "DeathCoalescer", "derive_domain_map", "logical_domain_of"]
+
+
+def logical_domain_of(rank: int, num_ranks: int, num_domains: int) -> int:
+    """Contiguous H-way partition of ``num_ranks`` ranks (the
+    ``RXGB_FAULT_DOMAINS=H`` layout, also used by the launcher to attribute
+    process failures): rank ``r`` belongs to domain ``r * H // num_ranks``."""
+    h = max(1, min(int(num_domains), int(num_ranks)))
+    return int(rank) * h // int(num_ranks)
+
+
+class DomainMap:
+    """Immutable rank -> fault-domain assignment for one training attempt."""
+
+    def __init__(self, assignment: Dict[int, int]):
+        self._assignment = dict(assignment)
+        self._ranks: Dict[int, Tuple[int, ...]] = {}
+        for rank, dom in sorted(self._assignment.items()):
+            self._ranks.setdefault(dom, ())
+            self._ranks[dom] = self._ranks[dom] + (rank,)
+
+    def domain_of(self, rank: int) -> int:
+        return self._assignment[rank]
+
+    def ranks_of(self, domain: int) -> Tuple[int, ...]:
+        return self._ranks.get(domain, ())
+
+    def domains(self) -> List[int]:
+        return sorted(self._ranks)
+
+    def domains_of(self, ranks: Sequence[int]) -> List[int]:
+        return sorted({self._assignment[r] for r in ranks if r in self._assignment})
+
+    @property
+    def num_ranks(self) -> int:
+        return len(self._assignment)
+
+    @property
+    def num_domains(self) -> int:
+        return len(self._ranks)
+
+    def __repr__(self) -> str:  # debugging / event payloads
+        return f"DomainMap({self._assignment!r})"
+
+
+def derive_domain_map(
+    num_actors: int,
+    devices: Optional[Sequence] = None,
+    logical_domains: int = 0,
+) -> DomainMap:
+    """Build the rank -> domain assignment for a world of ``num_actors``.
+
+    ``devices`` is the resolved mesh device list (rank ``r`` is backed by the
+    ``r``-th contiguous slice); only each device's ``process_index`` attribute
+    is consulted, so any object (including test fakes) works. See the module
+    docstring for the three-tier derivation order.
+    """
+    n = int(num_actors)
+    if logical_domains and int(logical_domains) > 0:
+        return DomainMap(
+            {r: logical_domain_of(r, n, int(logical_domains)) for r in range(n)}
+        )
+    if devices:
+        procs = [getattr(d, "process_index", 0) for d in devices]
+        if len(set(procs)) > 1:
+            # rank r <-> its first backing device (devices are laid out in
+            # rank-contiguous slices by the mesh builder)
+            per = max(1, len(procs) // n)
+            return DomainMap(
+                {r: int(procs[min(r * per, len(procs) - 1)]) for r in range(n)}
+            )
+    return DomainMap({r: r for r in range(n)})
+
+
+class DeathCoalescer:
+    """Thread-safe mailbox folding near-simultaneous deaths into one shrink.
+
+    Anything that learns of a rank death out-of-band of the driver's round
+    loop (``RayXGBoostActor.kill`` from a chaos thread, a liveness probe, a
+    future multi-host heartbeat monitor) ``note()``s the rank here; the
+    driver's in-flight recovery drains the mailbox inside its coalescing
+    window and blames every noted rank in the SAME shrink — one retrace for
+    a whole lost host instead of N sequential shrink/recompile cycles.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending: Dict[int, Optional[int]] = {}
+
+    def note(self, rank: int, domain: Optional[int] = None) -> None:
+        """Record a dead rank (idempotent; first note's domain attribution
+        wins). Never blocks the noting thread on driver-side work."""
+        with self._lock:
+            self._pending.setdefault(int(rank), domain)
+
+    def drain(self) -> Dict[int, Optional[int]]:
+        """Atomically take every noted death. A rank noted concurrently with
+        a drain lands in exactly one batch — never both, never neither."""
+        with self._lock:
+            out = dict(self._pending)
+            self._pending.clear()
+            return out
+
+    @property
+    def pending(self) -> bool:
+        with self._lock:
+            return bool(self._pending)

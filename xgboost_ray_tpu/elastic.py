@@ -158,26 +158,35 @@ def _maybe_schedule_new_actors(
 
 
 def _update_scheduled_actor_states(training_state, raise_on_ready: bool = True):
-    """Reintegration state machine for pending workers (elastic.py:98-142).
+    """Reintegration state machine for pending workers (elastic.py:98-142),
+    now per fault domain.
 
-    Returns True when reintegration is due: the grace period has expired
-    with at least one READY pending worker. With ``raise_on_ready`` (the
-    legacy restart-from-checkpoint mode — since every gbtree engine
-    re-shards in place now, this arm remains only for gblinear and
-    engines without a ``can_reshard`` probe) a due reintegration raises
-    ``RayXGBoostActorAvailable`` instead of returning; the driver's
-    in-flight grow path passes ``raise_on_ready=False`` and re-shards the
-    running world at the round boundary — zero rounds replayed.
+    Returns True when reintegration is due for at least one COMPLETE domain:
+    every dead rank of the domain has a READY pending worker and that
+    domain's grace period has expired. The due domains land in
+    ``training_state.domains_due`` so the driver's round-boundary grow path
+    (``raise_on_ready=False``) re-admits them atomically — a half-staged
+    domain waits, it never half-grows. With ``raise_on_ready`` (the legacy
+    restart-from-checkpoint mode for engines without a ``can_reshard``
+    probe) a due reintegration raises ``RayXGBoostActorAvailable`` instead
+    of returning.
 
     Workers whose background data load failed are dropped (and re-tried on
-    the next resource check). The grace clock only arms once at least one
-    pending worker has FINISHED loading, and is DISARMED again whenever no
-    ready pending worker remains (e.g. every pending worker was dropped for
-    load errors after the clock armed) — the next ready worker must earn a
-    fresh grace period, not inherit a stale expired one."""
+    the next resource check). Each domain's grace clock arms only once ALL
+    of its dead ranks have FINISHED loading, and is DISARMED again whenever
+    that completeness regresses — a freshly-complete domain must earn its
+    own grace period, and one flapping domain never resets the clocks of
+    healthy domains. Without a domain map every rank is its own domain,
+    which reproduces the pre-domain per-rank semantics."""
     from xgboost_ray_tpu.main import ENV
 
+    clocks = getattr(training_state, "domain_restart_at", None)
+    if clocks is None:
+        clocks = {}
+        training_state.domain_restart_at = clocks
+    training_state.domains_due = []
     if not training_state.pending_actors:
+        clocks.clear()
         training_state.restart_training_at = None
         return False
     for rank, pending in list(training_state.pending_actors.items()):
@@ -188,34 +197,61 @@ def _update_scheduled_actor_states(training_state, raise_on_ready: bool = True):
                 f"rank {rank}: {err}"
             )
             del training_state.pending_actors[rank]
-    if not any(p.ready for p in training_state.pending_actors.values()):
-        training_state.restart_training_at = None
-        return False
+
+    domain_map = getattr(training_state, "domain_map", None)
+
+    def _dom(rank: int) -> int:
+        return domain_map.domain_of(rank) if domain_map is not None else rank
+
+    # a domain's required set = every rank it has in flight: dead ranks not
+    # yet rescheduled AND staged pendings — completeness over that set is
+    # the atomic-grow contract
+    dead = set(getattr(training_state, "elastic_dead_ranks", ()) or ())
+    dead |= set(getattr(training_state, "failed_actor_ranks", ()) or ())
+    required: Dict[int, set] = {}
+    for rank in set(training_state.pending_actors) | dead:
+        required.setdefault(_dom(rank), set()).add(rank)
+
     now = time.time()
-    if training_state.restart_training_at is None:
-        training_state.restart_training_at = now + float(
-            ENV.ELASTIC_RESTART_GRACE_PERIOD_S
+    due_domains: List[int] = []
+    for dom in sorted(required):
+        complete = all(
+            (p := training_state.pending_actors.get(r)) is not None and p.ready
+            for r in required[dom]
         )
+        if not complete:
+            clocks.pop(dom, None)
+            continue
+        if dom not in clocks:
+            clocks[dom] = now + float(ENV.ELASTIC_RESTART_GRACE_PERIOD_S)
+        elif now >= clocks[dom]:
+            due_domains.append(dom)
+    for dom in list(clocks):  # drop clocks of domains no longer in flight
+        if dom not in required:
+            del clocks[dom]
+    for dom in due_domains:
+        clocks.pop(dom, None)
+    # legacy mirror: earliest armed clock (tests and the resume path read it)
+    training_state.restart_training_at = min(clocks.values()) if clocks else None
+    if not due_domains:
         return False
-    if now >= training_state.restart_training_at:
-        training_state.restart_training_at = None
-        obs.get_tracer().event(
-            "elastic.ready",
-            attrs={
-                "ranks": sorted(
-                    r for r, p in training_state.pending_actors.items()
-                    if p.ready
-                ),
-                "mode": "restart" if raise_on_ready else "grow",
-            },
+    training_state.domains_due = due_domains
+    obs.get_tracer().event(
+        "elastic.ready",
+        attrs={
+            "ranks": sorted(
+                r for dom in due_domains for r in required[dom]
+            ),
+            "domains": due_domains,
+            "mode": "restart" if raise_on_ready else "grow",
+        },
+    )
+    if raise_on_ready:
+        raise RayXGBoostActorAvailable(
+            "A new worker became available for training. Restarting from "
+            "the latest checkpoint with the restored world size."
         )
-        if raise_on_ready:
-            raise RayXGBoostActorAvailable(
-                "A new worker became available for training. Restarting from "
-                "the latest checkpoint with the restored world size."
-            )
-        return True
-    return False
+    return True
 
 
 def _get_actor_alive_status(actors: List, callback) -> int:

@@ -2653,9 +2653,10 @@ class TpuEngine:
         capacity-padded device forest and tree weights rebuild from the
         in-memory booster via ``reset_from_booster``; the per-round drop
         RNG is a pure function of (seed, global round), so it needs no
-        carried state). gblinear remains the one restart-only booster —
-        ``LinearEngine`` has no ``can_reshard`` and the driver's probe
-        defaults to False."""
+        carried state). gblinear is no longer the asterisk: ``LinearEngine``
+        ships its own ``can_reshard``/``reset_from_booster`` (the weight
+        vector re-derives from the in-memory booster on any survivor mesh),
+        so every built-in booster continues in flight."""
         return True
 
     def reset_from_booster(self, shards, evals, init_booster) -> None:

@@ -39,7 +39,11 @@ stream.h2d_upload     ``DoubleBufferedUploader.submit``           ``bytes`` (the
 ====================  ==========================================  ==============
 
 Actions: ``raise`` (an exception — ``RayActorError`` when ``ranks`` is set),
-``kill`` (SIGKILL the current process — real-process sites), ``delay`` /
+``kill`` (SIGKILL the current process — real-process sites), ``domain_kill``
+(correlated host loss: kills EVERY rank of fault domain ``domain`` at once —
+one ``fault.injected`` event per rank sharing a ``domain`` attr, then a
+single ``RayActorError`` blaming all of them; ranks resolve through the
+driver-installed resolver, see ``set_domain_resolver``), ``delay`` /
 ``hang`` (sleep ``delay_s``; hang defaults to an hour), and the file actions
 ``corrupt`` / ``truncate`` applied by ``fire_file()`` to the site's file
 (checkpoints) with plan-seeded byte positions.
@@ -74,6 +78,8 @@ __all__ = [
     "plan_targets",
     "fire",
     "fire_file",
+    "set_domain_resolver",
+    "get_domain_resolver",
 ]
 
 #: the fault-site catalogue (kept in sync with the table above; ``FaultRule``
@@ -121,12 +127,13 @@ class FaultRule:
     """
 
     site: str
-    action: str  # raise | kill | delay | hang | corrupt | truncate
+    action: str  # raise | kill | domain_kill | delay | hang | corrupt | truncate
     at: int = 1
     times: int = 1
     match: Optional[Dict[str, Any]] = None
     # action parameters
     ranks: Optional[List[int]] = None  # raise -> RayActorError(ranks=...)
+    domain: Optional[int] = None  # domain_kill: fault domain to take down
     exc: str = "RuntimeError"  # raise without ranks: exception type name
     message: str = ""
     delay_s: float = 0.0  # delay; hang defaults to 3600 when unset
@@ -138,9 +145,12 @@ class FaultRule:
                 f"unknown fault site {self.site!r}; one of {SITES}"
             )
         if self.action not in (
-            "raise", "kill", "delay", "hang", "corrupt", "truncate"
+            "raise", "kill", "domain_kill", "delay", "hang", "corrupt",
+            "truncate",
         ):
             raise ValueError(f"unknown fault action {self.action!r}")
+        if self.action == "domain_kill" and self.domain is None:
+            raise ValueError("domain_kill requires a `domain` index")
         if self.at < 1:
             raise ValueError("`at` is 1-based; must be >= 1")
 
@@ -157,6 +167,8 @@ class FaultRule:
         for key in ("match", "ranks", "message"):
             if getattr(self, key):
                 out[key] = getattr(self, key)
+        if self.domain is not None:
+            out["domain"] = self.domain
         if self.exc != "RuntimeError":
             out["exc"] = self.exc
         if self.delay_s:
@@ -260,8 +272,26 @@ class FaultPlan:
                 self._perform(rule, site, ctx)
 
     def _perform(self, rule: FaultRule, site: str, ctx: Dict[str, Any]):
-        _emit_fault_event(site, rule.action, ctx)
         msg = rule.message or f"injected fault at {site} ({ctx})"
+        if rule.action == "domain_kill":
+            # correlated host loss: one event PER RANK (sharing the domain
+            # attr) so the timeline shows every death, then one exception
+            # blaming all of them so the driver sees ONE failure to coalesce
+            ranks = _resolve_domain_ranks(rule.domain, rule.ranks)
+            if not ranks:
+                return  # domain already fully dead: nothing left to kill
+            for r in ranks:
+                _emit_fault_event(
+                    site, rule.action, dict(ctx, rank=r, domain=rule.domain)
+                )
+            from xgboost_ray_tpu.exceptions import RayActorError
+
+            raise RayActorError(
+                rule.message
+                or f"injected domain_kill of domain {rule.domain} at {site}",
+                ranks=ranks,
+            )
+        _emit_fault_event(site, rule.action, ctx)
         if rule.action == "raise":
             if rule.ranks is not None:
                 from xgboost_ray_tpu.exceptions import RayActorError
@@ -314,6 +344,43 @@ def _emit_fault_event(site: str, action: str, ctx: Dict[str, Any]) -> None:
         obs.get_tracer().event("fault.injected", **attrs)
     except Exception:  # noqa: BLE001 - never fail the fault path
         pass
+
+
+# ---------------------------------------------------------------------------
+# Fault-domain resolution: the driver installs a resolver mapping a domain id
+# to the ranks currently alive in it (derived from the attempt's DomainMap),
+# so a `domain_kill` rule written against logical domains hits whatever the
+# placement layer decided those domains contain.
+# ---------------------------------------------------------------------------
+
+_DOMAIN_RESOLVER = None
+
+
+def set_domain_resolver(resolver) -> None:
+    """Install (or clear, with ``None``) the domain -> alive-ranks resolver.
+    Called by the driver at the start of every training attempt; the last
+    installed resolver wins."""
+    global _DOMAIN_RESOLVER
+    _DOMAIN_RESOLVER = resolver
+
+
+def get_domain_resolver():
+    return _DOMAIN_RESOLVER
+
+
+def _resolve_domain_ranks(
+    domain: Optional[int], fallback: Optional[List[int]]
+) -> List[int]:
+    resolver = _DOMAIN_RESOLVER
+    if resolver is not None:
+        return sorted(int(r) for r in resolver(domain))
+    if fallback:
+        return sorted(int(r) for r in fallback)
+    raise RuntimeError(
+        f"domain_kill: no domain resolver installed and no `ranks` fallback "
+        f"for domain {domain!r} (the driver installs one per attempt; "
+        f"outside a training run pass explicit ranks)"
+    )
 
 
 # ---------------------------------------------------------------------------

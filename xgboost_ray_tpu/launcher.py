@@ -109,6 +109,10 @@ class ProcessFailure:
     # member), "slow" (the whole-world timeout_s expired), or "torn_down"
     # (healthy peer killed while the launcher tore a crashed world down)
     reason: str = "crashed"
+    # fault-domain attribution (RXGB_FAULT_DOMAINS logical partition of the
+    # process space, same layout as the elastic plane's); None = no
+    # partition configured
+    domain: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -122,6 +126,24 @@ class LaunchFailedError(RuntimeError):
     def __init__(self, message: str, failures: List[ProcessFailure]):
         super().__init__(message)
         self.failures = failures
+
+
+def _process_domain(process_id: int, num_processes: int) -> Optional[int]:
+    """Fault-domain of a launcher process under the ``RXGB_FAULT_DOMAINS``
+    logical partition (the same contiguous layout the elastic plane uses),
+    or None when no partition is configured — correlates cross-process
+    failures ("both deaths were domain 1") in ProcessFailure records and
+    the ``launcher.attempt_failed`` timeline event."""
+    from xgboost_ray_tpu.domains import logical_domain_of
+
+    raw = os.environ.get("RXGB_FAULT_DOMAINS", "")
+    try:
+        h = int(raw) if raw else 0
+    except ValueError:
+        h = 0
+    if h <= 0 or num_processes <= 0:
+        return None
+    return logical_domain_of(process_id, num_processes, h)
 
 
 def _free_port() -> int:
@@ -714,6 +736,7 @@ def _run_attempts(
                             attempt, pid_, rc, _tail(log_path),
                             forced=pid_ in forced_ids,
                             reason=reason,
+                            domain=_process_domain(pid_, num_processes),
                         )
                     )
             if hung_ids:
@@ -750,7 +773,12 @@ def _run_attempts(
             obs.get_tracer().event(
                 "launcher.attempt_failed",
                 attrs={"attempt": attempt - 1, "reason": why,
-                       "restart": restarts, "backoff_s": round(backoff, 4)},
+                       "restart": restarts, "backoff_s": round(backoff, 4),
+                       "domains": sorted({
+                           f_.domain for f_ in failures
+                           if f_.attempt == attempt - 1
+                           and f_.domain is not None
+                       })},
             )
             if backoff > 0:
                 time.sleep(backoff)

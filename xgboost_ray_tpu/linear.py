@@ -391,12 +391,70 @@ class LinearEngine:
     @property
     def num_round_trees(self) -> int:
         # no trees — but the driver's booster proxy invalidates its cache on
-        # change, so this must advance every round or callbacks would see
-        # the round-1 model forever
-        return self._rounds_done
+        # change, so this must advance every round; and like TpuEngine it
+        # counts only rounds boosted on THIS engine (excluding the
+        # init_booster's), which the driver's post-swap round arithmetic
+        # (``engine_base + num_round_trees``) depends on
+        return self._rounds_done - self.iteration_offset
 
     def can_batch_rounds(self) -> bool:
         return False
+
+    # ------------------------------------------------------------------
+    # Elastic re-shard: gblinear is the easy booster — the whole model is a
+    # replicated [F, K] weight matrix + [K] bias with no carried histogram
+    # or forest state, so continuing on a changed world is just rebuilding
+    # the engine over the survivors' shards (the driver's `_build_world`
+    # does that) and a cache revival is re-seeding w/b from the booster.
+    # ------------------------------------------------------------------
+    def can_reshard(self) -> bool:
+        """Zero-replay elastic continuation: the driver may shrink/grow this
+        engine's world in flight and continue from the in-memory booster."""
+        return True
+
+    def reset_from_booster(self, shards, evals, init_booster) -> None:
+        """Revive this cached engine for its original world: verify the
+        shard layout still matches the device-resident matrix, then re-seed
+        weights/bias/round bookkeeping from ``init_booster``. The compiled
+        coordinate-update program and the device-resident data are reused
+        as-is — zero re-upload, zero retrace."""
+        from xgboost_ray_tpu.engine import _concat_shards
+
+        x, _, _, _, _, _, _ = _concat_shards(shards)
+        if x.shape[0] != self.n_rows or x.shape[1] != self.n_features:
+            raise ValueError(
+                f"cached gblinear engine covers a [{self.n_rows}, "
+                f"{self.n_features}] matrix; got [{x.shape[0]}, "
+                f"{x.shape[1]}]"
+            )
+        if init_booster is not None:
+            if not isinstance(init_booster, RayLinearBooster):
+                raise ValueError(
+                    "reset_from_booster for gblinear needs a gblinear model"
+                )
+            if init_booster.num_features != self.n_features:
+                raise ValueError(
+                    f"booster has {init_booster.num_features} features; "
+                    f"engine has {self.n_features}"
+                )
+            # replicated placement (matches the round program's P() specs) —
+            # jnp.asarray would land on the default device and trip the
+            # strict transfer guard on the first warm dispatch
+            self._w = jax.device_put(
+                np.asarray(init_booster.weights, np.float32), self._repl
+            )
+            self._b = jax.device_put(
+                np.asarray(init_booster.bias, np.float32), self._repl
+            )
+            self.iteration_offset = init_booster.num_boosted_rounds()
+        else:
+            k = self.n_outputs
+            self._w = jax.device_put(
+                np.zeros((self.n_features, k), np.float32), self._repl
+            )
+            self._b = jax.device_put(np.zeros((k,), np.float32), self._repl)
+            self.iteration_offset = 0
+        self._rounds_done = self.iteration_offset
 
     # ------------------------------------------------------------------
     def _build_round_fn(self):

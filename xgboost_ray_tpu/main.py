@@ -34,6 +34,7 @@ from xgboost_ray_tpu.callback import (
     TrainingCallback,
 )
 from xgboost_ray_tpu import faults, obs
+from xgboost_ray_tpu.domains import DeathCoalescer, DomainMap, derive_domain_map
 from xgboost_ray_tpu.engine import TpuEngine
 from xgboost_ray_tpu.exceptions import (
     RayActorError,
@@ -92,6 +93,15 @@ class _XGBoostEnv:
     ELASTIC_RESTART_DISABLED: bool = False
     ELASTIC_RESTART_RESOURCE_CHECK_S: float = 30.0
     ELASTIC_RESTART_GRACE_PERIOD_S: float = 10.0
+    # fault domains: 0 = derive from placement (process_index groups on a
+    # real multi-host mesh, per-rank domains on one host); H > 0 = logical
+    # H-way partition of the rank space so domain-granular failure behavior
+    # is exercisable on the single-process CPU CI mesh
+    FAULT_DOMAINS: int = 0
+    # how long the in-flight recovery lingers to fold near-simultaneous
+    # deaths (a whole domain dying at once) into ONE shrink; 0 still sweeps
+    # once for already-dead ranks, it just doesn't wait for stragglers
+    ELASTIC_DEATH_COALESCE_S: float = 0.0
     COMMUNICATION_SOFT_PLACEMENT: bool = True
     # upper bound on rounds fused into one compiled lax.scan program in the
     # batched fast path. Bounds compiled-program size and the stacked
@@ -216,6 +226,11 @@ class RayXGBoostActor:
         self.queue = queue
         self.stop_event = stop_event
         self.alive = True
+        # death-coalescing mailbox (domains.DeathCoalescer) wired up by the
+        # driver so an out-of-band kill() lands in the same shrink as its
+        # domain siblings
+        self._coalescer = None
+        self._domain: Optional[int] = None
         self._data: Dict[RayDMatrix, Dict[str, Optional[np.ndarray]]] = {}
         self._local_n: Dict[RayDMatrix, int] = {}
         self._distributed_callbacks = DistributedCallbackContainer(
@@ -260,6 +275,9 @@ class RayXGBoostActor:
     def kill(self):
         """Mark this worker dead (fault injection / failure detection)."""
         self.alive = False
+        coalescer = self._coalescer
+        if coalescer is not None:
+            coalescer.note(self.rank, self._domain)
 
 
 # ---------------------------------------------------------------------------
@@ -285,6 +303,18 @@ class _TrainingState:
     pending_actors: Optional[Dict[int, Any]] = None  # rank -> elastic.PendingActor
     restart_training_at: Optional[float] = None
     last_resource_check_at: float = 0.0
+
+    # fault domains (ROADMAP item 4): the attempt's rank -> domain
+    # assignment, the per-domain reintegration grace clocks, the domains
+    # whose replacements are complete and past grace (set by the elastic
+    # updater, consumed atomically by the round-boundary grow), and the
+    # mailbox that folds near-simultaneous deaths into one shrink
+    domain_map: Optional[DomainMap] = None
+    domain_restart_at: Dict[int, float] = dataclasses.field(default_factory=dict)
+    domains_due: List[int] = dataclasses.field(default_factory=list)
+    death_coalescer: DeathCoalescer = dataclasses.field(
+        default_factory=DeathCoalescer
+    )
 
     # in-flight elastic continuation: live engines keyed by world signature
     # (tuple of alive ranks), so a shrink->grow cycle revives the cached
@@ -427,8 +457,9 @@ def _engine_can_reshard(engine) -> bool:
     elastic decision point (caching a world, gating the in-flight recover,
     choosing boundary-grow vs the legacy ``RayXGBoostActorAvailable``
     restart) routes through here so the gate semantics cannot drift per
-    call site. Engines without the method (``LinearEngine``/gblinear, or a
-    user-supplied engine) are restart-only."""
+    call site. Every built-in engine (including ``LinearEngine``/gblinear)
+    re-shards now; only a user-supplied engine without the method is
+    restart-only."""
     probe = getattr(engine, "can_reshard", None)
     return bool(probe()) if probe is not None else False
 
@@ -786,19 +817,6 @@ def _train(
         # keeps the legacy R-slot request byte for byte)
         mesh_slots = len(world_actors) * max(1, parsed.feature_parallel)
         trial_devices = _resolve_mesh_devices(mesh_slots, ray_params)
-        if parsed.booster == "gblinear":
-            from xgboost_ray_tpu.linear import LinearEngine
-
-            return LinearEngine(
-                train_shards,
-                parsed,
-                num_actors=len(world_actors),
-                evals=evals_in,
-                devices=trial_devices,
-                init_booster=world_init,
-                feature_names=dtrain.resolved_feature_names,
-                feature_types=dtrain.resolved_feature_types,
-            )
         key = tuple(a.rank for a in world_actors)
         fp = shard_layout_fingerprint(train_shards)
         cached = state.engine_cache.pop(key, None)
@@ -811,20 +829,34 @@ def _train(
                     "[RayXGBoost] cached engine for world %s unusable (%s); "
                     "rebuilding.", key, exc,
                 )
-        eng = TpuEngine(
-            train_shards,
-            parsed,
-            num_actors=len(world_actors),
-            evals=evals_in,
-            devices=trial_devices,
-            init_booster=world_init,
-            feature_names=dtrain.resolved_feature_names,
-            total_rounds=boost_rounds_left,
-            feature_weights=dtrain.feature_weights,
-            feature_types=dtrain.resolved_feature_types,
-            categories=train_cats,
-            stream_donor=donor,
-        )
+        if parsed.booster == "gblinear":
+            from xgboost_ray_tpu.linear import LinearEngine
+
+            eng = LinearEngine(
+                train_shards,
+                parsed,
+                num_actors=len(world_actors),
+                evals=evals_in,
+                devices=trial_devices,
+                init_booster=world_init,
+                feature_names=dtrain.resolved_feature_names,
+                feature_types=dtrain.resolved_feature_types,
+            )
+        else:
+            eng = TpuEngine(
+                train_shards,
+                parsed,
+                num_actors=len(world_actors),
+                evals=evals_in,
+                devices=trial_devices,
+                init_booster=world_init,
+                feature_names=dtrain.resolved_feature_names,
+                total_rounds=boost_rounds_left,
+                feature_weights=dtrain.feature_weights,
+                feature_types=dtrain.resolved_feature_types,
+                categories=train_cats,
+                stream_donor=donor,
+            )
         eng._world_key = key
         eng._shard_fingerprint = fp
         return eng
@@ -836,6 +868,33 @@ def _train(
         state.engine_cache[key] = eng
         while len(state.engine_cache) > 2:
             state.engine_cache.pop(next(iter(state.engine_cache)))
+
+    # fault domains for this attempt (ROADMAP item 4): the rank -> domain
+    # assignment from RXGB_FAULT_DOMAINS or device placement. The faults
+    # plane resolves `domain_kill` rules through it, actors carry their
+    # domain into the death-coalescing mailbox, and the elastic updater
+    # runs its grace clocks per domain.
+    state.domain_map = derive_domain_map(
+        num_actors,
+        devices=_resolve_mesh_devices(
+            num_actors * max(1, parsed.feature_parallel), ray_params
+        ),
+        logical_domains=int(ENV.FAULT_DOMAINS),
+    )
+
+    def _alive_domain_ranks(dom):
+        if dom not in state.domain_map.domains():
+            raise ValueError(
+                f"domain_kill: unknown fault domain {dom!r}; this world has "
+                f"domains {state.domain_map.domains()}"
+            )
+        return [
+            r for r in state.domain_map.ranks_of(dom)
+            if state.actors[r] is not None
+        ]
+
+    faults.set_domain_resolver(_alive_domain_ranks)
+    _rewire_actors(state)  # actors pick up the coalescer + domain ids
 
     init_booster = _deserialize_booster(state.checkpoint.value)
     engine = _build_world(alive, init_booster)
@@ -937,6 +996,72 @@ def _train(
         )
         obs.get_registry().counter(f"rxgb_train_{kind}s_total").inc()
 
+    def _coalesce_deaths():
+        """Fold near-simultaneous deaths into the CURRENT failure: drain the
+        death-coalescing mailbox and probe actor liveness, blaming every
+        additional dead rank NOW so a whole lost domain costs one shrink and
+        one retrace instead of N sequential shrink/recompile cycles. With
+        ``RXGB_ELASTIC_DEATH_COALESCE_S > 0`` the sweep lingers until the
+        window closes, catching stragglers of a correlated loss; at 0 it
+        still folds everything already dead."""
+        deadline = time.time() + max(
+            0.0, float(ENV.ELASTIC_DEATH_COALESCE_S)
+        )
+        extra = []
+        while True:
+            noted = set(state.death_coalescer.drain())
+            noted.update(
+                rank for rank, a in enumerate(state.actors)
+                if a is not None and not a.alive
+            )
+            for rank in sorted(noted):
+                if state.actors[rank] is None:
+                    continue  # already blamed (possibly by this sweep)
+                state.actors[rank].kill()
+                state.actors[rank] = None
+                state.failed_actor_ranks.add(rank)
+                extra.append(rank)
+            now = time.time()
+            if now >= deadline:
+                return extra
+            time.sleep(min(0.005, deadline - now))
+
+    def _note_domains_lost(blamed):
+        """Domain attribution of a failure: every domain whose LAST alive
+        rank is among ``blamed`` is a lost domain — count it and put a
+        ``world.domain_down`` record on the timeline."""
+        dm = state.domain_map
+        if dm is None or not blamed:
+            return
+        rnd = engine.iteration_offset + engine.num_round_trees
+        for dom in dm.domains_of(blamed):
+            ranks = dm.ranks_of(dom)
+            if all(state.actors[r] is None for r in ranks):
+                rob["domains_lost"] = rob.get("domains_lost", 0) + 1
+                obs.get_tracer().event(
+                    "world.domain_down", round=rnd,
+                    attrs={"domain": dom, "ranks": list(ranks)},
+                )
+
+    def _note_domains_up(promoted):
+        """Emit ``world.domain_up`` for every domain ``promoted`` made whole
+        again — the timeline closure of its ``world.domain_down``."""
+        dm = state.domain_map
+        if dm is None or not promoted:
+            return
+        rnd = engine.iteration_offset + engine.num_round_trees
+        for dom in dm.domains_of(promoted):
+            if all(state.actors[r] is not None for r in dm.ranks_of(dom)):
+                obs.get_tracer().event(
+                    "world.domain_up", round=rnd,
+                    attrs={
+                        "domain": dom,
+                        "ranks": [
+                            r for r in promoted if dm.domain_of(r) == dom
+                        ],
+                    },
+                )
+
     def _world_is_current(world_actors):
         """True when ``world_actors`` is exactly the world the CURRENT
         engine was built over (same ranks, same shard rows) — continuation
@@ -953,10 +1078,13 @@ def _train(
         )
 
     def _grow_at_boundary():
-        """Reintegrate ready pending ranks at a round boundary by
+        """Reintegrate the due COMPLETE domains at a round boundary by
         re-sharding the running world in place — the in-memory booster
-        carries every boosted round, so reintegration replays NOTHING.
-        Falls back to the legacy restart-from-checkpoint reintegration
+        carries every boosted round, so reintegration replays NOTHING, and
+        a domain re-admits as a unit (``state.domains_due`` holds only
+        domains whose every dead rank is staged and past grace — a
+        half-staged domain keeps waiting, it never half-grows). Falls back
+        to the legacy restart-from-checkpoint reintegration
         (``RayXGBoostActorAvailable``) when the in-place grow fails."""
         started = time.time()
         try:
@@ -966,10 +1094,20 @@ def _train(
                 "A new worker is ready but the in-memory booster could not "
                 "be snapshotted; restarting from the latest checkpoint."
             ) from exc
-        promoted = [
-            r for r, p in (state.pending_actors or {}).items() if p.ready
-        ]
-        _promote_pending_actors(state)
+        due = list(state.domains_due or ())
+        dm = state.domain_map
+        if due and dm is not None:
+            due_ranks = {r for dom in due for r in dm.ranks_of(dom)}
+            promoted = [
+                r for r, p in (state.pending_actors or {}).items()
+                if p.ready and r in due_ranks
+            ]
+        else:
+            promoted = [
+                r for r, p in (state.pending_actors or {}).items() if p.ready
+            ]
+        state.domains_due = []
+        _promote_pending_actors(state, ranks=promoted)
         _rewire_actors(state)
         target = [a for a in state.actors if a is not None]
         try:
@@ -985,6 +1123,7 @@ def _train(
                     state.actors[r]
                 )
         _swap_engine(new_engine, "grow", started)
+        _note_domains_up(promoted)
         logger.info(
             f"[RayXGBoost] Reintegrated ranks {promoted} in place at a round "
             f"boundary ({len(target)} workers, zero rounds replayed)."
@@ -996,9 +1135,11 @@ def _train(
         already staged and no grace period applies (the world never
         actually shrinks — zero recompile, bitwise continuation), otherwise
         shrink to the survivors in place, recompiling once for the smaller
-        mesh and continuing from the in-memory booster. Returns False when
-        the in-flight path is unavailable (non-elastic, dart/gblinear,
-        empty forest, too many dead, rebuild failure, repeated failures
+        mesh and continuing from the in-memory booster. Near-simultaneous
+        deaths (a whole fault domain dying at once) are coalesced into ONE
+        shrink before the target world is chosen. Returns False when the
+        in-flight path is unavailable (non-elastic, an engine without
+        ``can_reshard``, too many dead, rebuild failure, repeated failures
         without progress) — the caller re-raises into the
         restart-from-checkpoint policy."""
         if not ray_params.elastic_training:
@@ -1019,7 +1160,16 @@ def _train(
             )
             return False
         alive_before = sum(1 for a in state.actors if a is not None)
-        alive_n = _apply_failure(state, exc)
+        dead_before = {r for r, a in enumerate(state.actors) if a is None}
+        _apply_failure(state, exc)
+        # death coalescing: fold every near-simultaneous death (the rest of
+        # a dying domain, out-of-band kills) into THIS failure so the world
+        # shrinks once, retraces once, replays nothing
+        _coalesce_deaths()
+        alive_n = sum(1 for a in state.actors if a is not None)
+        blamed = sorted(
+            {r for r, a in enumerate(state.actors) if a is None} - dead_before
+        )
         dead = ray_params.num_actors - alive_n
         if alive_n == 0 or dead > ray_params.max_failed_actors:
             return False
@@ -1035,6 +1185,16 @@ def _train(
                 "in_flight": True,
             },
         )
+        if len(blamed) > 1:
+            rob["deaths_coalesced"] = (
+                rob.get("deaths_coalesced", 0) + len(blamed) - 1
+            )
+            obs.get_tracer().event(
+                "world.deaths_coalesced",
+                round=engine.iteration_offset + engine.num_round_trees,
+                attrs={"ranks": blamed, "extra": len(blamed) - 1},
+            )
+        _note_domains_lost(blamed)
         # stage replacements NOW: when every dead rank reloads within the
         # scheduler's fast path and no grace period applies, the world is
         # restored before the next round even starts
@@ -1098,6 +1258,8 @@ def _train(
         # retry handler — one failure, one count)
         state.consecutive_failures += 1
         _swap_engine(new_engine, kind, started)
+        if kind == "grow":
+            _note_domains_up(promoted)
         if kind == "resume":
             logger.warning(
                 f"[RayXGBoost] A transient failure blamed no worker. "
@@ -1708,6 +1870,12 @@ def _train_impl(
             "grows": 0,
             "orphaned_rows": 0,
             "recompile_s": 0.0,
+            # failure-domain attribution: whole domains lost (every rank of
+            # the domain dead in one failure) and deaths folded into an
+            # already-detected failure's single shrink (a lost domain of K
+            # ranks is 1 shrink + K-1 deaths_coalesced, never K shrinks)
+            "domains_lost": 0,
+            "deaths_coalesced": 0,
         },
     )
 
@@ -1939,12 +2107,21 @@ def _rewire_actors(state: _TrainingState):
         if actor is not None:
             actor.set_queue(state.queue)
             actor.set_stop_event(state.stop_event)
+            actor._coalescer = state.death_coalescer
+            if state.domain_map is not None:
+                actor._domain = state.domain_map.domain_of(actor.rank)
 
 
-def _promote_pending_actors(state: _TrainingState):
+def _promote_pending_actors(state: _TrainingState, ranks=None):
+    """Install ready pending workers as live actors. ``ranks`` restricts the
+    promotion (the round-boundary grow passes only the ranks of COMPLETE due
+    domains — atomic domain grow-back); ``None`` promotes every ready worker
+    (the legacy restart path, which rebuilds the whole world anyway)."""
     for rank, pending in list((state.pending_actors or {}).items()):
         if not pending.ready:
             continue  # still loading in the background; promote next time
+        if ranks is not None and rank not in ranks:
+            continue  # its domain is not complete yet: never half-grow
         state.actors[rank] = pending.actor
         state.failed_actor_ranks.discard(rank)
         state.elastic_dead_ranks.discard(rank)

@@ -76,6 +76,14 @@ TRACE_NAMES = frozenset({
     "attempt", "failure.detected", "recovered", "backoff",
     "world.shrink", "world.grow", "world.resume", "world.restart",
     "checkpoint.commit", "allreduce.bytes",
+    # failure domains (main.py): domain_down when a failure takes a whole
+    # domain's last alive rank (one per lost domain, beside the single
+    # coalesced world.shrink), deaths_coalesced when one shrink absorbed
+    # multiple near-simultaneous deaths (ranks + how many were folded),
+    # domain_up when an atomic domain grow-back makes the domain whole —
+    # a host-loss incident reads domain_down -> deaths_coalesced ->
+    # world.shrink -> elastic.ready -> world.grow -> domain_up
+    "world.domain_down", "world.domain_up", "world.deaths_coalesced",
     # elastic scheduler (elastic.py)
     "elastic.reschedule", "elastic.ready",
     # launcher (launcher.py)
