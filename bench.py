@@ -721,7 +721,10 @@ def low_precision_tripwire(current_lp, prev_rec, prev_name=None,
                            backend=None,
                            threshold=LOW_PRECISION_TRIPWIRE_RATIO):
     """Compare this run's gh_precision='int8' arm steady per-round time
-    against the newest recorded bench's ``low_precision`` section.
+    against the newest recorded bench's ``low_precision`` section, and —
+    when both records carry it — the composed ``int8_block_wire`` arm too
+    (records predating the block wire simply lack the arm; the watch is
+    skipped, never fired, so old snapshots stay comparable).
 
     The quantized-gradient analog of ``sampling_round_time_tripwire``:
     returns ``{prev_per_round_s, prev_record, ratio, fired}`` or None when
@@ -760,6 +763,23 @@ def low_precision_tripwire(current_lp, prev_rec, prev_name=None,
             f"before trusting this build's low-precision numbers.",
             file=sys.stderr,
         )
+    cur_b = (current_lp.get("int8_block_wire") or {}).get("per_round_s")
+    prev_b = (prev_lp.get("int8_block_wire") or {}).get("per_round_s")
+    if cur_b and prev_b:
+        bratio = float(cur_b) / float(prev_b)
+        out["block_wire_ratio"] = round(bratio, 3)
+        out["prev_block_wire_per_round_s"] = round(float(prev_b), 4)
+        if bratio > threshold:
+            out["fired"] = True
+            print(
+                f"[bench] LOW-PRECISION TRIPWIRE: int8_block_wire per-round "
+                f"time {cur_b:.4f}s is {bratio:.2f}x the newest recorded "
+                f"run ({prev_b:.4f}s in {prev_name or 'BENCH_*.json'}) — "
+                f">{(threshold - 1) * 100:.0f}% regression. The block-"
+                f"scaled ring is rotting into a slow path; investigate "
+                f"before trusting this build's wire numbers.",
+                file=sys.stderr,
+            )
     return out
 
 
@@ -767,10 +787,14 @@ def run_low_precision_ablation(x, y, base_params, actors):
     """Paired gh-precision ablation on the ambient mesh: f32 vs int16 vs
     int8 quantized gradients (ROADMAP item 3's measured contract).
 
-    Four arms, fresh and back-to-back (identical environment), each
-    config-identical to the protocol run except ``gh_precision`` — and the
-    f32 reference runs TWICE, bracketing the quantized arms
-    (f32, int16, int8, f32_recheck): same-process round time drifts a few
+    Six arms, fresh and back-to-back (identical environment), each
+    config-identical to the protocol run except the precision knobs — and
+    the f32 reference runs TWICE, bracketing the quantized arms
+    (f32, int16, int8, int8_row_wire, int8_block_wire, f32_recheck): the
+    two wire arms compose int8 gradients with the quantized actors-axis
+    histogram wire (row scales vs block scales) and carry the block
+    format's measured byte-cut and block-vs-row logloss-parity gates.
+    Same-process round time drifts a few
     percent over a multi-minute capture (the r4_paired_recheck lesson), so
     comparing the last arm against the first conflates that drift with the
     mode under test. Ratios are judged against the bracket MEAN, and the
@@ -803,6 +827,15 @@ def run_low_precision_ablation(x, y, base_params, actors):
         "f32": {},
         "int16": {"gh_precision": "int16"},
         "int8": {"gh_precision": "int8"},
+        # composed wire arms (PR 19): int8 gradients x quantized actors-axis
+        # histogram wire, row scales vs block scales — the paired comparison
+        # the block format is bought for. min_bytes=0 so every level really
+        # takes the quantized wire at ablation scale.
+        "int8_row_wire": {"gh_precision": "int8", "hist_quant": "int8",
+                          "hist_quant_min_bytes": 0},
+        "int8_block_wire": {"gh_precision": "int8",
+                            "hist_quant": "int8_block",
+                            "hist_quant_min_bytes": 0},
         "f32_recheck": {},
     }
 
@@ -854,6 +887,9 @@ def run_low_precision_ablation(x, y, base_params, actors):
         gh_bytes = res.get("gh_plane_bytes_per_shard")
         if gh_bytes is not None:
             arm["gh_plane_bytes_per_shard"] = int(gh_bytes)
+        wire_bytes = res.get("hist_allreduce_bytes_per_round")
+        if wire_bytes is not None:
+            arm["hist_allreduce_bytes_per_round"] = int(wire_bytes)
         out[name] = arm
     # drift-resistant f32 reference: the mean of the two bracket arms (the
     # int arms ran between them), plus the recheck/first drift bound
@@ -910,6 +946,67 @@ def run_low_precision_ablation(x, y, base_params, actors):
                 f"until understood.",
                 file=sys.stderr,
             )
+    # composed wire arms: the block format's measured contract is (a) the
+    # ppermute ring moves strictly fewer bytes than the row-scale wire at
+    # the same payload and (b) the two int8-granularity wires agree in
+    # final logloss (block-vs-row parity; both sit ~1e-3 absolute from f32
+    # at this protocol — row and block alike — so the 5e-4 ABSOLUTE gate
+    # stays on the gh arms where it physically holds, and the per-arm f32
+    # deltas are recorded unGated for the drift history)
+    wb_row = out["int8_row_wire"].get("hist_allreduce_bytes_per_round")
+    wb_block = out["int8_block_wire"].get("hist_allreduce_bytes_per_round")
+    if wb_row and wb_block:
+        out["block_wire_bytes_cut"] = round(wb_row / wb_block, 4)
+        out["block_wire_bytes_ok"] = wb_block < wb_row
+        if not out["block_wire_bytes_ok"]:
+            print(
+                f"[bench] BLOCK WIRE BYTES not below row wire: int8_block "
+                f"moved {wb_block} B/round vs int8 row {wb_row} B/round — "
+                f"the in-band-scale ring lost its byte cut; see the "
+                f"low-precision runbook in README.",
+                file=sys.stderr,
+            )
+    for name in ("int8_row_wire", "int8_block_wire"):
+        out[f"{name}_logloss_delta"] = round(
+            ll_exact[name] - ll_exact["f32"], 6
+        )
+    wire_delta = ll_exact["int8_block_wire"] - ll_exact["int8_row_wire"]
+    out["block_vs_row_logloss_delta"] = round(wire_delta, 6)
+    # two-tier accuracy contract for the block wire (mirrors
+    # tests/test_hist_quant.py): ALWAYS gate "block no worse than the row
+    # wire vs f32" — the scale-robust check that catches block-format
+    # accuracy rot — and gate the strict 5e-4 block-vs-row parity only at
+    # protocol scale (>=100k rows; at smoke shapes the two wires path-
+    # diverge by ~1e-3 from sheer sample noise, which says nothing about
+    # the wire format)
+    d_row = abs(ll_exact["int8_row_wire"] - ll_exact["f32"])
+    d_block = abs(ll_exact["int8_block_wire"] - ll_exact["f32"])
+    out["block_no_worse_than_row_ok"] = (
+        d_block <= d_row + LOW_PRECISION_LOGLOSS_TOL
+    )
+    if not out["block_no_worse_than_row_ok"]:
+        print(
+            f"[bench] BLOCK WIRE LOGLOSS drift: int8_block sits "
+            f"{d_block:.6f} from f32 vs the row wire's {d_row:.6f} "
+            f"(margin {LOW_PRECISION_LOGLOSS_TOL}). The block-scale "
+            f"rounding is drifting from the row-scale reference; fall "
+            f"back to hist_quant='int8' until understood (README "
+            f"runbook).",
+            file=sys.stderr,
+        )
+    if x.shape[0] >= 100_000:
+        out["block_vs_row_logloss_ok"] = (
+            abs(wire_delta) <= LOW_PRECISION_LOGLOSS_TOL
+        )
+        if not out["block_vs_row_logloss_ok"]:
+            print(
+                f"[bench] BLOCK WIRE PARITY: block-vs-row logloss delta "
+                f"{out['block_vs_row_logloss_delta']} exceeds "
+                f"{LOW_PRECISION_LOGLOSS_TOL} at protocol scale — the two "
+                f"int8 wires no longer track each other (measured 6e-5 at "
+                f"200k when healthy); see README runbook.",
+                file=sys.stderr,
+            )
     out["config"] = {
         "rows": int(x.shape[0]), "features": int(x.shape[1]),
         "rounds": abl_rounds, "actors": actors,
@@ -920,7 +1017,8 @@ def run_low_precision_ablation(x, y, base_params, actors):
         # lists, not tuples: the prev record round-trips through JSON and
         # the tripwire's like-for-like comparison is plain ==
         "arm_modes": [
-            [k, v.get("gh_precision", "float32")] for k, v in arms.items()
+            [k, v.get("gh_precision", "float32"),
+             v.get("hist_quant", "none")] for k, v in arms.items()
         ],
     }
     print(f"[bench] low-precision ablation: {out}", file=sys.stderr)
@@ -1154,6 +1252,316 @@ def run_streaming_ablation(x, y, base_params, actors):
         "actors": actors,
         "max_depth": int(parsed.max_depth),
     }
+    return out
+
+
+#: --large drift guard: >20% steady per-round regression of the composed
+#: (streamed x int8-gh x int8_block-wire) arm across snapshots
+LARGE_TRIPWIRE_RATIO = 1.2
+#: --large accuracy envelope, RELATIVE to the f32 reference logloss: the
+#: composed arm carries int8-granularity wire rounding, which sits ~2e-3
+#: relative from f32 at the 200k/10-round protocol (row and block scales
+#: alike — the 5e-4 ABSOLUTE bound is pinned where it physically holds:
+#: int16_block vs f32 and block-vs-row, tests/test_hist_quant.py). The
+#: relative gate catches the failure mode that matters at scale: the
+#: composed pipeline drifting from "tracks f32" to "trains a different
+#: model".
+LARGE_LOGLOSS_REL_TOL = 5e-3
+
+
+def _meminfo_available_mb():
+    """MemAvailable from /proc/meminfo in MB, or None off-Linux."""
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) // 1024
+    except OSError:
+        pass
+    return None
+
+
+def _synthetic_higgs_stream(n_rows, n_feat, seed=0, chunk_rows=None):
+    """A fully synthetic generator-backed ShardStream: ``chunk_fn``
+    SYNTHESIZES HIGGS-shaped rows (the make_higgs_like recipe) for
+    [lo, hi) on demand, so the full matrix never exists on the host —
+    peak host memory is O(chunk), which is what lets --large reach rows
+    that a materialized ``make_higgs_like`` array could not.
+
+    Rows are generated in fixed 65536-row blocks each seeded by
+    (seed, block index), so the dataset is a pure function of
+    (n_rows, n_feat, seed) — independent of chunk boundaries, identical
+    across the two-pass read and across arms."""
+    from xgboost_ray_tpu.stream.reader import ShardStream, StreamConfig
+
+    block = 65536
+
+    def _block(bi):
+        rng = np.random.RandomState((int(seed) * 1000003 + bi) % (2 ** 31))
+        lo = bi * block
+        rows = min(block, n_rows - lo)
+        bx = rng.standard_normal(size=(rows, n_feat)).astype(np.float32)
+        logits = (0.8 * bx[:, 0] - 0.6 * bx[:, 1]
+                  + 0.4 * bx[:, 2] * bx[:, 3] + 0.3 * bx[:, 4])
+        by = (logits + rng.standard_normal(rows).astype(np.float32)
+              > 0).astype(np.float32)
+        return bx, by
+
+    def chunk_fn(lo, hi):
+        xs, ys = [], []
+        for bi in range(lo // block, (hi - 1) // block + 1):
+            bx, by = _block(bi)
+            s = slice(max(0, lo - bi * block), min(block, hi - bi * block))
+            xs.append(bx[s])
+            ys.append(by[s])
+        return {"data": np.concatenate(xs), "label": np.concatenate(ys)}
+
+    stream = ShardStream(
+        n_rows, n_feat, chunk_fn,
+        config=StreamConfig(chunk_rows=chunk_rows),
+        source_token=("synthetic_higgs", int(n_rows), int(n_feat),
+                      int(seed)),
+    )
+    return {"stream": stream}, chunk_fn
+
+
+def run_large_measurement():
+    """``--large``: the composed-headline run, MEASURED — never
+    extrapolated. Streams a HIGGS-shaped dataset (11M rows when the host
+    allows; auto-scaled DOWN and recorded/printed otherwise, never
+    silently) through the full low-precision pipeline — streamed binned
+    ingest x gh_precision=int8 x hist_quant=int8_block — against a
+    config-identical f32 reference arm on the same synthetic stream.
+
+    Per arm: peak host RSS delta over build+train, per-device peak memory
+    when the backend reports it (recorded as unavailable otherwise),
+    steady per-round time (min over post-compile rounds), measured wire
+    bytes per round, and the final train logloss via chunked predict over
+    the regenerated stream (the matrix is never materialized). Gates:
+    peak host RSS within the memory budget (2x the binned matrix + 768 MB
+    slack by default, BENCH_LARGE_MEM_BUDGET_MB overrides), composed
+    logloss within LARGE_LOGLOSS_REL_TOL relative of f32, and the
+    composed arm moving strictly fewer wire bytes than the f32 psum."""
+    import gc
+
+    import jax
+
+    from xgboost_ray_tpu.engine import TpuEngine
+    from xgboost_ray_tpu.params import parse_params
+
+    n_feat = int(os.environ.get("BENCH_FEATURES", 28))
+    rounds = int(os.environ.get("BENCH_LARGE_ROUNDS", 20))
+    depth = int(os.environ.get("BENCH_DEPTH", 6))
+    actors = int(os.environ.get("BENCH_ACTORS",
+                                max(1, len(jax.devices()))))
+    requested = int(os.environ.get("BENCH_LARGE_ROWS", 11_000_000))
+
+    # auto-scale rows to the host: the streamed pipeline's resident set is
+    # ~(1 binned byte per feature + bookkeeping) per row; cap the run so
+    # the estimate stays under 40% of MemAvailable. NEVER silent: the
+    # requested and actual row counts are both recorded and printed.
+    avail_mb = _meminfo_available_mb()
+    est_bytes_per_row = n_feat + 64
+    rows = requested
+    if avail_mb is not None:
+        cap = int(avail_mb * 0.4 * 2 ** 20 / est_bytes_per_row)
+        rows = min(requested, cap)
+    if rows < requested:
+        print(
+            f"[bench] --large AUTO-SCALED: host MemAvailable "
+            f"{avail_mb} MB supports ~{rows} rows at "
+            f"{est_bytes_per_row} B/row estimated; requested {requested}. "
+            f"Running the MEASURED smaller shape — figures below are real "
+            f"measurements at rows={rows}, not the requested scale.",
+            file=sys.stderr,
+        )
+    chunk_rows = int(os.environ.get(
+        "BENCH_LARGE_CHUNK", str(max(65536, rows // 64))
+    ))
+    binned_mb = rows * n_feat / 2 ** 20
+    budget_mb = float(os.environ.get(
+        "BENCH_LARGE_MEM_BUDGET_MB", str(2.0 * binned_mb + 768.0)
+    ))
+
+    base = {
+        "objective": "binary:logistic",
+        "eval_metric": ["logloss"],
+        "max_depth": depth,
+        "eta": 0.1,
+        "max_bin": 256,
+    }
+    arms = {
+        "f32": {},
+        "composed": {"gh_precision": "int8", "hist_quant": "int8_block",
+                     "hist_quant_min_bytes": 0},
+    }
+    out = {
+        "rows_requested": requested,
+        "rows": rows,
+        "auto_scaled": rows < requested,
+        "features": n_feat,
+        "rounds": rounds,
+        "actors": actors,
+        "chunk_rows": chunk_rows,
+        "host_mem_available_mb": avail_mb,
+        "mem_budget_mb": round(budget_mb, 1),
+    }
+
+    def _device_peak_mb():
+        peaks = []
+        for d in jax.devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if stats and stats.get("peak_bytes_in_use"):
+                peaks.append(stats["peak_bytes_in_use"])
+        if peaks:
+            return round(sum(peaks) / 2 ** 20, 1)
+        return None
+
+    ll_exact = {}
+    for name, extra in arms.items():
+        gc.collect()
+        shard, chunk_fn = _synthetic_higgs_stream(
+            rows, n_feat, seed=0, chunk_rows=chunk_rows
+        )
+        parsed = parse_params(dict(base, **extra))
+        with _RssPeakSampler() as rss:
+            t0 = time.time()
+            eng = TpuEngine([shard], parsed, num_actors=actors)
+            ingest_s = time.time() - t0
+            round_s = []
+            for i in range(rounds):
+                r0 = time.time()
+                eng.step(i)
+                round_s.append(time.time() - r0)
+        train_s = sum(round_s)
+        # chunked logloss over the regenerated stream: predict per chunk,
+        # accumulate the sum — the matrix is never materialized
+        bst = eng.get_booster()
+        n_seen, ll_sum = 0, 0.0
+        for lo in range(0, rows, chunk_rows):
+            hi = min(lo + chunk_rows, rows)
+            fields = chunk_fn(lo, hi)
+            margin = np.asarray(
+                bst.predict(fields["data"], output_margin=True), np.float64
+            ).ravel()
+            p = np.clip(1.0 / (1.0 + np.exp(-margin)), 1e-15, 1 - 1e-15)
+            cy = fields["label"].astype(np.float64)
+            ll_sum += float(-np.sum(cy * np.log(p)
+                                    + (1 - cy) * np.log1p(-p)))
+            n_seen += hi - lo
+        ll_exact[name] = ll_sum / max(1, n_seen)
+        arm_out = {
+            "ingest_s": round(ingest_s, 3),
+            "train_s": round(train_s, 2),
+            "steady_per_round_s": round(min(round_s[1:]) if len(round_s) > 1
+                                        else round_s[0], 4),
+            "rss_peak_delta_mb": round(rss.delta_mb, 1),
+            "final_logloss": round(ll_exact[name], 6),
+        }
+        dev_mb = _device_peak_mb()
+        arm_out["device_peak_mb"] = (
+            dev_mb if dev_mb is not None else "unavailable"
+        )
+        wire = eng.hist_allreduce_bytes_per_round()
+        if wire is not None:
+            arm_out["hist_allreduce_bytes_per_round"] = int(wire)
+        gh = getattr(eng, "gh_plane_bytes_per_shard", None)
+        if callable(gh):
+            arm_out["gh_plane_bytes_per_shard"] = int(gh())
+        out[name] = arm_out
+        del eng
+    # gates — all three recorded, all three loud on failure
+    peak = max(out["f32"]["rss_peak_delta_mb"],
+               out["composed"]["rss_peak_delta_mb"])
+    out["mem_budget_ok"] = peak <= budget_mb
+    if not out["mem_budget_ok"]:
+        print(
+            f"[bench] LARGE MEMORY over budget: peak host RSS delta "
+            f"{peak} MB exceeds the {budget_mb:.0f} MB budget "
+            f"(2x binned + slack) — a full-f32 materialization has crept "
+            f"into the streamed path.",
+            file=sys.stderr,
+        )
+    delta = ll_exact["composed"] - ll_exact["f32"]
+    out["logloss_delta"] = round(delta, 6)
+    rel = abs(delta) / max(abs(ll_exact["f32"]), 1e-9)
+    out["logloss_rel_delta"] = round(rel, 6)
+    out["logloss_ok"] = rel <= LARGE_LOGLOSS_REL_TOL
+    if not out["logloss_ok"]:
+        print(
+            f"[bench] LARGE LOGLOSS drift: composed arm differs from f32 "
+            f"by {rel:.2%} relative (> {LARGE_LOGLOSS_REL_TOL:.1%}) — the "
+            f"low-precision composition is no longer tracking the "
+            f"reference; fall back per the README runbook.",
+            file=sys.stderr,
+        )
+    wb_f32 = out["f32"].get("hist_allreduce_bytes_per_round")
+    wb_comp = out["composed"].get("hist_allreduce_bytes_per_round")
+    if wb_f32 and wb_comp:
+        out["wire_bytes_cut"] = round(wb_f32 / wb_comp, 2)
+        out["wire_bytes_ok"] = wb_comp < wb_f32
+        if not out["wire_bytes_ok"]:
+            print(
+                f"[bench] LARGE WIRE BYTES: composed arm moved {wb_comp} "
+                f"B/round vs the f32 psum's {wb_f32} — the quantized ring "
+                f"lost its cut.",
+                file=sys.stderr,
+            )
+    out["config"] = {
+        "rows": rows, "features": n_feat, "rounds": rounds,
+        "actors": actors, "max_depth": depth, "chunk_rows": chunk_rows,
+        "arm_modes": [
+            [k, v.get("gh_precision", "float32"),
+             v.get("hist_quant", "none")] for k, v in arms.items()
+        ],
+    }
+    print(f"[bench] large measurement: {out}", file=sys.stderr)
+    return out
+
+
+def large_tripwire(current_large, prev_rec, prev_name=None, backend=None,
+                   threshold=LARGE_TRIPWIRE_RATIO):
+    """Compare this run's composed-arm steady per-round time against the
+    newest recorded bench's ``large`` section. Same shape as the other
+    tripwires: None when no comparable record exists (records predating
+    --large simply lack the section), like-for-like config only."""
+    if not isinstance(current_large, dict):
+        return None
+    cur = (current_large.get("composed") or {}).get("steady_per_round_s")
+    if not cur or not isinstance(prev_rec, dict):
+        return None
+    if backend and prev_rec.get("backend") and prev_rec["backend"] != backend:
+        return None
+    prev_sec = prev_rec.get("large")
+    if not isinstance(prev_sec, dict):
+        return None
+    prev = (prev_sec.get("composed") or {}).get("steady_per_round_s")
+    if not prev:
+        return None
+    ratio = float(cur) / float(prev)
+    out = {
+        "prev_per_round_s": round(float(prev), 4),
+        "prev_record": prev_name,
+        "ratio": round(ratio, 3),
+        "fired": False,
+    }
+    if prev_sec.get("config") != current_large.get("config"):
+        out["config_mismatch"] = True
+        return out
+    if ratio > threshold:
+        out["fired"] = True
+        print(
+            f"[bench] LARGE TRIPWIRE: composed-arm steady per-round time "
+            f"{cur:.4f}s is {ratio:.2f}x the newest recorded run "
+            f"({prev:.4f}s in {prev_name or 'BENCH_*.json'}) — "
+            f">{(threshold - 1) * 100:.0f}% regression at the headline "
+            f"scale; investigate before trusting this build's large-run "
+            f"numbers.",
+            file=sys.stderr,
+        )
     return out
 
 
@@ -2690,7 +3098,10 @@ def run_measurement():
         metric = "higgs11m_100r_train_wall_clock_extrapolated"
         print(
             "[bench] WARNING: CPU-mesh fallback; the value below is a "
-            f"{scale:.0f}x extrapolation, not a TPU measurement.",
+            f"{scale:.0f}x extrapolation, not a TPU measurement. For a "
+            "MEASURED large-scale figure on this host, run "
+            "`python bench.py --large` (streams the HIGGS shape at the "
+            "largest row count the host holds, auto-scale recorded).",
             file=sys.stderr,
         )
     if on_tpu and actors == 1:
@@ -2903,11 +3314,109 @@ def serve_only_main():
     )
 
 
+def large_only_main():
+    """``--large``: run ONLY the composed-headline large measurement and
+    print one JSON line headlined by the composed arm's steady per-round
+    time, with the full ``large`` section and the >20% drift tripwire vs
+    the newest BENCH_*.json. Runs on the 8-device virtual CPU mesh unless
+    BENCH_LARGE_ON_ACCEL=1 keeps the ambient accelerator backend. Exits
+    nonzero when any of the section's contracts (memory budget, relative
+    logloss envelope, wire byte cut) fails."""
+    if os.environ.get("BENCH_LARGE_ON_ACCEL") != "1":
+        _force_cpu_mesh()
+    import jax
+
+    backend = jax.default_backend()
+    section = run_large_measurement()
+    prev_rec, prev_name = _load_latest_bench_record(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    trip = large_tripwire(section, prev_rec, prev_name, backend=backend)
+    if trip is not None:
+        section["regression_tripwire"] = trip
+    print(
+        json.dumps(
+            {
+                "metric": "large_composed_steady_per_round_s",
+                "value": section["composed"]["steady_per_round_s"],
+                "unit": "s",
+                "backend": backend,
+                "large": section,
+            }
+        )
+    )
+    ok = section["mem_budget_ok"] and section["logloss_ok"]
+    ok = ok and section.get("wire_bytes_ok", True)
+    if not ok:
+        print("[bench] large measurement FAILED its contracts",
+              file=sys.stderr)
+        sys.exit(1)
+
+
+def lowprec_only_main():
+    """``--lowprec``: run ONLY the low-precision ablation (gh arms + the
+    composed row/block wire arms) on protocol-shaped data and print one
+    JSON line headlined by the block wire's measured byte cut vs the row
+    wire, with the full ``low_precision`` section and its tripwire. Runs
+    on the 8-device virtual CPU mesh unless BENCH_LOW_PRECISION_ON_ACCEL=1
+    keeps the ambient backend. Exits nonzero when a section gate fails."""
+    if os.environ.get("BENCH_LOW_PRECISION_ON_ACCEL") != "1":
+        _force_cpu_mesh()
+    import jax
+
+    backend = jax.default_backend()
+    rows = int(os.environ.get("BENCH_LOW_PRECISION_ROWS", 200_000))
+    n_feat = int(os.environ.get("BENCH_FEATURES", 28))
+    actors = int(os.environ.get("BENCH_ACTORS",
+                                max(1, len(jax.devices()))))
+    x, y = make_higgs_like(rows, n_feat)
+    params = {
+        "objective": "binary:logistic",
+        "eval_metric": ["logloss"],
+        "max_depth": int(os.environ.get("BENCH_DEPTH", 6)),
+        "eta": 0.1,
+        "max_bin": 256,
+        "tree_method": "tpu_hist",
+    }
+    section = run_low_precision_ablation(x, y, params, actors)
+    prev_rec, prev_name = _load_latest_bench_record(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    trip = low_precision_tripwire(section, prev_rec, prev_name,
+                                  backend=backend)
+    if trip is not None:
+        section["regression_tripwire"] = trip
+    print(
+        json.dumps(
+            {
+                "metric": "low_precision_block_wire_bytes_cut",
+                "value": section.get("block_wire_bytes_cut"),
+                "unit": "x",
+                "backend": backend,
+                "low_precision": section,
+            }
+        )
+    )
+    ok = True
+    for gate in ("int16_logloss_ok", "int8_logloss_ok", "round_time_ok",
+                 "gh_bytes_cut_ok", "block_wire_bytes_ok",
+                 "block_no_worse_than_row_ok", "block_vs_row_logloss_ok"):
+        ok = ok and section.get(gate, True)
+    if not ok:
+        print("[bench] low-precision ablation FAILED its contracts",
+              file=sys.stderr)
+        sys.exit(1)
+
+
 if __name__ == "__main__":
     if "--serve" in sys.argv:
         serve_only_main()
     elif "--chaos" in sys.argv:
         chaos_only_main()
+    elif "--large" in sys.argv:
+        large_only_main()
+    elif "--lowprec" in sys.argv:
+        lowprec_only_main()
     elif "--run" in sys.argv:
         run_measurement()
     else:

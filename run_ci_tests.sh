@@ -47,6 +47,17 @@ echo "================= Running smoke benchmark ================="
 PYTHONPATH=".:$PYTHONPATH" python tests/release/benchmark_tpu.py 2 10 8 --smoke-test
 echo "================= Running chaos smoke (bench --chaos) ================="
 BENCH_CHAOS_ROWS=2000 BENCH_CHAOS_ROUNDS=6 python bench.py --chaos
+echo "========= Running low-precision wire smoke (bench --lowprec) ========="
+# gh int8/int16 arms plus the composed row/block wire arms: gh byte cut,
+# block wire byte cut, and the scale-aware logloss gates must all hold at
+# smoke shape (the strict 5e-4 block-vs-row parity engages at >=100k rows)
+BENCH_LOW_PRECISION_ROWS=4000 BENCH_LOW_PRECISION_ROUNDS=4 \
+    python bench.py --lowprec
+echo "========= Running large-measurement smoke (bench --large) ========="
+# the composed headline run at smoke rows: streamed synthetic ingest x
+# int8 gh x int8_block wire vs the f32 reference — memory budget, wire
+# byte cut, and the relative logloss envelope are real gates even small
+BENCH_LARGE_ROWS=20000 BENCH_LARGE_ROUNDS=4 python bench.py --large
 echo "========= Running elastic-continuation chaos smoke (kill + reintegrate) ========="
 PYTHONPATH=".:$PYTHONPATH" \
 RXGB_FAULT_PLAN='{"rules": [{"site": "actor.train_round", "action": "raise", "ranks": [1], "match": {"round": 3}}]}' \

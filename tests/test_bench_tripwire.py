@@ -773,6 +773,116 @@ def test_low_precision_tripwire_skips_incomparable_records():
     assert bench.low_precision_tripwire({}, rec_tpu, "x") is None
 
 
+def _lp_with_block(per_round_int8, block_per_round, cfg=None):
+    sec = _lp_section(per_round_int8, cfg)
+    sec["int8_block_wire"] = {
+        "per_round_s": block_per_round, "final_logloss": 0.3103,
+        "hist_allreduce_bytes_per_round": 814737,
+    }
+    return sec
+
+
+def test_low_precision_tripwire_fires_on_block_wire_regression(capsys):
+    """The int8 gh arm is flat but the composed int8_block_wire arm got
+    2x slower — the block-arm watch fires on its own."""
+    rec = {"metric": "m", "backend": "cpu",
+           "low_precision": _lp_with_block(2.0, 2.5)}
+    out = bench.low_precision_tripwire(
+        _lp_with_block(2.0, 5.0), rec, "BENCH_r19.json", backend="cpu"
+    )
+    assert out is not None and out["fired"]
+    assert out["block_wire_ratio"] == 2.0
+    assert out["prev_block_wire_per_round_s"] == 2.5
+    err = capsys.readouterr().err
+    assert "int8_block_wire" in err
+
+
+def test_low_precision_tripwire_block_arm_quiet_within_20pct(capsys):
+    rec = {"metric": "m", "backend": "cpu",
+           "low_precision": _lp_with_block(2.0, 2.5)}
+    out = bench.low_precision_tripwire(
+        _lp_with_block(2.0, 2.8), rec, "x", backend="cpu"
+    )
+    assert out is not None and not out["fired"]
+    assert abs(out["block_wire_ratio"] - 1.12) < 1e-9
+    assert "TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_low_precision_tripwire_tolerates_records_without_block_arm(capsys):
+    """A record written before the block wire existed lacks the arm: the
+    int8 watch still runs, the block watch is skipped (no ratio key, no
+    fire) — old snapshots stay comparable."""
+    rec = {"metric": "m", "backend": "cpu",
+           "low_precision": _lp_section(2.0)}
+    out = bench.low_precision_tripwire(
+        _lp_with_block(2.0, 99.0), rec, "x", backend="cpu"
+    )
+    assert out is not None and not out["fired"]
+    assert "block_wire_ratio" not in out
+    assert "TRIPWIRE" not in capsys.readouterr().err
+
+
+_LARGE_CFG = {"rows": 200000, "features": 28, "rounds": 20, "actors": 8,
+              "max_depth": 6, "chunk_rows": 65536,
+              "arm_modes": [["f32", "float32", "none"],
+                            ["composed", "int8", "int8_block"]]}
+
+
+def _large_section(composed_per_round, cfg=None):
+    return {
+        "rows": 200000,
+        "f32": {"steady_per_round_s": 2.0, "final_logloss": 0.545},
+        "composed": {"steady_per_round_s": composed_per_round,
+                     "final_logloss": 0.546,
+                     "hist_allreduce_bytes_per_round": 814737},
+        "mem_budget_ok": True,
+        "logloss_ok": True,
+        "config": dict(cfg if cfg is not None else _LARGE_CFG),
+    }
+
+
+def test_large_tripwire_fires_on_composed_regression(capsys):
+    rec = {"metric": "m", "backend": "cpu", "large": _large_section(2.0)}
+    out = bench.large_tripwire(
+        _large_section(4.0), rec, "BENCH_r19.json", backend="cpu"
+    )
+    assert out is not None and out["fired"]
+    assert out["ratio"] == 2.0
+    assert out["prev_per_round_s"] == 2.0
+    assert "LARGE TRIPWIRE" in capsys.readouterr().err
+
+
+def test_large_tripwire_quiet_within_20pct(capsys):
+    rec = {"metric": "m", "backend": "cpu", "large": _large_section(2.0)}
+    out = bench.large_tripwire(
+        _large_section(2.3), rec, "x", backend="cpu"
+    )
+    assert out is not None and not out["fired"]
+    assert "LARGE TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_large_tripwire_reports_but_never_fires_on_config_mismatch(capsys):
+    other = dict(_LARGE_CFG, rows=1000)
+    rec = {"metric": "m", "backend": "cpu",
+           "large": _large_section(2.0, other)}
+    out = bench.large_tripwire(
+        _large_section(9.0), rec, "x", backend="cpu"
+    )
+    assert out is not None and not out["fired"]
+    assert out["config_mismatch"] is True
+    assert "LARGE TRIPWIRE" not in capsys.readouterr().err
+
+
+def test_large_tripwire_skips_incomparable_records():
+    cur = _large_section(4.0)
+    rec_tpu = {"metric": "m", "backend": "tpu", "large": _large_section(2.0)}
+    assert bench.large_tripwire(cur, rec_tpu, "x", backend="cpu") is None
+    rec_none = {"metric": "m", "backend": "cpu"}  # pre---large record
+    assert bench.large_tripwire(cur, rec_none, "x", backend="cpu") is None
+    assert bench.large_tripwire(None, rec_tpu, "x") is None
+    assert bench.large_tripwire({}, rec_tpu, "x") is None
+
+
 # ---------------------------------------------------------------------------
 # streamed-ingest throughput tripwire
 # ---------------------------------------------------------------------------

@@ -616,3 +616,38 @@ def test_domain_growback_is_atomic(monkeypatch):
     assert up["attrs"]["domain"] == 1
     assert up["attrs"]["ranks"] == [2, 3]
     assert up["seq"] > by_name["world.domain_down"][0]["seq"]
+
+
+def test_block_wire_shrink_then_boundary_growback_bitwise_rerun(monkeypatch):
+    """Block-scaled wire (hist_quant=int8_block) under elastic shrink/grow:
+    the kill shrinks the world to ONE actor — the no-wire branch that
+    replays the quantize/dequantize rounding twice so a later grow back to
+    the ring stays on the same deterministic-rounding contract — then the
+    boundary grow restores the 2-world ppermute ring.  Zero replay, world
+    restored, chaos-vs-chaos bitwise."""
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_RESOURCE_CHECK_S", "0")
+    monkeypatch.setenv("RXGB_ELASTIC_RESTART_GRACE_PERIOD_S", "0")
+    x, y = _data(512)
+    params = dict(_PARAMS, hist_quant="int8_block", hist_quant_min_bytes=0)
+    plan_rules = [
+        {"site": "actor.train_round", "action": "raise", "ranks": [1],
+         "match": {"round": 3}},
+        {"site": "actor.load_shard", "action": "delay", "delay_s": 2.0,
+         "match": {"rank": 1}, "at": 2},
+    ]
+    outs = []
+    for _ in range(2):
+        res = {}
+        with faults.active_plan(faults.FaultPlan(rules=list(plan_rules))):
+            bst = train(params, RayDMatrix(x, y), 12, additional_results=res,
+                        ray_params=RayParams(num_actors=2,
+                                             elastic_training=True,
+                                             max_failed_actors=1,
+                                             max_actor_restarts=2,
+                                             checkpoint_frequency=4))
+        outs.append(bst.predict(x, output_margin=True))
+    rob = res["robustness"]
+    assert rob["rounds_replayed"] == 0 and rob["restarts"] == 0
+    assert rob["shrinks"] == 1 and rob["grows"] == 1
+    assert res["total_n"] == 512
+    assert np.array_equal(outs[0], outs[1])

@@ -305,6 +305,100 @@ def test_unquantized_hist_allreduce_golden_schedule():
     assert sched == [("psum", "float32")]
 
 
+@pytest.mark.parametrize(
+    "mode,narrow", [("int8_block", "int8"), ("int16_block", "int16")]
+)
+def test_block_hist_allreduce_golden_schedule(mode, narrow):
+    """Golden jaxpr schedule for the block-scaled (EQuARX) path: exactly
+    n-1 narrow ppermute ring hops then one narrow all_gather — NO absmax
+    pmax pre-pass, NO all_to_all, NO psum of the payload. The deleted
+    full-latency collective is pinned absent at the program level."""
+    t = _trace(_sharded(_quant_body(mode, 4)), (_HIST,), hist_quant=mode)
+    assert t.ok, t.error
+    sched = [(c.prim, c.dtype) for c in t.analysis.collectives]
+    assert sched == [("ppermute", narrow)] * 3 + [("all_gather", narrow)]
+    assert checks.check_precision_flow([t]) == []
+
+
+def test_block_precision_flow_row_program_claiming_block_meta():
+    """Planted lie, direction 1: a ROW-scale program shipped under block
+    meta must flag every way — the pmax pre-pass survives, the ring is
+    missing, and the row all_to_all survives."""
+    t = _trace(_sharded(_quant_body("int8", 4)), (_HIST,),
+               hist_quant="int8_block")
+    findings = checks.check_precision_flow([t])
+    msgs = [f.message for f in findings]
+    assert all(f.rule == "VER004" for f in findings)
+    assert any("pmax pre-pass survives" in m for m in msgs)
+    assert any("no ppermute" in m for m in msgs)
+    assert any("all_to_all reduce-scatter survives" in m for m in msgs)
+    assert checks.run_checks([t], MESH_AXES)  # fails the gate
+
+
+def test_block_precision_flow_block_program_claiming_row_meta():
+    """Planted lie, direction 2: a BLOCK-scale program shipped under row
+    meta must flag too — the row contract's all_to_all stage is missing."""
+    t = _trace(_sharded(_quant_body("int8_block", 4)), (_HIST,),
+               hist_quant="int8")
+    findings = checks.check_precision_flow([t])
+    assert any(f.rule == "VER004" and "no all_to_all" in f.message
+               for f in findings)
+
+
+def test_block_precision_flow_upcast_ring_true_positive():
+    """A ppermute ring whose hop payload was upcast to f32 defeats the
+    narrow wire — flagged per hop."""
+    def body(h):
+        perm = [(i, (i + 1) % 4) for i in range(4)]
+        cur = h.reshape(-1)
+        for _ in range(3):
+            q = jnp.clip(jnp.round(cur), -127, 127).astype(jnp.int8)
+            cur = jax.lax.ppermute(
+                q.astype(jnp.float32), AXIS_ACTORS, perm
+            )
+        g = jax.lax.all_gather(
+            cur.astype(jnp.int8), AXIS_ACTORS, tiled=True
+        )
+        return g.astype(jnp.float32)[:h.size].reshape(h.shape)
+
+    t = _trace(_sharded(body), (_HIST,), hist_quant="int8_block")
+    assert t.ok, t.error
+    findings = checks.check_precision_flow([t])
+    assert any(
+        f.rule == "VER004" and "ppermute hop payload is float32" in f.message
+        for f in findings
+    )
+
+
+def test_schedule_identity_collapses_ring_hops_across_worlds():
+    """VER001 canonicalization: world 2 traces 1 ring hop, world 4 traces 3
+    — the same collapsed pattern, NOT a divergence (the hop count derives
+    from the axis size every rank agrees on). A dtype drift inside the ring
+    still flags."""
+    def ring(n, dtype):
+        def body(h):
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            cur = jnp.clip(jnp.round(h.reshape(-1)), -127, 127).astype(dtype)
+            for _ in range(n - 1):
+                cur = jax.lax.ppermute(cur, AXIS_ACTORS, perm)
+            g = jax.lax.all_gather(cur, AXIS_ACTORS, tiled=True)
+            return g.astype(jnp.float32)[:h.size].reshape(h.shape)
+        return body
+
+    shard2 = jax.ShapeDtypeStruct((16, 7, 16, 2), "float32")
+    t2 = _trace(_sharded(ring(2, jnp.int8), n=2), (shard2,), world=2,
+                hist_quant="int8_block")
+    t4 = _trace(_sharded(ring(4, jnp.int8)), (_HIST,), world=4,
+                hist_quant="int8_block")
+    assert t2.ok and t4.ok, (t2.error, t4.error)
+    assert checks.check_schedule_identity([t2, t4]) == []
+
+    t4_wide = _trace(_sharded(ring(4, jnp.int16)), (_HIST,), world=4,
+                     hist_quant="int8_block")
+    findings = checks.check_schedule_identity([t2, t4_wide])
+    assert [f.rule for f in findings] == ["VER001"]
+
+
 # ---------------------------------------------------------------------------
 # registry + engine integration
 # ---------------------------------------------------------------------------

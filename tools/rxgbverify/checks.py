@@ -31,10 +31,11 @@ VERIFY_RULES: Dict[str, str] = {
     "VER004": (
         "quantized precision-flow contract broken: a hist_quant int8/int16 "
         "payload is upcast before the wire collective (or the f32 fallback "
-        "psum of the full histogram survives), or a gh_precision program's "
-        "gradient plane is upcast to f32 before histogram accumulation "
-        "(narrow gh aval missing / f32 histogram psum instead of the exact "
-        "int32 wire)"
+        "psum of the full histogram survives); a *_block program still runs "
+        "the global absmax pmax pre-pass, a row-scale all_to_all, or a "
+        "non-narrow ppermute ring; or a gh_precision program's gradient "
+        "plane is upcast to f32 before histogram accumulation (narrow gh "
+        "aval missing / f32 histogram psum instead of the exact int32 wire)"
     ),
     "VER005": (
         "float64 aval in a compiled program: TPU-hostile dtype, doubles "
@@ -55,6 +56,14 @@ _HIST_QUANT_PROGRAMS = (
 )
 
 _NARROW = {"int8": "int8", "int16": "int16"}
+#: block-scaled wire modes -> their narrow payload dtype (schedule: ppermute
+#: ring + in-band-scale all_gather, NO absmax pre-pass, NO all_to_all)
+_NARROW_BLOCK = {"int8_block": "int8", "int16_block": "int16"}
+#: a block-mode program may legitimately contain TINY f32 pmaxes (the
+#: gh_precision per-tree scale reduce is a [2]-element pmax, [k, 2] under
+#: vmapped lanes); the deleted row-scale absmax pre-pass is a
+#: [nodes*F]-element pmax — discriminate by payload element count
+_BLOCK_PMAX_MAX_ELEMS = 8
 
 
 @dataclasses.dataclass
@@ -155,7 +164,10 @@ def check_schedule_identity(traced: Sequence[TracedProgram],
         # have several records at different shapes, all collective-free or
         # alike)
         def sched_set(v):
-            return sorted(t.analysis.schedule() for t in by_variant[v])
+            return sorted(
+                _canonical_schedule(t.analysis.schedule())
+                for t in by_variant[v]
+            )
 
         def label(v):
             return ",".join(f"{k}={val}" for k, val in v)
@@ -179,6 +191,23 @@ def check_schedule_identity(traced: Sequence[TracedProgram],
                 root,
             ))
     return findings
+
+
+def _canonical_schedule(sched: Tuple[tuple, ...]) -> Tuple[tuple, ...]:
+    """Collapse runs of consecutive identical ``ppermute`` identities into
+    one entry. The block-scale ring reduce-scatter traces ``world - 1``
+    identical hops, so the hop COUNT is a deterministic function of the
+    axis size itself (like a psum's payload extent), not a schedule
+    divergence an elastic recompile could deadlock on — every rank of a
+    world derives the same count from the same world size. The collapsed
+    PATTERN (ring present, payload dtype, axis) is the deadlock-freedom
+    certificate VER001 compares."""
+    out: List[tuple] = []
+    for c in sched:
+        if out and out[-1] == c and c[0] == "ppermute":
+            continue
+        out.append(c)
+    return tuple(out)
 
 
 def _first_divergence(ref, cur, ref_label, cur_label) -> str:
@@ -241,6 +270,12 @@ def check_precision_flow(traced: Sequence[TracedProgram],
       ``convert_element_type -> f32`` before the ``all_to_all`` silently
       re-inflates every byte the mode was bought to save, and the f32
       fallback psum of the full [nodes, F, bins, 2] payload must be gone.
+    * ``hist_quant`` block modes (``int8_block``/``int16_block``): the
+      schedule contract is the EQuARX one — NO global absmax pmax pre-pass
+      (the collective the mode was built to delete), a narrow ppermute ring
+      present with every hop payload narrow, a narrow all_gather publish,
+      no row-scale all_to_all reduce-scatter surviving, and no full-rank
+      f32 histogram psum.
     * ``gh_precision`` (the PLANE): the gh buffer entering histogram build
       must BE int8/int16 (the narrow aval must appear in the program) and
       accumulation must stay integer — any histogram-rank psum in f32 means
@@ -257,26 +292,68 @@ def check_precision_flow(traced: Sequence[TracedProgram],
             continue
         colls = t.analysis.collectives
         findings.extend(_gh_precision_findings(t, colls, root))
-        narrow = _NARROW.get(str(t.record.meta.get("hist_quant", "none")))
+        wire = str(t.record.meta.get("hist_quant", "none"))
+        block_narrow = _NARROW_BLOCK.get(wire)
+        narrow = block_narrow or _NARROW.get(wire)
         if narrow is None:
             continue
-        a2a = [c for c in colls if c.prim == "all_to_all"]
         ag = [c for c in colls if c.prim == "all_gather"]
-        if not a2a:
-            findings.append(_finding(
-                t, "VER004",
-                "no all_to_all in a quantized-histogram program: the "
-                "reduce-scatter stage traced away (f32 fallback engaged?)",
-                root,
-            ))
-        for c in a2a:
-            if c.dtype != narrow:
+        a2a = [c for c in colls if c.prim == "all_to_all"]
+        if block_narrow is not None:
+            pps = [c for c in colls if c.prim == "ppermute"]
+            if not pps:
                 findings.append(_finding(
                     t, "VER004",
-                    f"all_to_all payload is {c.dtype}, expected {narrow}: "
-                    f"upcast before the wire ({c.describe()})",
+                    "no ppermute in a block-scaled program: the ring "
+                    "reduce-scatter traced away (f32 fallback engaged, or "
+                    "a row-scale schedule shipped under block meta?)",
                     root,
                 ))
+            for c in pps:
+                if c.dtype != narrow:
+                    findings.append(_finding(
+                        t, "VER004",
+                        f"ppermute hop payload is {c.dtype}, expected "
+                        f"{narrow}: upcast before the wire ({c.describe()})",
+                        root,
+                    ))
+            for c in a2a:
+                findings.append(_finding(
+                    t, "VER004",
+                    f"row-scale all_to_all reduce-scatter survives in a "
+                    f"block-scaled program ({c.describe()})",
+                    root,
+                ))
+            for c in colls:
+                if (
+                    c.prim == "pmax"
+                    and c.dtype == "float32"
+                    and _elems(c.shape) > _BLOCK_PMAX_MAX_ELEMS
+                ):
+                    findings.append(_finding(
+                        t, "VER004",
+                        f"global absmax pmax pre-pass survives in a "
+                        f"block-scaled program — the full-latency collective "
+                        f"the mode deletes ({c.describe()})",
+                        root,
+                    ))
+        else:
+            if not a2a:
+                findings.append(_finding(
+                    t, "VER004",
+                    "no all_to_all in a quantized-histogram program: the "
+                    "reduce-scatter stage traced away (f32 fallback "
+                    "engaged?)",
+                    root,
+                ))
+            for c in a2a:
+                if c.dtype != narrow:
+                    findings.append(_finding(
+                        t, "VER004",
+                        f"all_to_all payload is {c.dtype}, expected "
+                        f"{narrow}: upcast before the wire ({c.describe()})",
+                        root,
+                    ))
         if not any(c.dtype == narrow for c in ag):
             findings.append(_finding(
                 t, "VER004",
@@ -293,6 +370,13 @@ def check_precision_flow(traced: Sequence[TracedProgram],
                     root,
                 ))
     return findings
+
+
+def _elems(shape: tuple) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
 
 
 def _gh_precision_findings(t: TracedProgram, colls,
@@ -314,11 +398,13 @@ def _gh_precision_findings(t: TracedProgram, colls,
         # accumulation-dtype checks below do not apply
         return findings
     hist_psums = [c for c in colls if c.prim == "psum" and len(c.shape) >= 4]
-    wire_narrow = _NARROW.get(str(t.record.meta.get("hist_quant", "none")))
+    wire = str(t.record.meta.get("hist_quant", "none"))
+    wire_narrow = _NARROW.get(wire) or _NARROW_BLOCK.get(wire)
     if wire_narrow is None:
-        # with a narrow hist_quant wire the check_precision_flow loop
-        # already flags any surviving f32 histogram psum — reporting it
-        # here too would count one defect twice
+        # with a narrow hist_quant wire (row- or block-scale) the
+        # check_precision_flow loop already flags any surviving f32
+        # histogram psum — reporting it here too would count one defect
+        # twice
         for c in hist_psums:
             if c.dtype == "float32":
                 findings.append(_finding(
@@ -328,10 +414,7 @@ def _gh_precision_findings(t: TracedProgram, colls,
                     f"({c.describe()})",
                     root,
                 ))
-    if (
-        str(t.record.meta.get("hist_quant", "none")) == "none"
-        and not any(c.dtype == "int32" for c in hist_psums)
-    ):
+    if wire == "none" and not any(c.dtype == "int32" for c in hist_psums):
         findings.append(_finding(
             t, "VER004",
             f"no int32 histogram psum in a gh_precision={narrow} program "

@@ -62,6 +62,21 @@ FULL_MATRIX: Tuple[MatrixEntry, ...] = (
         {"hist_quant": "int16", "hist_quant_min_bytes": 0},
         (4, 8),
     ),
+    # block-scaled wire (EQuARX schedule): VER004 asserts NO absmax pmax
+    # pre-pass, narrow ppermute hops + narrow all_gather, no row-scale
+    # all_to_all; VER001 certifies the ring PATTERN across worlds (hop
+    # count collapses — it is a function of the axis size, see
+    # checks._canonical_schedule)
+    MatrixEntry(
+        "depthwise-int8block",
+        {"hist_quant": "int8_block", "hist_quant_min_bytes": 0},
+        (2, 4, 8),
+    ),
+    MatrixEntry(
+        "depthwise-int16block",
+        {"hist_quant": "int16_block", "hist_quant_min_bytes": 0},
+        (4, 8),
+    ),
     MatrixEntry(
         "lossguide",
         {"grow_policy": "lossguide", "max_leaves": 8},
@@ -91,6 +106,15 @@ FULL_MATRIX: Tuple[MatrixEntry, ...] = (
         # feeding the quantized collective without a f32 round-trip
         "depthwise-int8gh-int8wire",
         {"gh_precision": "int8", "hist_quant": "int8",
+         "hist_quant_min_bytes": 0},
+        (2, 4),
+    ),
+    MatrixEntry(
+        # int8 gh x int8 BLOCK wire: the int32 quantized-domain histogram
+        # must enter the ring via one exact f32 view (never a full-rank f32
+        # psum round-trip) — the composition VER004's block half pins
+        "depthwise-int8gh-int8block",
+        {"gh_precision": "int8", "hist_quant": "int8_block",
          "hist_quant_min_bytes": 0},
         (2, 4),
     ),
@@ -129,6 +153,15 @@ FULL_MATRIX: Tuple[MatrixEntry, ...] = (
     MatrixEntry(
         "depthwise-2d-int8",
         {"feature_parallel": 2, "hist_quant": "int8",
+         "hist_quant_min_bytes": 0},
+        (4,),
+    ),
+    MatrixEntry(
+        # 2D mesh x block wire: the ring runs on the actors axis over the
+        # F/C local tile; the min_bytes global-payload rescale must keep
+        # the block path engaged exactly as on (R, 1)
+        "depthwise-2d-int8block",
+        {"feature_parallel": 2, "hist_quant": "int8_block",
          "hist_quant_min_bytes": 0},
         (4,),
     ),
@@ -187,6 +220,15 @@ QUICK_MATRIX: Tuple[MatrixEntry, ...] = (
         "depthwise-int8",
         {"hist_quant": "int8", "hist_quant_min_bytes": 0},
         (2, 4),
+    ),
+    # block-scaled wire at one world: the fast tier pins the no-pre-pass
+    # ring schedule (VER004 block half) end to end; cross-world pattern
+    # identity for the ring rides on the FULL matrix (CLI gate) and the
+    # planted-program VER001 ring-collapse unit test
+    MatrixEntry(
+        "depthwise-int8block",
+        {"hist_quant": "int8_block", "hist_quant_min_bytes": 0},
+        (2,),
     ),
     # quantized gradients: the gh-plane analog of the quantized wire —
     # exercises the VER004 gh sub-checks in the fast tier

@@ -305,6 +305,7 @@ class TpuEngine:
             hist_precision=resolve_hist_precision(params.hist_precision),
             hist_quant=params.hist_quant,
             hist_quant_min_bytes=params.hist_quant_min_bytes,
+            hist_quant_block=params.hist_quant_block,
             gh_precision=params.gh_precision,
             hist_chunk=params.hist_chunk,
             sibling_subtract=params.sibling_subtract,
@@ -1296,6 +1297,7 @@ class TpuEngine:
                 return quantized_hist_allreduce(
                     h, AXIS_ACTORS, cfg.hist_quant, n_actors, counter,
                     min_bytes=cfg.hist_quant_min_bytes,
+                    block=cfg.hist_quant_block,
                 )
 
             w_eff = weight * valid.astype(jnp.float32)
@@ -1517,6 +1519,9 @@ class TpuEngine:
             "world": int(self.n_devices),
             "grower": "dart" if is_dart else self.params.grow_policy,
             "hist_quant": self.cfg.hist_quant,
+            # block-scale wire granularity: a different block size traces a
+            # different ring payload layout, so it is part of the identity
+            "hist_quant_block": int(self.cfg.hist_quant_block),
             # on-chip gh precision: int8/int16 programs trace integer
             # accumulation + int32 (or quantized) histogram wires — a
             # legitimately different schedule from float32, so it is an

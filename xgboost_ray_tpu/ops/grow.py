@@ -199,12 +199,18 @@ class GrowConfig:
     # leaf budget for lossguide (resolved by the engine: 0 -> 2^max_depth)
     max_leaves: int = 0
     # wire format of the per-level histogram allreduce: "none" (f32 psum) |
-    # "int16" | "int8" (quantized collective, ops/histogram.py). The engine
-    # resolves this into the ``hist_allreduce`` callable; carried here so
-    # the jit-static config names the full histogram contract.
+    # "int16" | "int8" (row-scale quantized collective) | "int16_block" |
+    # "int8_block" (block-scale ppermute ring, no absmax pre-pass;
+    # ops/histogram.py). The engine resolves this into the
+    # ``hist_allreduce`` callable; carried here so the jit-static config
+    # names the full histogram contract. The exact-totals side-psum and 2D
+    # min_bytes rescale decisions key on != "none", so the block modes
+    # compose through both growers with no further plumbing.
     hist_quant: str = "none"
     # sub-threshold payloads keep the exact f32 psum (latency-bound regime)
     hist_quant_min_bytes: int = 32768
+    # elements per in-band scale block (``*_block`` wire modes only)
+    hist_quant_block: int = 512
     # on-chip gh storage/accumulation precision: "float32" (default, exact
     # pre-PR program) | "int16" | "int8" — g/h quantized at the objective
     # kernel (stochastic rounding, per-tree pmax scales; ops/objectives.py)
@@ -438,9 +444,10 @@ def build_tree(
 
         # Does THIS level's histogram cross the quantization size threshold?
         # (Mirrors quantized_hist_allreduce's static decision on the built
-        # tensor.) Sub-threshold levels take the exact f32 psum, and then
-        # node totals also come from the histogram readout — bit-identical
-        # to hist_quant="none", so small problems are a provable no-op.
+        # tensor; != "none" covers the row AND block wire modes.) Sub-
+        # threshold levels take the exact f32 psum, and then node totals
+        # also come from the histogram readout — bit-identical to
+        # hist_quant="none", so small problems are a provable no-op.
         sib = cfg.sibling_subtract and d > 0
         build_nodes = (n_nodes // 2) if sib else n_nodes
         exact_totals = (

@@ -128,8 +128,9 @@ def build_tree_lossguide(
         # [nn, 2] totals: exact psum when the histogram wire is quantized
         # (leaf weights must not carry quantization rounding), feature-0
         # readout otherwise (free). Mirrors quantized_hist_allreduce's
-        # static size-threshold decision so sub-threshold trees stay
-        # bit-identical to hist_quant="none".
+        # static size-threshold decision — != "none" covers row and block
+        # wire modes alike — so sub-threshold trees stay bit-identical to
+        # hist_quant="none".
         quantized = (
             cfg.hist_quant != "none"
             and nn * num_features * nbt * 2 * 4 >= cfg.hist_quant_min_bytes

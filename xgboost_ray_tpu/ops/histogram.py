@@ -34,7 +34,9 @@ import jax.numpy as jnp
 # gpu_hist target). "Quantized Training of GBDTs" (arxiv 2207.09682) shows
 # gradient histograms tolerate low-bit quantization, and EQuARX
 # (arxiv 2506.17615) shows quantized allreduce recovers near-linear
-# collective bandwidth. The wire format here:
+# collective bandwidth. Two wire formats:
+#
+# Row scales ("int8" / "int16"):
 #
 #   1. per-(node, feature) symmetric scales from a pmax-merged absmax
 #      (one tiny f32 pre-reduce — every actor agrees on the scales);
@@ -48,14 +50,38 @@ import jax.numpy as jnp
 #      (same per-(node, feature) granularity) and all_gathered as
 #      int8/int16 + one f32 scale per row.
 #
-# Total wire payload per element ~ 1 + 1/n_actors bytes for int8 vs 4 bytes
-# for the f32 psum. Accuracy: two deterministic roundings at 1/127 (int8)
-# or 1/32767 (int16) relative granularity per (node, feature).
+# Block scales ("int8_block" / "int16_block") — the EQuARX schedule:
+#
+#   1. NO absmax pre-pass. Scales are per contiguous block of the FLATTENED
+#      histogram (``hist_quant_block`` elements, default 512), computed from
+#      whatever each actor holds locally at the moment it sends — the
+#      full-extent pmax pre-reduce (a full-latency collective per merge) is
+#      deleted from the schedule entirely;
+#   2. the merge is a ppermute ring reduce-scatter: at each of the n-1 hops
+#      an actor quantizes its running partial sum against its own running
+#      block absmax, ships int8/int16 data + bitcast f32 block scales as ONE
+#      in-band payload, and the receiver dequant-accumulates in f32 — the
+#      wire is narrow on every hop;
+#   3. after the ring each actor owns one fully-reduced chunk, built by a
+#      single computation path — so the final requantize + tiled all_gather
+#      (scales again in-band) publishes bit-identical results everywhere.
+#
+# Row-scale wire per element ~ 1 + 1/n bytes (int8) vs 4 for f32 psum, plus
+# the pmax pre-pass. Block-scale wire = 2(n-1) * (S/n + 4*ceil(S/(n*B)))
+# bytes for S elements at block B: fewer bytes AND one fewer full-latency
+# collective per merge. Accuracy: row modes round twice at 1/127 (int8)
+# per (node, feature); block modes round once per hop against the running
+# block absmax (n_hops + 1 roundings at 1/127 per block of 512 elements —
+# finer granularity, more roundings; 2207.09682 bounds both regimes).
 # ---------------------------------------------------------------------------
 
-HIST_QUANT_MODES = ("none", "int16", "int8")
+HIST_QUANT_MODES = ("none", "int16", "int8", "int16_block", "int8_block")
 _QMAX = {"int16": 32767, "int8": 127}
 _QDTYPE = {"int16": jnp.int16, "int8": jnp.int8}
+#: block-scaled wire modes -> the narrow dtype key their payloads use
+HIST_QUANT_BLOCK_MODES = {"int16_block": "int16", "int8_block": "int8"}
+#: default elements per in-band scale block (``hist_quant_block`` param)
+HIST_QUANT_DEFAULT_BLOCK = 512
 
 # Payloads below this ship as plain f32 psum even when a quantized mode is
 # on: small collectives are latency-bound (quantizing them saves nothing and
@@ -111,6 +137,14 @@ class AllreduceBytes:
     def add_all_gather(self, chunk) -> None:
         self.total += (self.n - 1) * self._nbytes(chunk) * self._mult
 
+    def add_ppermute(self, arr, hops: int = 1) -> None:
+        """One ``ppermute`` ring hop: every actor ships the full operand to
+        exactly one peer, so the per-actor wire cost is the operand itself
+        (``hops`` times for a multi-hop ring recorded at one call site).
+        Without this the counter would have no model for the block-scale
+        ring and would silently charge it as an allreduce."""
+        self.total += self._nbytes(arr) * int(hops) * self._mult
+
     def repeated(self, n: int):
         """Context manager: collectives traced inside run ``n`` times."""
         import contextlib
@@ -158,12 +192,14 @@ def quantized_hist_allreduce(
     n_actors: int,
     counter: Optional[AllreduceBytes] = None,
     min_bytes: int = HIST_QUANT_MIN_BYTES,
+    block: int = HIST_QUANT_DEFAULT_BLOCK,
 ) -> jnp.ndarray:
     """Allreduce a histogram across ``axis_name`` with an optionally
     quantized wire format (see module comment). ``mode`` is one of
     ``HIST_QUANT_MODES``; ``"none"`` is the plain f32 psum, and payloads
-    under ``min_bytes`` fall back to it (shape-static decision). The result
-    is bit-identical on every shard in all modes.
+    under ``min_bytes`` fall back to it (shape-static decision). ``block``
+    is the scale granularity of the block-scaled modes (ignored by the row
+    modes). The result is bit-identical on every shard in all modes.
 
     ``h`` may be an INT32 quantized-domain histogram (``gh_precision``
     int8/int16 gradients accumulate integer-exact): the fallback psum stays
@@ -174,6 +210,11 @@ def quantized_hist_allreduce(
         if counter is not None:
             counter.add_allreduce(h)
         return jax.lax.psum(h, axis_name)
+    if mode in HIST_QUANT_BLOCK_MODES:
+        return _block_scaled_allreduce(
+            h, axis_name, HIST_QUANT_BLOCK_MODES[mode], n_actors, counter,
+            int(block),
+        )
     if mode not in _QMAX:
         raise ValueError(f"unknown hist_quant mode {mode!r}")
     qmax = _QMAX[mode]
@@ -240,6 +281,108 @@ def quantized_hist_allreduce(
     full_s = jax.lax.bitcast_convert_type(full[:, cols:], jnp.float32)
     merged = full[:, :cols].astype(jnp.float32) * full_s.reshape(-1, 1)
     return merged[:rows].reshape(nn, num_features, nbt, two)
+
+
+def _block_scaled_allreduce(
+    h: jnp.ndarray,
+    axis_name: str,
+    base: str,  # "int8" | "int16" — the narrow payload dtype
+    n_actors: int,
+    counter: Optional[AllreduceBytes],
+    block: int,
+) -> jnp.ndarray:
+    """Block-scaled ring allreduce (``hist_quant="int8_block"/"int16_block"``,
+    see module comment). No absmax pre-pass: each send quantizes against the
+    LOCAL running block absmax, and the schedule is n-1 narrow ppermute hops
+    (ring reduce-scatter with f32 dequant-accumulate per hop) + one narrow
+    tiled all_gather with the f32 block scales bitcast in-band. Each chunk's
+    final value is computed by exactly one actor along its ring path, so the
+    gathered result is bit-identical on every shard."""
+    qmax = _QMAX[base]
+    qdt = _QDTYPE[base]
+    nn, num_features, nbt, two = h.shape
+    size = nn * num_features * nbt * two
+    flat = h.reshape(-1)
+    if flat.dtype != jnp.float32:
+        # int32 gh_precision domain: exact below 2^24, coarser-than-wire
+        # rounding beyond — and NEVER a full-rank f32 psum (VER004)
+        flat = flat.astype(jnp.float32)
+    n = max(1, int(n_actors))
+    pad = (-size) % n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    chunk = (size + pad) // n
+    bpc = -(-chunk // block)  # scale blocks per chunk (last may be ragged)
+    bpad = bpc * block - chunk
+    sw = 4 // jnp.dtype(qdt).itemsize  # narrow words per f32 scale
+
+    def quantize(v):  # [chunk] f32 -> ([chunk] narrow, [bpc] f32 scales)
+        vb = (jnp.pad(v, (0, bpad)) if bpad else v).reshape(bpc, block)
+        amax = jnp.max(jnp.abs(vb), axis=1)
+        scale = jnp.where(amax > 0, amax / qmax, 1.0)
+        q = jnp.clip(jnp.round(vb / scale[:, None]), -qmax, qmax).astype(qdt)
+        return q.reshape(-1)[:chunk], scale
+
+    def dequantize(q, scale):  # ([chunk] narrow, [bpc] f32) -> [chunk] f32
+        qb = (jnp.pad(q, (0, bpad)) if bpad else q).reshape(bpc, block)
+        v = qb.astype(jnp.int32).astype(jnp.float32) * scale[:, None]
+        return v.reshape(-1)[:chunk]
+
+    def pack(q, scale):  # ragged 1-D wire: data then bitcast scale words
+        return jnp.concatenate(
+            [q, jax.lax.bitcast_convert_type(scale, qdt).reshape(-1)]
+        )
+
+    def unpack(payload):
+        scale = jax.lax.bitcast_convert_type(
+            payload[chunk:].reshape(bpc, sw), jnp.float32
+        )
+        return payload[:chunk], scale
+
+    if n == 1:
+        # no wire: the same two deterministic block-granular roundings as
+        # the multi-actor path (one at the first ring send, one at the
+        # publish requantize), so 1-actor and n-actor models see the same
+        # quantization contract
+        q, scale = quantize(flat)
+        q2, scale2 = quantize(dequantize(q, scale))
+        return dequantize(q2, scale2)[:size].reshape(
+            nn, num_features, nbt, two
+        )
+
+    chunks = flat.reshape(n, chunk)
+    p = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    # ring reduce-scatter: at step s actor p ships the running sum of chunk
+    # (p - 1 - s) % n to p + 1, quantized against its running block absmax;
+    # the receiver dequant-accumulates its own local copy in f32. After the
+    # n - 1 hops actor p owns the fully reduced chunk p.
+    cur = jnp.take(chunks, (p - 1) % n, axis=0)
+    for s in range(n - 1):
+        payload = pack(*quantize(cur))
+        if counter is not None:
+            counter.add_ppermute(payload)
+        recv = jax.lax.ppermute(payload, axis_name, perm)
+        rq, rscale = unpack(recv)
+        cur = dequantize(rq, rscale) + jnp.take(chunks, (p - 2 - s) % n, axis=0)
+    # publish: requantize the owned chunk against its merged block absmax
+    # and all_gather with the scales riding in-band — one collective
+    payload = pack(*quantize(cur))
+    if counter is not None:
+        counter.add_all_gather(payload)
+    full = jax.lax.all_gather(payload, axis_name, tiled=True)
+    per = full.reshape(n, chunk + bpc * sw)
+    scales = jax.lax.bitcast_convert_type(
+        per[:, chunk:].reshape(n, bpc, sw), jnp.float32
+    )
+    qs = per[:, :chunk]
+    qb = jnp.pad(qs, ((0, 0), (0, bpad))) if bpad else qs
+    vals = (
+        qb.reshape(n, bpc, block).astype(jnp.int32).astype(jnp.float32)
+        * scales[:, :, None]
+    )
+    merged = vals.reshape(n, bpc * block)[:, :chunk].reshape(-1)
+    return merged[:size].reshape(nn, num_features, nbt, two)
 
 
 def _einsum_precision(precision: str):

@@ -96,14 +96,22 @@ class TrainParams:
     hist_precision: str = "auto"
     # histogram ALLREDUCE wire format: none (f32 psum, default) | int16 |
     # int8 — quantized collective payloads (~4x fewer bytes for int8) with
-    # deterministic rounding and int32 accumulation; node totals / leaf
-    # weights stay exact. Orthogonal to hist_precision (which governs the
-    # on-chip BUILD, this governs the cross-chip MERGE).
+    # deterministic rounding and int32 accumulation — | int16_block |
+    # int8_block — block-scaled ppermute-ring merge with per-block scales
+    # shipped in-band and NO global absmax pre-pass (fewer bytes AND one
+    # fewer full-latency collective per merge); node totals / leaf weights
+    # stay exact in all modes. Orthogonal to hist_precision (which governs
+    # the on-chip BUILD, this governs the cross-chip MERGE).
     hist_quant: str = "none"
     # payloads under this many bytes psum in f32 even when hist_quant is on:
     # small collectives are latency-bound (no byte win) and staying exact
     # keeps small-problem tree structure invariant to the world size
     hist_quant_min_bytes: int = 32768
+    # elements per in-band scale block of the flattened histogram for the
+    # *_block wire modes (power of two; ignored by the row-scale modes).
+    # 512 keeps the scale overhead under 1% while staying far finer than a
+    # per-(node, feature) row at production bin counts.
+    hist_quant_block: int = 512
     # on-chip gradient/hessian precision: float32 (default) | int16 | int8 —
     # g/h quantized AT THE OBJECTIVE KERNEL with per-tree pmax-shared scales
     # and stochastic rounding (deterministic per seed), then carried
@@ -350,10 +358,26 @@ def parse_params(params: Optional[Dict[str, Any]]) -> TrainParams:
             f"{' | '.join(known_impls)}.{extra}"
         )
 
-    if out.hist_quant not in ("none", "int16", "int8"):
+    if out.hist_quant not in (
+        "none", "int16", "int8", "int16_block", "int8_block"
+    ):
         raise ValueError(
             f"Unknown hist_quant {out.hist_quant!r}; use none | int16 | "
-            f"int8 (quantized histogram allreduce wire format)."
+            f"int8 | int16_block | int8_block (quantized histogram "
+            f"allreduce wire format)."
+        )
+    if out.hist_quant_block is None:
+        out.hist_quant_block = 512
+    out.hist_quant_block = int(out.hist_quant_block)
+    if (
+        out.hist_quant_block < 64
+        or out.hist_quant_block > (1 << 20)
+        or out.hist_quant_block & (out.hist_quant_block - 1)
+    ):
+        raise ValueError(
+            f"hist_quant_block must be a power of two in [64, 2^20], got "
+            f"{out.hist_quant_block!r} (elements per in-band scale block "
+            f"of the *_block wire modes)."
         )
 
     if out.gh_precision is None:
